@@ -1,0 +1,64 @@
+"""go_avalanche_tpu — a TPU-native Avalanche consensus simulation framework.
+
+A brand-new JAX/XLA/Pallas framework with the capabilities of
+`itsdevbear/go-avalanche` (see SURVEY.md): the Snowball vote-record state
+machine, the poll/response Processor, and a peer network simulator — rebuilt
+as batched array computation.  Layers:
+
+  ops/       L0 — the vectorized vote-record kernel (+ Pallas fusion)
+  (this pkg) L1 — wire/data types, config, clock
+  processor  L2 — host-side per-node Processor with full reference API parity
+  net        L3 — Connman peer registry
+  models/    L4 — batched network simulators (snowball, avalanche, DAG)
+  parallel/  mesh + shard_map sharding of the simulators
+  utils/     golden oracle, checkpointing, metrics
+"""
+
+from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG, VoteMode
+from go_avalanche_tpu.clock import Clock, StubClock
+from go_avalanche_tpu.net import Connman
+from go_avalanche_tpu.processor import Processor
+from go_avalanche_tpu.types import (
+    NO_NODE,
+    VOTE_NEUTRAL,
+    VOTE_NO,
+    VOTE_YES,
+    Block,
+    Hash,
+    Inv,
+    NodeID,
+    RequestRecord,
+    Response,
+    Status,
+    StatusUpdate,
+    Target,
+    Tx,
+    Vote,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "AvalancheConfig",
+    "DEFAULT_CONFIG",
+    "VoteMode",
+    "Clock",
+    "StubClock",
+    "Connman",
+    "Processor",
+    "NO_NODE",
+    "VOTE_NEUTRAL",
+    "VOTE_NO",
+    "VOTE_YES",
+    "Block",
+    "Hash",
+    "Inv",
+    "NodeID",
+    "RequestRecord",
+    "Response",
+    "Status",
+    "StatusUpdate",
+    "Target",
+    "Tx",
+    "Vote",
+]

@@ -1,0 +1,103 @@
+"""Protocol and simulation configuration.
+
+The reference hard-codes four protocol constants (reference `avalanche.go:8-22`)
+and buries two more in the vote kernel (window size 8 implicit in the `uint8`
+sliding window, `vote.go:55`; quorum 7 implicit in the `> 6` popcount test,
+`vote.go:58`).  Here every protocol parameter is an explicit, sweepable field of
+a frozen dataclass so whole parameter sweeps can be expressed as configs.
+
+The config is *static* with respect to jit: it is hashable and is closed over
+(or passed as a static argument) by the compiled step functions, so every field
+participates in XLA constant folding rather than being traced.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+
+class VoteMode(enum.Enum):
+    """How one simulated round turns k sampled peer preferences into votes.
+
+    SEQUENTIAL — faithful to the reference's ingest path: each peer's vote is
+    pushed through the 8-vote sliding window one at a time, in sample order
+    (`processor.go:94-117` applies votes one by one via `vote.go:54`).
+
+    MAJORITY — Avalanche-paper style: the k sampled preferences are reduced to
+    a single conclusive yes/no chit per round when >= alpha*k agree, else a
+    neutral vote; the chit is pushed through the window once.  This matches how
+    Bitcoin ABC uses the window (one aggregated poll result per round).
+    """
+
+    SEQUENTIAL = "sequential"
+    MAJORITY = "majority"
+
+
+@dataclasses.dataclass(frozen=True)
+class AvalancheConfig:
+    """All protocol constants of the reference plus simulator knobs.
+
+    Reference constants (same defaults, now sweepable):
+      finalization_score  — `avalanche.go:10`  (confidence needed to finalize)
+      time_step_s         — `avalanche.go:13`  (event-loop tick, 10ms)
+      max_element_poll    — `avalanche.go:17`  (max invs per query, 4096)
+      request_timeout_s   — `avalanche.go:21`  (query expiry, 1 minute)
+      window              — `vote.go:55`       (sliding vote window, uint8 => 8)
+      quorum              — `vote.go:58`       (conclusive needs > quorum-1 of
+                                                the non-neutral window bits)
+
+    Simulator knobs (capability gaps, SURVEY.md section 2.4):
+      k                — peers sampled per node per round (replaces the
+                         lowest-id placeholder in `processor.go:173-182` and the
+                         example's round-robin, `examples/.../main.go:111`).
+      alpha            — majority threshold for VoteMode.MAJORITY.
+      vote_mode        — see VoteMode.
+      sample_with_replacement — peer sampling distribution.
+      exclude_self     — never sample yourself (`main.go:114-116`).
+      gossip           — gossip-on-poll admission: a polled peer admits targets
+                         it has not seen (`main.go:177`).
+      strict_validation — the request/response validation contract that the
+                         reference compiled out behind `if false`
+                         (`processor.go:62-90`); here it is an explicit mode
+                         and both paths stay tested.
+    """
+
+    # --- protocol constants (reference parity) ---
+    finalization_score: int = 128
+    time_step_s: float = 0.010
+    max_element_poll: int = 4096
+    request_timeout_s: float = 60.0
+    window: int = 8
+    quorum: int = 7
+
+    # --- simulator knobs ---
+    k: int = 8
+    alpha: float = 0.8
+    vote_mode: VoteMode = VoteMode.SEQUENTIAL
+    sample_with_replacement: bool = True
+    exclude_self: bool = True
+    gossip: bool = True
+    strict_validation: bool = False
+
+    # --- fault / adversary model (SURVEY.md section 2.4 item 5) ---
+    byzantine_fraction: float = 0.0   # nodes that vote adversarially
+    flip_probability: float = 1.0     # P(byzantine node flips its vote)
+    drop_probability: float = 0.0     # P(a sampled peer fails to respond
+                                      #   => neutral vote, vote.go:56 semantics)
+
+    def __post_init__(self) -> None:
+        if not (0 < self.window <= 8):
+            raise ValueError("window must be in (0, 8]: packed into uint8")
+        if not (0 < self.quorum <= self.window):
+            raise ValueError("quorum must be in (0, window]")
+        if self.finalization_score <= 0 or self.finalization_score > 0x7FFF:
+            raise ValueError("finalization_score must fit in 15 bits "
+                             "(confidence counter is uint16 >> 1)")
+        if self.k <= 0:
+            raise ValueError("k must be positive")
+        if not (0.5 < self.alpha <= 1.0):
+            raise ValueError("alpha must be in (0.5, 1.0]")
+
+
+DEFAULT_CONFIG = AvalancheConfig()

@@ -1,0 +1,1 @@
+"""Batched network simulators (layer L4)."""

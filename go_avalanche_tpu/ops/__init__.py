@@ -1,0 +1,1 @@
+"""Vectorized consensus kernels (layer L0)."""

@@ -1,0 +1,22 @@
+"""Small integer bit-twiddling helpers shared by the kernels.
+
+`popcount8` replaces `lax.population_count` on uint8 because of a verified
+XLA:CPU miscompile: inside the fused vote-update loop at certain batch widths
+(observed at batch=64 under `lax.scan`, jax 0.9.0), the vectorized uint8
+popcount of `~votes & consider` returns values off by one (e.g. 7 for
+0b11011011).  The SWAR form below is four VPU-cheap arithmetic ops, compiles
+correctly on every backend, and is what the reference's Kernighan loop
+(`vote.go:93-98`) becomes when vectorized.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def popcount8(x: jax.Array) -> jax.Array:
+    """Per-element popcount of a uint8 array (SWAR, branch-free)."""
+    x = x - ((x >> 1) & jnp.uint8(0x55))
+    x = (x & jnp.uint8(0x33)) + ((x >> 2) & jnp.uint8(0x33))
+    return (x + (x >> 4)) & jnp.uint8(0x0F)

@@ -1,0 +1,245 @@
+"""The vote-record kernel: Snowball confidence tracking, vectorized.
+
+This is layer L0 of the reference (SURVEY.md sections 1, 2.2): the per-target
+state machine in `vote.go:24-98`, re-expressed as a branch-free element-wise
+update over arrays of any shape — in the simulator, shape ``[nodes, txs]``.
+Everything is <=16-bit integer bit-twiddling: shifts, ANDs, SWAR popcounts
+(see `ops/bitops.py` for why not `lax.population_count`), and three-way
+`where` selects, which XLA fuses into a single VPU pass (there is no
+gather/scatter inside the kernel).
+
+State encoding — identical to the reference (`vote.go:25-29, 38-45`):
+  votes      : uint8   sliding window of the last 8 votes, bit0 = newest;
+               bit set = that vote was a yes            (`vote.go:55`)
+  consider   : uint8   sliding window of non-neutral-ness; bit set = that
+               vote was NOT an abstention               (`vote.go:56`)
+  confidence : uint16  bit 0 = current preference (accepted?); bits 1..15 =
+               confidence counter, i.e. isAccepted = confidence & 1
+               (`vote.go:38-40`), getConfidence = confidence >> 1
+               (`vote.go:43-45`), and "+= 2" bumps the counter by one
+               (`vote.go:67`).
+
+Transition, per incoming vote error `err` (`vote.go:54-75`):
+  1. shift a yes bit into `votes`, a non-neutral bit into `consider`;
+  2. conclusive-yes  iff popcount(votes & consider)  > quorum-1  (>6);
+     conclusive-no   iff popcount(~votes & consider) > quorum-1
+     (the reference writes ~votes as (-votes-1), `vote.go:61`);
+  3. inconclusive -> state unchanged, `changed` = False;
+  4. conclusive & agrees with current preference -> counter += 1; `changed`
+     is True only at the exact moment the counter hits finalization_score
+     (`vote.go:68`: == not >=);
+  5. conclusive & disagrees -> preference flips, counter resets to 0
+     (`vote.go:72-74`); `changed` = True.
+
+Vote error convention (signed int): 0 = yes, positive = no, negative = neutral
+(`vote.go:5`, `vote.go:56`: the uint32 sign-bit test).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
+from go_avalanche_tpu.ops.bitops import popcount8
+
+
+class VoteRecordState(NamedTuple):
+    """SoA vote-record state; each leaf has the same (arbitrary) shape."""
+
+    votes: jax.Array       # uint8
+    consider: jax.Array    # uint8
+    confidence: jax.Array  # uint16
+
+
+def init_state(accepted: jax.Array) -> VoteRecordState:
+    """Fresh records seeded with an initial preference (`vote.go:33-35`).
+
+    `accepted` is a bool array of any shape; confidence starts at 0 with the
+    preference bit set iff accepted.
+    """
+    accepted = jnp.asarray(accepted)
+    return VoteRecordState(
+        votes=jnp.zeros(accepted.shape, jnp.uint8),
+        consider=jnp.zeros(accepted.shape, jnp.uint8),
+        confidence=accepted.astype(jnp.uint16),
+    )
+
+
+def is_accepted(confidence: jax.Array) -> jax.Array:
+    """Preference bit (`vote.go:38-40`)."""
+    return (confidence & 1).astype(jnp.bool_)
+
+
+def get_confidence(confidence: jax.Array) -> jax.Array:
+    """Confidence counter (`vote.go:43-45`)."""
+    return confidence >> 1
+
+
+def has_finalized(confidence: jax.Array,
+                  cfg: AvalancheConfig = DEFAULT_CONFIG) -> jax.Array:
+    """Counter reached the finalization score (`vote.go:48-50`)."""
+    return get_confidence(confidence) >= cfg.finalization_score
+
+
+def status(confidence: jax.Array,
+           cfg: AvalancheConfig = DEFAULT_CONFIG) -> jax.Array:
+    """Status codes (`vote.go:77-91`), as int8 matching types.Status values."""
+    acc = is_accepted(confidence)
+    fin = has_finalized(confidence, cfg)
+    # finalized: accepted -> FINALIZED(3) else INVALID(0)
+    # live:      accepted -> ACCEPTED(2)  else REJECTED(1)
+    return jnp.where(
+        fin,
+        jnp.where(acc, jnp.int8(3), jnp.int8(0)),
+        jnp.where(acc, jnp.int8(2), jnp.int8(1)),
+    )
+
+
+def _apply_vote_bits(
+    votes: jax.Array,
+    consider: jax.Array,
+    confidence: jax.Array,
+    yes_bit: jax.Array,
+    non_neutral_bit: jax.Array,
+    cfg: AvalancheConfig,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """One window-shift + confidence transition (`vote.go:54-75`).
+
+    The single shared core behind `register_vote` and
+    `register_packed_votes`; takes the already-extracted yes / non-neutral
+    bits.  Returns (votes, consider, confidence, changed).
+
+    The confidence counter saturates at its 15-bit ceiling instead of wrapping
+    (the reference deletes finalized records before overflow could matter,
+    `processor.go:114-116`; batched records may live on past finalization, and
+    a uint16 wrap would silently un-finalize them).
+    """
+    window_mask = jnp.uint8((1 << cfg.window) - 1)
+    votes = ((votes << 1) | yes_bit.astype(jnp.uint8)) & window_mask
+    consider = ((consider << 1)
+                | non_neutral_bit.astype(jnp.uint8)) & window_mask
+
+    threshold = jnp.uint8(cfg.quorum - 1)  # reference: > 6 with quorum 7
+    yes = popcount8(votes & consider) > threshold
+    no = popcount8(jnp.bitwise_not(votes) & consider & window_mask) > threshold
+    conclusive = yes | no
+
+    accepted = (confidence & 1) == 1
+    agree = accepted == yes
+
+    saturated = get_confidence(confidence) >= jnp.uint16(0x7FFF)
+    conf_bumped = jnp.where(saturated, confidence,
+                            confidence + jnp.uint16(2))
+    conf_reset = yes.astype(jnp.uint16)
+    new_confidence = jnp.where(
+        conclusive,
+        jnp.where(agree, conf_bumped, conf_reset),
+        confidence,
+    )
+
+    finalized_now = (get_confidence(conf_bumped)
+                     == cfg.finalization_score) & agree
+    changed = conclusive & (jnp.logical_not(agree) | finalized_now)
+    return votes, consider, new_confidence, changed
+
+
+def register_vote(
+    state: VoteRecordState,
+    err: jax.Array,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    update_mask: jax.Array | None = None,
+) -> Tuple[VoteRecordState, jax.Array]:
+    """Apply one vote per record; returns (new_state, changed).
+
+    `err` is a signed integer array broadcastable to the state shape.
+    `changed` mirrors the reference's bool return (`vote.go:54`): True iff the
+    acceptance or finalization state changed on this vote.
+
+    `update_mask` (bool, optional) freezes records where False — the batched
+    replacement for the reference's delete-on-finalize (`processor.go:114-116`)
+    and skip-missing-record (`processor.go:95-99`) map operations: masked-out
+    records keep their exact state and report changed=False.
+    """
+    err = jnp.asarray(err)
+    votes, consider, confidence, changed = _apply_vote_bits(
+        state.votes, state.consider, state.confidence,
+        err == 0, err >= 0, cfg)
+
+    if update_mask is not None:
+        update_mask = jnp.asarray(update_mask, jnp.bool_)
+        votes = jnp.where(update_mask, votes, state.votes)
+        consider = jnp.where(update_mask, consider, state.consider)
+        confidence = jnp.where(update_mask, confidence, state.confidence)
+        changed = changed & update_mask
+
+    return VoteRecordState(votes, consider, confidence), changed
+
+
+def register_votes_sequence(
+    state: VoteRecordState,
+    errs: jax.Array,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    update_mask: jax.Array | None = None,
+) -> Tuple[VoteRecordState, jax.Array]:
+    """Apply a sequence of votes (leading axis of `errs`) via `lax.scan`.
+
+    Returns (final_state, changed[num_votes, ...]).  Mirrors replaying the
+    reference ingest loop (`processor.go:94-117`) over a whole response.
+    """
+    errs = jnp.asarray(errs)
+
+    def step(s, e):
+        return register_vote(s, e, cfg, update_mask)
+
+    return lax.scan(step, state, errs)
+
+
+def register_packed_votes(
+    state: VoteRecordState,
+    yes_pack: jax.Array,
+    consider_pack: jax.Array,
+    k: int,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    update_mask: jax.Array | None = None,
+) -> Tuple[VoteRecordState, jax.Array]:
+    """Apply k votes per record from bit-packed planes, oldest-first.
+
+    `yes_pack` / `consider_pack` are uint8 arrays of the state shape; bit j
+    (j in [0, k)) holds vote j's yes / non-neutral flag.  Vote 0 is applied
+    first.  This is the memory-lean form the simulator uses: the per-round
+    gather emits two uint8 planes instead of a [nodes, k, txs] tensor, and the
+    k window updates fuse into one element-wise pass (no HBM round-trips
+    between them).  Semantically identical to k calls to `register_vote` with
+    errs derived from the bits (changed flags are OR-reduced across the k
+    votes, which is what one reference response produces at most one status
+    update per target from, `processor.go:105-112`).
+
+    Returns (new_state, any_changed).
+    """
+    if not (0 < k <= 8):
+        raise ValueError("k must be in (0, 8] for uint8 packing")
+
+    votes, consider, confidence = state
+    any_changed = jnp.zeros(state.votes.shape, jnp.bool_)
+
+    for j in range(k):  # unrolled: k is a static config constant
+        bit = jnp.uint8(1 << j)
+        votes, consider, confidence, changed = _apply_vote_bits(
+            votes, consider, confidence,
+            (yes_pack & bit) != 0, (consider_pack & bit) != 0, cfg)
+        any_changed |= changed
+
+    new_state = VoteRecordState(votes, consider, confidence)
+    if update_mask is not None:
+        update_mask = jnp.asarray(update_mask, jnp.bool_)
+        new_state = VoteRecordState(
+            jnp.where(update_mask, new_state.votes, state.votes),
+            jnp.where(update_mask, new_state.consider, state.consider),
+            jnp.where(update_mask, new_state.confidence, state.confidence),
+        )
+        any_changed = any_changed & update_mask
+    return new_state, any_changed

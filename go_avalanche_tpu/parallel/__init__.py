@@ -1,0 +1,1 @@
+"""Mesh sharding of the simulators (ICI/DCN scale-out)."""

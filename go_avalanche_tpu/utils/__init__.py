@@ -1,0 +1,1 @@
+"""Golden oracle, checkpointing, metrics."""

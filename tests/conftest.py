@@ -1,0 +1,14 @@
+"""Test harness configuration.
+
+Tests run on the CPU backend with 8 virtual XLA devices so the multi-chip
+sharding path (`parallel/`) is exercised without TPU hardware (SURVEY.md
+section 4 test plan, item d).  Must run before the first `import jax`.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,298 @@
+"""Processor lifecycle tests — the intended-behavior spec of the reference
+suite (`avalanche_test.go:93-383`), expressed against the snake_case API.
+
+Covers admission, the confidence ramp with neutral stalls, exactly-one
+finalization update and poll removal, finalized rejection -> INVALID,
+multi-target score ordering, and event-loop start/stop idempotence.
+"""
+
+import pytest
+
+from go_avalanche_tpu import (
+    AvalancheConfig,
+    Block,
+    Connman,
+    Processor,
+    Response,
+    Status,
+    StubClock,
+    Vote,
+)
+
+FIN = AvalancheConfig().finalization_score
+
+
+def make_blocks():
+    # Fixture mirroring `staticTestBlockMap` (`avalanche.go:113-116`):
+    # block 65 (work 99, in active chain), block 66 (work 100, not).
+    return Block(65, 99, True, True), Block(66, 100, True, False)
+
+
+def make_processor(**kwargs):
+    connman = Connman()
+    connman.add_node(0)
+    return Processor(connman, clock=StubClock(0.0), **kwargs), connman
+
+
+def votes_for(hash_, err):
+    return Response(0, 0, [Vote(err, hash_)])
+
+
+def test_admission():
+    p, _ = make_processor()
+    block, _ = make_blocks()
+    assert not p.is_accepted(block)  # unknown target reports False
+    assert p.add_target_to_reconcile(block)
+    assert not p.add_target_to_reconcile(block)  # idempotent
+    assert p.is_accepted(block)  # seeded with the target's own preference
+    invalid = Block(70, 1, False, True)
+    assert not p.add_target_to_reconcile(invalid)  # invalid targets rejected
+
+
+def test_confidence_getter_unknown_target_raises():
+    p, _ = make_processor()
+    block, _ = make_blocks()
+    with pytest.raises(KeyError):
+        p.get_confidence(block)
+
+
+def test_block_register_full_lifecycle():
+    # The `TestBlockRegister` ramp (`avalanche_test.go:93-252`).
+    p, _ = make_processor()
+    block, _ = make_blocks()
+    updates = []
+
+    assert p.add_target_to_reconcile(block)
+    assert len(p.get_invs_for_next_poll()) == 1
+    assert p.get_invs_for_next_poll()[0].target_hash == block.hash()
+
+    yes, no, neutral = (votes_for(block.hash(), e) for e in (0, 1, -1))
+
+    # Six warm-up yes votes: no confidence yet, no updates.
+    for _ in range(6):
+        p.event_loop()
+        assert p.register_votes(0, yes, updates)
+        assert p.is_accepted(block)
+        assert p.get_confidence(block) == 0
+        assert updates == []
+
+    # A single neutral vote changes nothing.
+    p.event_loop()
+    assert p.register_votes(0, neutral, updates)
+    assert p.get_confidence(block) == 0 and updates == []
+
+    # Confidence ramps 1..6.
+    for i in range(1, 7):
+        p.event_loop()
+        assert p.register_votes(0, yes, updates)
+        assert p.get_confidence(block) == i and updates == []
+
+    # Two neutral votes stall progress at 6 — and stay stalled until the
+    # window clears them out again.
+    for _ in range(2):
+        p.event_loop()
+        assert p.register_votes(0, neutral, updates)
+        assert p.get_confidence(block) == 6 and updates == []
+    for _ in range(2, 8):
+        p.event_loop()
+        assert p.register_votes(0, yes, updates)
+        assert p.get_confidence(block) == 6 and updates == []
+
+    # Ramp the rest of the way to one short of finalization.
+    for i in range(7, FIN):
+        p.event_loop()
+        assert p.register_votes(0, yes, updates)
+        assert p.get_confidence(block) == i and updates == []
+    assert len(p.get_invs_for_next_poll()) == 1  # not finalized -> still polls
+
+    # The finalizing vote: exactly one FINALIZED update, poll removed.
+    p.event_loop()
+    assert p.register_votes(0, yes, updates)
+    assert updates == [(block.hash(), Status.FINALIZED)]
+    assert p.get_invs_for_next_poll() == []
+    updates.clear()
+
+    # Re-admit and drive to finalized *rejection* -> INVALID.
+    assert p.add_target_to_reconcile(block)
+    for _ in range(6):
+        p.event_loop()
+        assert p.register_votes(0, no, updates)
+        assert p.is_accepted(block)  # warm-up: preference not yet flipped
+        assert updates == []
+    p.event_loop()
+    assert p.register_votes(0, no, updates)  # 7th no flips preference
+    assert not p.is_accepted(block)
+    assert updates == [(block.hash(), Status.REJECTED)]
+    updates.clear()
+    for _ in range(1, FIN):
+        p.event_loop()
+        assert p.register_votes(0, no, updates)
+        assert not p.is_accepted(block)
+        assert updates == []
+    # One more vote finalizes the rejection (window still conclusive-no even
+    # for a yes vote) -> INVALID, poll removed.
+    p.event_loop()
+    assert p.register_votes(0, yes, updates)
+    assert not p.is_accepted(block)
+    assert updates == [(block.hash(), Status.INVALID)]
+    assert p.get_invs_for_next_poll() == []
+
+
+def test_multi_target_score_descending_order():
+    # The *intended* work-descending inv order (`avalanche_test.go:307-313`,
+    # backed by the disabled sort at `processor.go:163`).
+    p, _ = make_processor()
+    block_a, block_b = make_blocks()  # works 99, 100
+    assert p.add_target_to_reconcile(block_a)
+    assert p.add_target_to_reconcile(block_b)
+    invs = p.get_invs_for_next_poll()
+    assert [i.target_hash for i in invs] == [block_b.hash(), block_a.hash()]
+
+
+def test_multi_target_register_and_finalize_both():
+    p, _ = make_processor()
+    block_a, block_b = make_blocks()
+    block_b.is_in_active_chain = True  # same tweak the reference test makes
+    updates = []
+    assert p.add_target_to_reconcile(block_a)
+    assert p.add_target_to_reconcile(block_b)
+    both = Response(0, 0, [Vote(0, block_b.hash()), Vote(0, block_a.hash())])
+    # 6 warm-up votes, then confidence climbs 1..127 silently; vote 134
+    # finalizes both.
+    for _ in range(6 + FIN - 1):
+        p.event_loop()
+        assert p.register_votes(0, both, updates)
+        assert updates == []
+    p.event_loop()
+    assert p.register_votes(0, both, updates)
+    assert sorted(updates) == sorted([
+        (block_a.hash(), Status.FINALIZED),
+        (block_b.hash(), Status.FINALIZED),
+    ])
+    assert p.get_invs_for_next_poll() == []
+
+
+def test_votes_for_unknown_hash_are_skipped():
+    # "We are not voting on this anymore" (`processor.go:95-99`).
+    p, _ = make_processor()
+    updates = []
+    assert p.register_votes(0, votes_for(12345, 0), updates)
+    assert updates == []
+
+
+def test_invalidated_target_stops_polling_and_voting():
+    # Invalidation mid-flight stops polls (`processor.go:155, 185-187`).
+    p, _ = make_processor()
+    block, _ = make_blocks()
+    updates = []
+    assert p.add_target_to_reconcile(block)
+    assert len(p.get_invs_for_next_poll()) == 1
+    block.valid = False
+    assert p.get_invs_for_next_poll() == []
+    confidence_before = p.get_confidence(block)
+    assert p.register_votes(0, votes_for(block.hash(), 0), updates)
+    assert p.get_confidence(block) == confidence_before  # vote skipped
+    assert updates == []
+
+
+def test_poll_cap():
+    cfg = AvalancheConfig(max_element_poll=4)
+    connman = Connman()
+    connman.add_node(0)
+    p = Processor(connman, cfg, clock=StubClock(0.0))
+    for h in range(10):
+        assert p.add_target_to_reconcile(Block(h, work=h, valid=True,
+                                               is_in_active_chain=True))
+    invs = p.get_invs_for_next_poll()
+    assert len(invs) == 4
+    # Cap keeps the highest-score targets.
+    assert [i.target_hash for i in invs] == [9, 8, 7, 6]
+
+
+def test_round_advances_per_poll():
+    # The reference never advances `p.round` (SURVEY.md section 2.3); we do,
+    # with an opt-out for reference-parity behavior.
+    p, _ = make_processor()
+    block, _ = make_blocks()
+    p.add_target_to_reconcile(block)
+    assert p.get_round() == 0
+    p.event_loop()
+    assert p.get_round() == 1
+    p_ref, _ = make_processor(advance_round=False)
+    p_ref.add_target_to_reconcile(make_blocks()[0])
+    p_ref.event_loop()
+    assert p_ref.get_round() == 0
+
+
+def test_event_loop_without_invs_or_nodes_is_a_noop():
+    p, _ = make_processor()
+    p.event_loop()  # no invs
+    assert p.outstanding_requests() == 0
+    connman = Connman()  # no nodes at all
+    p2 = Processor(connman, clock=StubClock(0.0))
+    block, _ = make_blocks()
+    p2.add_target_to_reconcile(block)
+    p2.event_loop()
+    assert p2.outstanding_requests() == 0
+
+
+def test_start_stop_idempotence():
+    # `TestProcessorEventLoop` (`avalanche_test.go:365-383`).
+    cfg = AvalancheConfig(time_step_s=0.001)
+    connman = Connman()
+    p = Processor(connman, cfg)
+    assert p.start()
+    assert not p.start()
+    assert p.stop()
+    assert not p.stop()
+    assert p.start()
+    assert p.stop()
+
+
+def test_background_event_loop_records_queries():
+    import time
+    cfg = AvalancheConfig(time_step_s=0.001)
+    connman = Connman()
+    connman.add_node(0)
+    p = Processor(connman, cfg)
+    block, _ = make_blocks()
+    p.add_target_to_reconcile(block)
+    assert p.start()
+    deadline = time.time() + 2.0
+    while p.outstanding_requests() == 0 and time.time() < deadline:
+        time.sleep(0.005)
+    assert p.stop()
+    assert p.outstanding_requests() > 0
+
+
+def test_pending_queries_stay_bounded():
+    # The reference leaks a RequestRecord per tick (never consumed in sim
+    # mode); ours reaps expired requests and consumes answered ones.
+    connman = Connman()
+    connman.add_node(0)
+    clock = StubClock(0.0)
+    p = Processor(connman, clock=clock)
+    block, _ = make_blocks()
+    p.add_target_to_reconcile(block)
+    for _ in range(5):
+        p.event_loop()
+    assert p.outstanding_requests() == 5
+    # Answering consumes the matching pending query even in sim mode.
+    p.register_votes(0, Response(4, 0, [Vote(0, block.hash())]), [])
+    assert p.outstanding_requests() == 4
+    # Expiry reaps the rest on the next tick.
+    clock.advance(61.0)
+    p.event_loop()
+    assert p.outstanding_requests() == 1  # only the fresh one remains
+
+
+def test_reference_spelling_aliases():
+    p, _ = make_processor()
+    block, _ = make_blocks()
+    assert p.AddTargetToReconcile(block)
+    assert p.IsAccepted(block)
+    assert p.GetRound() == 0
+    assert len(p.GetInvsForNextPoll()) == 1
+    updates = []
+    assert p.RegisterVotes(0, votes_for(block.hash(), 0), updates)
+    assert p.GetConfidence(block) == 0

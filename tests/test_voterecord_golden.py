@@ -1,0 +1,184 @@
+"""Golden-vector parity tests for the vote-record kernel.
+
+Replays the reference suite's exhaustive scripted sequence
+(`avalanche_test.go:13-92`) against (a) the scalar Python oracle and (b) the
+vectorized JAX kernel, and cross-checks oracle vs kernel on random streams.
+This is the bit-for-bit contract (SURVEY.md section 4, test plan items a-b).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_avalanche_tpu.config import AvalancheConfig
+from go_avalanche_tpu.ops import voterecord as vr
+from go_avalanche_tpu.utils.golden import (
+    ScalarVoteRecord,
+    golden_vector_sequence,
+    replay,
+)
+
+
+def test_initial_state_scalar():
+    # NewVoteRecord(true/false): preference bit only, zero confidence
+    # (`avalanche_test.go:22-30`).
+    r = ScalarVoteRecord.new(True)
+    assert r.is_accepted() and not r.has_finalized() and r.get_confidence() == 0
+    r = ScalarVoteRecord.new(False)
+    assert (not r.is_accepted() and not r.has_finalized()
+            and r.get_confidence() == 0)
+
+
+def test_golden_sequence_scalar_oracle():
+    r = ScalarVoteRecord.new(False)
+    for i, (err, acc, fin, conf) in enumerate(golden_vector_sequence()):
+        r.register_vote(err)
+        assert r.is_accepted() == acc, f"step {i}: accepted"
+        assert r.has_finalized() == fin, f"step {i}: finalized"
+        assert r.get_confidence() == conf, f"step {i}: confidence"
+
+
+def test_golden_sequence_jax_kernel():
+    seq = golden_vector_sequence()
+    errs = jnp.array([e for e, _, _, _ in seq], jnp.int32)
+    state = vr.init_state(jnp.zeros((), jnp.bool_))
+    state, _ = vr.register_votes_sequence(state, errs)
+    # Spot-check trajectory too, not just the endpoint.
+    state2 = vr.init_state(jnp.zeros((), jnp.bool_))
+    for i, (err, acc, fin, conf) in enumerate(seq):
+        state2, _ = vr.register_vote(state2, jnp.int32(err))
+        assert bool(vr.is_accepted(state2.confidence)) == acc, f"step {i}"
+        assert bool(vr.has_finalized(state2.confidence)) == fin, f"step {i}"
+        assert int(vr.get_confidence(state2.confidence)) == conf, f"step {i}"
+    np.testing.assert_array_equal(np.asarray(state.confidence),
+                                  np.asarray(state2.confidence))
+
+
+def test_changed_flag_matches_reference_return():
+    # `regsiterVote` returns true on flips and at the exact finalization
+    # moment only (`vote.go:54-75`).
+    state = vr.init_state(jnp.zeros((), jnp.bool_))
+    changed_flags = []
+    for err, _, _, _ in golden_vector_sequence():
+        state, changed = vr.register_vote(state, jnp.int32(err))
+        changed_flags.append(bool(changed))
+    oracle = ScalarVoteRecord.new(False)
+    expected = [oracle.register_vote(e)
+                for e, _, _, _ in golden_vector_sequence()]
+    assert changed_flags == expected
+    assert sum(changed_flags) == 4  # two flips + two finalizations
+
+
+@pytest.mark.parametrize("seed", range(4))
+@pytest.mark.parametrize("initial_accepted", [False, True])
+def test_property_random_streams_scalar_vs_kernel(seed, initial_accepted):
+    # Random err streams including neutrals; oracle vs vmap'd kernel
+    # (SURVEY.md section 4, item b).
+    rng = np.random.default_rng(seed)
+    batch, steps = 64, 300
+    errs = rng.choice(np.array([0, 0, 0, 1, 1, -1], np.int32),
+                      size=(steps, batch))
+
+    state = vr.init_state(jnp.full((batch,), initial_accepted, jnp.bool_))
+    state, changed = vr.register_votes_sequence(state, jnp.asarray(errs))
+
+    for b in range(batch):
+        trace = replay(initial_accepted, errs[:, b].tolist())
+        v, c, conf, _ = trace[-1]
+        assert int(state.votes[b]) == v
+        assert int(state.consider[b]) == c
+        assert int(state.confidence[b]) == conf
+        assert np.array_equal(np.asarray(changed[:, b]),
+                              np.array([t[3] for t in trace]))
+
+
+def test_update_mask_freezes_records():
+    # Masked-out records must not move: the batched replacement for
+    # delete-on-finalize (`processor.go:114-116`).
+    state = vr.init_state(jnp.array([True, False]))
+    frozen = state
+    mask = jnp.array([False, True])
+    state, changed = vr.register_vote(state, jnp.int32(0), update_mask=mask)
+    assert int(state.votes[0]) == int(frozen.votes[0])
+    assert int(state.confidence[0]) == int(frozen.confidence[0])
+    assert not bool(changed[0])
+    assert int(state.votes[1]) == 1  # live record took the vote
+
+
+def test_packed_votes_match_sequential():
+    rng = np.random.default_rng(7)
+    batch, rounds, k = 32, 40, 8
+    # Per round, k votes per record: yes / no / neutral.
+    errs = rng.choice(np.array([0, 0, 1, -1], np.int32),
+                      size=(rounds, k, batch))
+
+    seq_state = vr.init_state(jnp.zeros((batch,), jnp.bool_))
+    pack_state = vr.init_state(jnp.zeros((batch,), jnp.bool_))
+    for r in range(rounds):
+        any_changed_seq = jnp.zeros((batch,), jnp.bool_)
+        for j in range(k):
+            seq_state, ch = vr.register_vote(seq_state,
+                                             jnp.asarray(errs[r, j]))
+            any_changed_seq |= ch
+        yes_pack = np.zeros((batch,), np.uint8)
+        consider_pack = np.zeros((batch,), np.uint8)
+        for j in range(k):
+            yes_pack |= ((errs[r, j] == 0).astype(np.uint8) << j)
+            consider_pack |= ((errs[r, j] >= 0).astype(np.uint8) << j)
+        pack_state, ch_pack = vr.register_packed_votes(
+            pack_state, jnp.asarray(yes_pack), jnp.asarray(consider_pack), k)
+        np.testing.assert_array_equal(np.asarray(any_changed_seq),
+                                      np.asarray(ch_pack))
+    np.testing.assert_array_equal(np.asarray(seq_state.votes),
+                                  np.asarray(pack_state.votes))
+    np.testing.assert_array_equal(np.asarray(seq_state.consider),
+                                  np.asarray(pack_state.consider))
+    np.testing.assert_array_equal(np.asarray(seq_state.confidence),
+                                  np.asarray(pack_state.confidence))
+
+
+def test_status_mapping():
+    # (finalized, accepted) -> status (`vote.go:77-91`): live-accepted=2,
+    # live-rejected=1, finalized-accepted=3, finalized-rejected=0.
+    fin = 128 << 1
+    confs = jnp.array([0 | 1, 0, fin | 1, fin], jnp.uint16)
+    np.testing.assert_array_equal(np.asarray(vr.status(confs)),
+                                  np.array([2, 1, 3, 0], np.int8))
+
+
+def test_custom_config_quorum_and_finalization():
+    cfg = AvalancheConfig(quorum=5, finalization_score=4, window=6)
+    r = ScalarVoteRecord.new(False, cfg)
+    flips = 0
+    for _ in range(4):  # 4 yes votes: window not yet conclusive (need 5)
+        assert not r.register_vote(0)
+    assert not r.is_accepted()
+    assert r.register_vote(0)  # 5th: conclusive, flips
+    assert r.is_accepted()
+    state = vr.init_state(jnp.zeros((), jnp.bool_))
+    for i in range(5):
+        state, changed = vr.register_vote(state, jnp.int32(0), cfg)
+        assert bool(changed) == (i == 4)
+    assert bool(vr.is_accepted(state.confidence))
+    # Confidence climbs to the custom finalization score.
+    for i in range(cfg.finalization_score):
+        r.register_vote(0)
+        state, _ = vr.register_vote(state, jnp.int32(0), cfg)
+    assert r.has_finalized()
+    assert bool(vr.has_finalized(state.confidence, cfg))
+    assert int(vr.get_confidence(state.confidence)) == r.get_confidence()
+
+
+def test_vmap_over_batch_matches_elementwise():
+    # The kernel is shape-polymorphic; vmap must be a no-op semantically.
+    errs = jnp.array([0, 1, -1, 0, 0, 0, 0, 0], jnp.int32)
+
+    def run_one(accepted):
+        s = vr.init_state(accepted)
+        s, _ = vr.register_votes_sequence(s, errs)
+        return s.confidence
+
+    single = jnp.stack([run_one(jnp.array(a)) for a in (False, True)])
+    batched = jax.vmap(run_one)(jnp.array([False, True]))
+    np.testing.assert_array_equal(np.asarray(single), np.asarray(batched))
