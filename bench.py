@@ -1,0 +1,91 @@
+"""Benchmark: sustained vote throughput of the Avalanche network simulator.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": "votes/sec", "vs_baseline": N}
+
+The reference publishes no numbers (BASELINE.md); the north-star target from
+BASELINE.json is >= 1e9 votes/sec on a v5e-8, so `vs_baseline` is
+value / 1e9.  The workload is the flagship multi-target simulator
+(`models/avalanche.round_step`) on one chip: N nodes x T txs, k sequential
+window votes per (node, tx) per round, gossip off (every node pre-seeded,
+matching the reference example's feed, `examples/.../main.go:49-53`), and a
+finalization score high enough that no record freezes during the timed
+window — i.e. sustained ingest throughput, the hot path of
+`processor.go:92-117` x the whole network.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+
+from go_avalanche_tpu.config import AvalancheConfig
+from go_avalanche_tpu.models import avalanche as av
+
+NORTH_STAR_VOTES_PER_SEC = 1e9
+
+
+def _sync(state) -> None:
+    """Force execution to completion via a scalar device->host fetch.
+
+    `jax.block_until_ready` does not reliably synchronize through the axon
+    TPU tunnel (verified: it reports a 8192^3 matmul at 57 PFLOP/s); fetching
+    a device-reduced scalar does.
+    """
+    import numpy as np
+    np.asarray(jax.numpy.sum(state.records.confidence.astype(jax.numpy.int32)))
+
+
+def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
+          repeats: int = 3) -> dict:
+    # finalization_score 0x7FFE: unreachable within the timed window, so
+    # every (node, tx) record keeps ingesting k votes per round.
+    # max_element_poll >= n_txs so the poll cap never freezes records the
+    # vote count below assumes are live.
+    cfg = AvalancheConfig(finalization_score=0x7FFE, k=k, gossip=False,
+                          max_element_poll=max(4096, n_txs))
+    state = av.init(jax.random.key(0), n_nodes, n_txs, cfg)
+
+    step = jax.jit(lambda s: av.round_step(s, cfg)[0])
+
+    # Warm-up: compile + one executed round.
+    state = step(state)
+    _sync(state)
+
+    best_dt = None
+    for _ in range(repeats):
+        s = state
+        t0 = time.perf_counter()
+        for _ in range(n_rounds):
+            s = step(s)
+        _sync(s)
+        dt = time.perf_counter() - t0
+        best_dt = dt if best_dt is None else min(best_dt, dt)
+
+    votes = n_nodes * n_txs * k * n_rounds
+    votes_per_sec = votes / best_dt
+    return {
+        "metric": f"sustained vote ingest ({n_nodes} nodes x {n_txs} txs, "
+                  f"k={k}, {n_rounds} rounds, "
+                  f"{jax.devices()[0].platform})",
+        "value": round(votes_per_sec, 1),
+        "unit": "votes/sec",
+        "vs_baseline": round(votes_per_sec / NORTH_STAR_VOTES_PER_SEC, 4),
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--nodes", type=int, default=8192)
+    parser.add_argument("--txs", type=int, default=8192)
+    parser.add_argument("--rounds", type=int, default=20)
+    parser.add_argument("--k", type=int, default=8)
+    args = parser.parse_args()
+    print(json.dumps(bench(args.nodes, args.txs, args.rounds, args.k)))
+
+
+if __name__ == "__main__":
+    main()

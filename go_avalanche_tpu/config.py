@@ -77,6 +77,9 @@ class AvalancheConfig:
     vote_mode: VoteMode = VoteMode.SEQUENTIAL
     sample_with_replacement: bool = True
     exclude_self: bool = True
+    weighted_sampling: bool = False   # draw peers prop. to latency weights
+                                      #   (times aliveness); self-draws
+                                      #   become abstentions
     gossip: bool = True
     strict_validation: bool = False
 
@@ -85,6 +88,8 @@ class AvalancheConfig:
     flip_probability: float = 1.0     # P(byzantine node flips its vote)
     drop_probability: float = 0.0     # P(a sampled peer fails to respond
                                       #   => neutral vote, vote.go:56 semantics)
+    churn_probability: float = 0.0    # P(a node toggles dead<->alive, per
+                                      #   round) — dynamic membership
 
     def __post_init__(self) -> None:
         if not (0 < self.window <= 8):

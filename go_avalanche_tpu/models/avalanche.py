@@ -1,0 +1,310 @@
+"""Multi-target Avalanche network simulator: N nodes × T targets.
+
+The batched re-design of the reference's whole stack (SURVEY.md sections 3.3,
+7 phase 3): every node's `Processor` maps (`processor.go:16-19`) become rows
+of dense ``[nodes, txs]`` arrays, and one `round_step` is the entire network
+doing one poll/response/ingest cycle:
+
+    poll-cap top-score targets  (GetInvsForNextPoll, `processor.go:144-170`)
+    sample k peers per node     (replaces round-robin, `main.go:111`)
+    gossip-on-poll admission    (`main.go:177`, as k scatter-ORs)
+    gather peer preferences     (the synchronous `query`, `main.go:168-193`)
+    adversary/drop transforms   (`main.go:184-187` hook; `vote.go:56` neutrals)
+    fused window update         (RegisterVotes, `processor.go:92-117`)
+
+Map insert/delete become masks: `added` replaces AddTargetToReconcile's map
+insert (`processor.go:55-56`), freezing finalized records replaces the
+delete (`processor.go:114-116`).
+
+Memory discipline: the per-round peer gather never materializes a
+``[nodes, k, txs]`` tensor — it runs as k gathers of ``[nodes, txs]`` planes
+bit-packed into two uint8 planes consumed by `register_packed_votes`.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG, VoteMode
+from go_avalanche_tpu.ops import voterecord as vr
+from go_avalanche_tpu.ops.bitops import popcount8
+from go_avalanche_tpu.ops.sampling import (
+    sample_peers_uniform,
+    sample_peers_weighted,
+    self_sample_mask,
+)
+
+
+def popcnt_plane(x: jax.Array) -> jax.Array:
+    """Per-element popcount of a uint8 plane, as int32."""
+    return popcount8(x).astype(jnp.int32)
+
+
+class AvalancheSimState(NamedTuple):
+    """Whole-network state: a pytree of ``[N, T]`` / ``[N]`` / ``[T]`` arrays.
+
+    The structs-of-arrays batched state store (SURVEY.md section 2.4 item 1).
+    """
+
+    records: vr.VoteRecordState  # [N, T] uint8/uint8/uint16
+    added: jax.Array             # bool [N, T] — node reconciles target
+    valid: jax.Array             # bool [T]   — Target.IsValid
+    score_rank: jax.Array        # int32 [T]  — 0 = highest score (poll order)
+    byzantine: jax.Array         # bool [N]
+    alive: jax.Array             # bool [N]
+    latency_weight: jax.Array    # float32 [N] — peer sampling propensity
+    finalized_at: jax.Array      # int32 [N, T]; -1 until finalized
+    round: jax.Array             # int32 scalar
+    key: jax.Array               # PRNG key
+
+
+class SimTelemetry(NamedTuple):
+    """Per-round scalars accumulated on device; fetched infrequently."""
+
+    polls: jax.Array           # int32 — (node, target) pairs polled
+    votes_applied: jax.Array   # int32 — non-neutral votes ingested
+    flips: jax.Array           # int32 — preference flips
+    finalizations: jax.Array   # int32 — records finalized this round
+    admissions: jax.Array      # int32 — gossip admissions this round
+
+
+def score_ranks(scores: jax.Array) -> jax.Array:
+    """Rank targets by descending score; int32 [T], 0 = best.
+
+    Implements the *intended* work-descending poll order
+    (`avalanche.go:162-174`, disabled call at `processor.go:163`).  Ties
+    break by index for determinism.
+    """
+    order = jnp.argsort(-jnp.asarray(scores), stable=True)
+    t = scores.shape[0]
+    return jnp.zeros((t,), jnp.int32).at[order].set(
+        jnp.arange(t, dtype=jnp.int32))
+
+
+def init(
+    key: jax.Array,
+    n_nodes: int,
+    n_txs: int,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    init_pref: Optional[jax.Array] = None,   # bool [T]; default all-accepted
+    scores: Optional[jax.Array] = None,      # [T]; default uniform (tx-like)
+    added: Optional[jax.Array] = None,       # bool [N, T]; default all
+    valid: Optional[jax.Array] = None,       # bool [T]; default all
+    latency_weights: Optional[jax.Array] = None,  # f32 [N]; default uniform
+) -> AvalancheSimState:
+    """Fresh network.
+
+    Defaults mirror the reference example: every node is fed every tx up
+    front (`main.go:49-53`), every tx starts accepted (`main.go:51`:
+    isAccepted=true) with score 1 (`main.go:209`).  Records for not-yet-added
+    pairs are pre-seeded with the target prior and stay inert until gossip
+    admission flips `added` — at which point they start from exactly the
+    state `NewVoteRecord(t.IsAccepted())` would give (`processor.go:56`).
+    """
+    if init_pref is None:
+        init_pref = jnp.ones((n_txs,), jnp.bool_)
+    if scores is None:
+        scores = jnp.ones((n_txs,), jnp.int32)
+    if added is None:
+        added = jnp.ones((n_nodes, n_txs), jnp.bool_)
+    if valid is None:
+        valid = jnp.ones((n_txs,), jnp.bool_)
+    if latency_weights is None:
+        latency_weights = jnp.ones((n_nodes,), jnp.float32)
+
+    n_byz = int(round(cfg.byzantine_fraction * n_nodes))
+    return AvalancheSimState(
+        records=vr.init_state(jnp.broadcast_to(init_pref[None, :],
+                                               (n_nodes, n_txs))),
+        added=jnp.asarray(added, jnp.bool_),
+        valid=jnp.asarray(valid, jnp.bool_),
+        score_rank=score_ranks(scores),
+        byzantine=jnp.arange(n_nodes) < n_byz,
+        alive=jnp.ones((n_nodes,), jnp.bool_),
+        latency_weight=jnp.asarray(latency_weights, jnp.float32),
+        finalized_at=jnp.full((n_nodes, n_txs), -1, jnp.int32),
+        round=jnp.int32(0),
+        key=key,
+    )
+
+
+def capped_poll_mask(
+    pollable: jax.Array,
+    score_rank: jax.Array,
+    cap: int,
+) -> jax.Array:
+    """Keep at most `cap` pollable targets per node, best score first.
+
+    The truncation at `processor.go:165-167` — but by the intended score
+    order rather than whatever the map iterator yielded.  No-op (statically)
+    when T <= cap.
+    """
+    t = pollable.shape[-1]
+    if t <= cap:
+        return pollable
+    order = jnp.argsort(score_rank)           # target indices, best first
+    in_order = pollable[:, order]
+    keep = (jnp.cumsum(in_order.astype(jnp.int32), axis=1) <= cap) & in_order
+    inv = jnp.argsort(order)
+    return keep[:, inv]
+
+
+def round_step(
+    state: AvalancheSimState,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+) -> Tuple[AvalancheSimState, SimTelemetry]:
+    """One network-wide poll/response/ingest round.  Pure; jit/scan-able."""
+    n, t = state.records.votes.shape
+    k_sample, k_byz, k_drop, k_churn, k_next = jax.random.split(state.key, 5)
+
+    fin = vr.has_finalized(state.records.confidence, cfg)
+
+    # --- GetInvsForNextPoll: live, valid, non-finalized, score-capped.
+    pollable = (state.added & state.alive[:, None] & state.valid[None, :]
+                & jnp.logical_not(fin))
+    polled = capped_poll_mask(pollable, state.score_rank,
+                              cfg.max_element_poll)
+
+    # --- peer sampling: uniform, or latency-weighted (BASELINE config 5).
+    # In the weighted mode peers are drawn proportionally to latency_weight
+    # times aliveness (dead peers are never drawn), and self-draws — which
+    # per-row exclusion can't cheaply rule out — become abstentions.
+    if cfg.weighted_sampling:
+        w = state.latency_weight * state.alive.astype(jnp.float32)
+        peers = sample_peers_weighted(k_sample, w, n, cfg.k)
+        self_draw = self_sample_mask(peers)
+    else:
+        peers = sample_peers_uniform(k_sample, n, cfg.k, cfg.exclude_self)
+        self_draw = None
+
+    # --- response model: byzantine flips and dropped responses, decided
+    # per (poller, draw) — a byzantine peer flips its whole response.
+    flip = (state.byzantine[peers]
+            & jax.random.bernoulli(k_byz, cfg.flip_probability, peers.shape))
+    responded = state.alive[peers]
+    if self_draw is not None:
+        responded &= jnp.logical_not(self_draw)
+    if cfg.drop_probability > 0.0:
+        responded &= ~jax.random.bernoulli(k_drop, cfg.drop_probability,
+                                           peers.shape)
+
+    # --- gossip-on-poll: each polled peer admits targets it hasn't seen
+    # (`main.go:177`), via k scatter-ORs (no [N,k,T] tensor).
+    added = state.added
+    admissions = jnp.int32(0)
+    if cfg.gossip:
+        heard = jnp.zeros((n, t), jnp.uint8)
+        polled_u8 = polled.astype(jnp.uint8)
+        for j in range(cfg.k):
+            heard = heard.at[peers[:, j]].max(polled_u8)
+        new_adds = ((heard > 0) & jnp.logical_not(added)
+                    & state.alive[:, None] & state.valid[None, :])
+        admissions = new_adds.sum().astype(jnp.int32)
+        added = added | new_adds
+
+    # --- gather peer preferences and pack the k votes into bit planes.
+    prefs = vr.is_accepted(state.records.confidence)       # [N, T]
+    yes_pack = jnp.zeros((n, t), jnp.uint8)
+    consider_pack = jnp.zeros((n, t), jnp.uint8)
+    for j in range(cfg.k):
+        vote_j = prefs[peers[:, j]]                        # [N, T] gather
+        vote_j = jnp.logical_xor(vote_j, flip[:, j][:, None])
+        yes_pack |= vote_j.astype(jnp.uint8) << jnp.uint8(j)
+        consider_pack |= (responded[:, j].astype(jnp.uint8)
+                          << jnp.uint8(j))[:, None]
+
+    # --- ingest: k fused window updates on polled records only
+    # (RegisterVotes, `processor.go:92-117`); finalized records freeze.
+    if cfg.vote_mode is VoteMode.SEQUENTIAL:
+        records, changed = vr.register_packed_votes(
+            state.records, yes_pack, consider_pack, cfg.k, cfg,
+            update_mask=polled)
+        votes_applied = (popcnt_plane(consider_pack) * polled).sum()
+    else:
+        thresh = math.ceil(cfg.alpha * cfg.k)
+        yes_cnt = popcnt_plane(yes_pack & consider_pack)
+        no_cnt = popcnt_plane(~yes_pack & consider_pack)
+        err = jnp.where(yes_cnt >= thresh, jnp.int32(0),
+                        jnp.where(no_cnt >= thresh, jnp.int32(1),
+                                  jnp.int32(-1)))
+        records, changed = vr.register_vote(state.records, err, cfg,
+                                            update_mask=polled)
+        votes_applied = ((err >= 0) & polled).sum()
+
+    # --- lifecycle + telemetry.
+    fin_after = vr.has_finalized(records.confidence, cfg)
+    newly_final = fin_after & jnp.logical_not(fin)
+    finalized_at = jnp.where(newly_final & (state.finalized_at < 0),
+                             state.round, state.finalized_at)
+
+    alive = state.alive
+    if cfg.churn_probability > 0.0:
+        toggle = jax.random.bernoulli(k_churn, cfg.churn_probability, (n,))
+        alive = jnp.logical_xor(alive, toggle)
+
+    telemetry = SimTelemetry(
+        polls=polled.sum().astype(jnp.int32),
+        votes_applied=votes_applied.astype(jnp.int32),
+        flips=(changed & jnp.logical_not(newly_final)).sum().astype(jnp.int32),
+        finalizations=newly_final.sum().astype(jnp.int32),
+        admissions=admissions,
+    )
+    new_state = AvalancheSimState(
+        records=records,
+        added=added,
+        valid=state.valid,
+        score_rank=state.score_rank,
+        byzantine=state.byzantine,
+        alive=alive,
+        latency_weight=state.latency_weight,
+        finalized_at=finalized_at,
+        round=state.round + 1,
+        key=k_next,
+    )
+    return new_state, telemetry
+
+
+def all_settled(state: AvalancheSimState,
+                cfg: AvalancheConfig = DEFAULT_CONFIG) -> jax.Array:
+    """True when no (live node, valid target) pair still needs polling —
+    the batched "out of invs" condition (`main.go:127-130`)."""
+    fin = vr.has_finalized(state.records.confidence, cfg)
+    pollable = (state.added & state.alive[:, None] & state.valid[None, :]
+                & jnp.logical_not(fin))
+    return jnp.logical_not(pollable.any())
+
+
+def run(
+    state: AvalancheSimState,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    max_rounds: int = 2000,
+) -> AvalancheSimState:
+    """Run until the network settles (or `max_rounds`); single compile."""
+
+    def cond(s: AvalancheSimState) -> jax.Array:
+        return jnp.logical_not(all_settled(s, cfg)) & (s.round < max_rounds)
+
+    def body(s: AvalancheSimState) -> AvalancheSimState:
+        new_s, _ = round_step(s, cfg)
+        return new_s
+
+    return lax.while_loop(cond, body, state)
+
+
+def run_scan(
+    state: AvalancheSimState,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    n_rounds: int = 200,
+) -> Tuple[AvalancheSimState, SimTelemetry]:
+    """Fixed-round run with stacked per-round telemetry (bench/curves)."""
+
+    def step(s: AvalancheSimState, _):
+        new_s, tel = round_step(s, cfg)
+        return new_s, tel
+
+    return lax.scan(step, state, None, length=n_rounds)

@@ -1,0 +1,194 @@
+"""Single-decree Snowball: N nodes deciding one binary question.
+
+The minimum end-to-end slice (SURVEY.md section 7 phase 2, BASELINE config
+"Snowball single-decree: 1k nodes, 1 binary decision").  The whole network is
+one `VoteRecordState` of shape ``[nodes]``; a round is:
+
+    sample k random peers per node  ->  gather their preferences  ->
+    adversary/drop transforms       ->  fused window update
+
+which replaces the reference example's goroutine-per-node poll loop
+(`examples/basic-preconcensus/main.go:91-166`) with one jitted step function
+`lax.scan`/`while_loop`-ed across rounds.
+
+Divergence from the reference example, by design: a node whose record
+finalized keeps answering polls with its *final* preference.  The example
+instead deletes the record and re-admits on the next poll with the target's
+initial prior (`processor.go:114-116` + `main.go:177-183`) — an artifact of
+its delete-then-gossip plumbing, not of the protocol.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG, VoteMode
+from go_avalanche_tpu.ops import voterecord as vr
+from go_avalanche_tpu.ops.sampling import sample_peers_uniform
+
+
+class SnowballState(NamedTuple):
+    """Whole-network state; a pytree of ``[nodes]`` arrays + scalars."""
+
+    records: vr.VoteRecordState   # [N] uint8/uint8/uint16
+    byzantine: jax.Array          # bool [N] — adversarial voters
+    alive: jax.Array              # bool [N] — churn mask
+    finalized_at: jax.Array       # int32 [N]; -1 until finalized
+    round: jax.Array              # int32 scalar
+    key: jax.Array                # PRNG key
+
+
+class RoundTelemetry(NamedTuple):
+    """Per-round scalars, accumulated on device (SURVEY.md section 5)."""
+
+    flips: jax.Array          # int32 — preference flips this round
+    finalizations: jax.Array  # int32 — records that finalized this round
+    yes_preferences: jax.Array  # int32 — nodes currently preferring yes
+
+
+def init(
+    key: jax.Array,
+    n_nodes: int,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    yes_fraction: float = 0.5,
+) -> SnowballState:
+    """Fresh network: each node seeded yes with prob `yes_fraction`, the
+    first `byzantine_fraction` of nodes adversarial."""
+    k_pref, k_next = jax.random.split(key)
+    initial = jax.random.bernoulli(k_pref, yes_fraction, (n_nodes,))
+    n_byz = int(round(cfg.byzantine_fraction * n_nodes))
+    byzantine = jnp.arange(n_nodes) < n_byz
+    return SnowballState(
+        records=vr.init_state(initial),
+        byzantine=byzantine,
+        alive=jnp.ones((n_nodes,), jnp.bool_),
+        finalized_at=jnp.full((n_nodes,), -1, jnp.int32),
+        round=jnp.int32(0),
+        key=k_next,
+    )
+
+
+def round_step(
+    state: SnowballState,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+) -> Tuple[SnowballState, RoundTelemetry]:
+    """One simulated network round.  Pure; jit/scan-able."""
+    n = state.records.votes.shape[0]
+    k_sample, k_byz, k_drop, k_churn, k_next = jax.random.split(state.key, 5)
+
+    # --- poll: every node samples k peers (`getSuitableNodeToQuery`
+    # replacement) and reads their current preference (the example's
+    # synchronous `query`, `main.go:168-193`, as a gather).
+    peers = sample_peers_uniform(k_sample, n, cfg.k, cfg.exclude_self)
+    prefs = vr.is_accepted(state.records.confidence)
+    peer_votes = prefs[peers]                               # [N, k] bool
+
+    # --- adversary: byzantine peers vote against their true preference with
+    # `flip_probability` (the commented-out vote flip, `main.go:184-187`).
+    flip = (state.byzantine[peers]
+            & jax.random.bernoulli(k_byz, cfg.flip_probability,
+                                   peers.shape))
+    peer_votes = jnp.logical_xor(peer_votes, flip)
+
+    # --- failure model: dropped responses and dead peers are abstentions
+    # (neutral votes model non-responsive peers, `vote.go:56`).
+    responded = state.alive[peers]
+    if cfg.drop_probability > 0.0:
+        responded &= ~jax.random.bernoulli(k_drop, cfg.drop_probability,
+                                           peers.shape)
+
+    fin_before = vr.has_finalized(state.records.confidence, cfg)
+    update_mask = jnp.logical_not(fin_before) & state.alive
+
+    if cfg.vote_mode is VoteMode.SEQUENTIAL:
+        # Faithful per-vote window semantics: pack the k votes into uint8 bit
+        # planes and run k fused window updates (`processor.go:94-117`).
+        shifts = jnp.arange(cfg.k, dtype=jnp.uint8)
+        yes_pack = (peer_votes.astype(jnp.uint8) << shifts).sum(
+            axis=1).astype(jnp.uint8)
+        consider_pack = (responded.astype(jnp.uint8) << shifts).sum(
+            axis=1).astype(jnp.uint8)
+        records, changed = vr.register_packed_votes(
+            state.records, yes_pack, consider_pack, cfg.k, cfg, update_mask)
+    else:
+        # Paper-style majority chit: one conclusive vote per round when
+        # >= ceil(alpha*k) of the sampled peers agree, else neutral.
+        thresh = math.ceil(cfg.alpha * cfg.k)
+        yes_cnt = (peer_votes & responded).sum(axis=1)
+        no_cnt = (jnp.logical_not(peer_votes) & responded).sum(axis=1)
+        err = jnp.where(yes_cnt >= thresh, jnp.int32(0),
+                        jnp.where(no_cnt >= thresh, jnp.int32(1),
+                                  jnp.int32(-1)))
+        records, changed = vr.register_vote(state.records, err, cfg,
+                                            update_mask)
+
+    # --- lifecycle + telemetry
+    fin_after = vr.has_finalized(records.confidence, cfg)
+    newly_final = fin_after & jnp.logical_not(fin_before)
+    finalized_at = jnp.where(
+        newly_final & (state.finalized_at < 0),
+        state.round, state.finalized_at)
+
+    # --- churn: nodes toggle dead<->alive.
+    alive = state.alive
+    if cfg.churn_probability > 0.0:
+        toggle = jax.random.bernoulli(k_churn, cfg.churn_probability, (n,))
+        alive = jnp.logical_xor(alive, toggle)
+
+    telemetry = RoundTelemetry(
+        flips=(changed & jnp.logical_not(newly_final)).sum().astype(jnp.int32),
+        finalizations=newly_final.sum().astype(jnp.int32),
+        yes_preferences=vr.is_accepted(
+            records.confidence).sum().astype(jnp.int32),
+    )
+    new_state = SnowballState(
+        records=records,
+        byzantine=state.byzantine,
+        alive=alive,
+        finalized_at=finalized_at,
+        round=state.round + 1,
+        key=k_next,
+    )
+    return new_state, telemetry
+
+
+def run(
+    state: SnowballState,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    max_rounds: int = 1000,
+) -> SnowballState:
+    """Run rounds until every live node finalized (or `max_rounds`).
+
+    Early exit via `lax.while_loop`; compile once, no host round-trips.
+    """
+
+    def cond(s: SnowballState) -> jax.Array:
+        live_unfinished = (jnp.logical_not(
+            vr.has_finalized(s.records.confidence, cfg)) & s.alive).any()
+        return live_unfinished & (s.round < max_rounds)
+
+    def body(s: SnowballState) -> SnowballState:
+        new_s, _ = round_step(s, cfg)
+        return new_s
+
+    return lax.while_loop(cond, body, state)
+
+
+def run_scan(
+    state: SnowballState,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    n_rounds: int = 200,
+) -> Tuple[SnowballState, RoundTelemetry]:
+    """Run a fixed number of rounds, returning stacked per-round telemetry
+    (for rounds-to-finality curves and benchmarking)."""
+
+    def step(s: SnowballState, _):
+        new_s, t = round_step(s, cfg)
+        return new_s, t
+
+    return lax.scan(step, state, None, length=n_rounds)

@@ -1,12 +1,13 @@
 """Small integer bit-twiddling helpers shared by the kernels.
 
 `popcount8` replaces `lax.population_count` on uint8 because of a verified
-XLA:CPU miscompile: inside the fused vote-update loop at certain batch widths
-(observed at batch=64 under `lax.scan`, jax 0.9.0), the vectorized uint8
-popcount of `~votes & consider` returns values off by one (e.g. 7 for
-0b11011011).  The SWAR form below is four VPU-cheap arithmetic ops, compiles
-correctly on every backend, and is what the reference's Kernighan loop
-(`vote.go:93-98`) becomes when vectorized.
+miscompile on the TPU (axon) backend, jax 0.9.0: inside the fused vote-update
+loop at certain batch widths (observed at batch=64 under `lax.scan`), the
+vectorized uint8 popcount of `~votes & consider` returns values off by one
+(e.g. 7 for 0b11011011).  The same program is correct on the XLA:CPU backend.
+The SWAR form below is four VPU-cheap arithmetic ops, compiles correctly on
+every backend, and is what the reference's Kernighan loop (`vote.go:93-98`)
+becomes when vectorized.
 """
 
 from __future__ import annotations
@@ -20,3 +21,24 @@ def popcount8(x: jax.Array) -> jax.Array:
     x = x - ((x >> 1) & jnp.uint8(0x55))
     x = (x & jnp.uint8(0x33)) + ((x >> 2) & jnp.uint8(0x33))
     return (x + (x >> 4)) & jnp.uint8(0x0F)
+
+
+def pack_bool_plane(x: jax.Array) -> jax.Array:
+    """Pack a bool ``[n, t]`` plane into uint8 ``[n, ceil(t/8)]``, bit j of
+    byte b holding column ``8*b + j``.  The wire format for cross-shard
+    preference exchange: 8x less all-gather traffic than bool planes."""
+    n, t = x.shape
+    tp = -(-t // 8) * 8
+    if tp != t:
+        x = jnp.pad(x, ((0, 0), (0, tp - t)))
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    return (x.reshape(n, tp // 8, 8).astype(jnp.uint8) << shifts).sum(
+        axis=-1).astype(jnp.uint8)
+
+
+def unpack_bool_plane(packed: jax.Array, t: int) -> jax.Array:
+    """Inverse of `pack_bool_plane`: uint8 ``[n, ceil(t/8)]`` -> bool
+    ``[n, t]``."""
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (packed[:, :, None] >> shifts) & jnp.uint8(1)
+    return bits.reshape(packed.shape[0], -1)[:, :t].astype(jnp.bool_)

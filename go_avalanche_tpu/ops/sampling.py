@@ -1,0 +1,83 @@
+"""Peer sampling — the topology module (SURVEY.md section 2.4 item 2).
+
+Replaces the reference's placeholder peer selection (always the lowest node
+id, `processor.go:173-182`) and the example's deterministic round-robin
+(`examples/basic-preconcensus/main.go:111`) with the protocol-correct random
+k-peer subsample, entirely on device: every node draws k peers per round from
+a keyed PRNG with no host round-trips (SURVEY.md section 7 hard part (a)).
+
+Latency weighting uses inverse-CDF sampling over a cumulative weight vector —
+O(N·k·log N) and mesh-friendly — instead of materializing per-node categorical
+logits (which would be O(N^2) at 100k nodes).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_peers_uniform(
+    key: jax.Array,
+    n_nodes: int,
+    k: int,
+    exclude_self: bool = True,
+    n_local: int | None = None,
+    id_offset: int | jax.Array = 0,
+) -> jax.Array:
+    """Uniform k-peer sample per node; int32 ``[n_local or n_nodes, k]`` of
+    *global* peer ids in [0, n_nodes).
+
+    With `exclude_self`, node i never draws i: each draw is taken from
+    [0, n_nodes-1) and values >= i are shifted up by one — an exact uniform
+    distribution over the other n-1 nodes, with replacement.
+
+    `n_local`/`id_offset` support sharded use: a shard owning global rows
+    [id_offset, id_offset + n_local) samples peers for just its own nodes
+    (ids remain global, so gathers cross shards).
+    """
+    if exclude_self and n_nodes < 2:
+        raise ValueError("exclude_self requires at least 2 nodes")
+    rows = n_nodes if n_local is None else n_local
+    self_ids = (jnp.arange(rows, dtype=jnp.int32)
+                + jnp.asarray(id_offset, jnp.int32))[:, None]
+    if exclude_self:
+        draws = jax.random.randint(key, (rows, k), 0, n_nodes - 1,
+                                   dtype=jnp.int32)
+        return draws + (draws >= self_ids).astype(jnp.int32)
+    return jax.random.randint(key, (rows, k), 0, n_nodes, dtype=jnp.int32)
+
+
+def sample_peers_weighted(
+    key: jax.Array,
+    weights: jax.Array,
+    n_rows: int,
+    k: int,
+) -> jax.Array:
+    """Weighted k-peer sample; int32 ``[n_rows, k]`` of global peer ids, with
+    replacement, drawn proportionally to `weights`.
+
+    `weights` is a non-negative ``[n_peers]`` vector (e.g. inverse expected
+    latency, times an aliveness mask so churned-out peers are never drawn).
+    Self-draws are NOT excluded here — per-row exclusion would need an O(N^2)
+    weight matrix; callers mask self-draws to neutral votes instead (see
+    `models/avalanche.round_step`, weighted branch).
+    """
+    weights = jnp.asarray(weights, jnp.float32)
+    cdf = jnp.cumsum(weights)
+    total = cdf[-1]
+    u = jax.random.uniform(key, (n_rows, k), jnp.float32) * total
+    idx = jnp.searchsorted(cdf, u, side="right")
+    return jnp.clip(idx, 0, weights.shape[0] - 1).astype(jnp.int32)
+
+
+def self_sample_mask(peers: jax.Array,
+                     id_offset: int | jax.Array = 0) -> jax.Array:
+    """Bool ``[n, k]``: True where a draw landed on the sampling node itself.
+
+    Row i holds the node with global id `id_offset + i` (sharded use).
+    """
+    n = peers.shape[0]
+    self_ids = (jnp.arange(n, dtype=peers.dtype)
+                + jnp.asarray(id_offset, peers.dtype))[:, None]
+    return peers == self_ids

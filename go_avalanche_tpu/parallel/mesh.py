@@ -1,0 +1,52 @@
+"""Device mesh construction for the simulators.
+
+The scale-out design (SURVEY.md sections 2.4 item 3, 5 "distributed
+communication backend"): the ``[nodes, txs]`` state shards over a 2D mesh —
+
+  axis "nodes":  data-parallel rows; the ONLY axis that communicates
+                 (packed-preference all-gather, gossip reduce-scatter,
+                 telemetry psum), riding ICI within a slice.
+  axis "txs":    embarrassingly parallel columns (a vote for target t only
+                 touches column t), so txs-sharding needs no collectives at
+                 all — the natural DCN / multi-slice axis.
+
+This replaces the reference's absence of any distributed backend (its
+"network" is a map of ids, `net.go:11-31`, and a mutex-guarded method call,
+`examples/basic-preconcensus/main.go:168-193`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+NODES_AXIS = "nodes"
+TXS_AXIS = "txs"
+
+
+def make_mesh(
+    n_node_shards: Optional[int] = None,
+    n_tx_shards: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a ``(nodes, txs)`` mesh over the given (default: all) devices.
+
+    With defaults, all devices go to the nodes axis.  `n_node_shards *
+    n_tx_shards` must equal the device count.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n_dev = len(devices)
+    if n_node_shards is None:
+        if n_dev % n_tx_shards:
+            raise ValueError(f"{n_dev} devices not divisible by "
+                             f"n_tx_shards={n_tx_shards}")
+        n_node_shards = n_dev // n_tx_shards
+    if n_node_shards * n_tx_shards != n_dev:
+        raise ValueError(
+            f"mesh {n_node_shards}x{n_tx_shards} != {n_dev} devices")
+    dev_array = np.asarray(devices).reshape(n_node_shards, n_tx_shards)
+    return Mesh(dev_array, (NODES_AXIS, TXS_AXIS))

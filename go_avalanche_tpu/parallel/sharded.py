@@ -1,0 +1,300 @@
+"""Mesh-sharded multi-target simulator: the distributed backend.
+
+`models/avalanche.round_step` re-expressed under `jax.shard_map` over the
+``(nodes, txs)`` mesh of `parallel/mesh.py`.  Where the reference has no
+communication backend at all (SURVEY.md section 5), every cross-node
+interaction here is an explicit XLA collective on the "nodes" axis:
+
+  * **preference exchange** — each shard packs its local preference plane to
+    bits (`ops/bitops.pack_bool_plane`, 8x traffic reduction) and
+    `all_gather`s it, so peer gathers index a replicated packed plane;
+  * **gossip admission**    — local scatter-ORs into a global-height plane,
+    then `psum_scatter` back to owner shards;
+  * **global statistics**   — telemetry and the settled flag are `psum`s.
+
+The "txs" axis needs no collectives (a vote for target t touches only
+column t), making it the natural cross-slice/DCN axis.
+
+Randomness: per-round base keys are folded with the shard's "nodes" axis
+index only, so all "txs" shards of the same node rows draw identical peers /
+flips / drops — preserving the unsharded semantics where one response covers
+all of a node's polled targets.  Runs are deterministic for a fixed key and
+mesh shape (the stream differs from the unsharded model's, which folds
+nothing).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG, VoteMode
+from go_avalanche_tpu.models.avalanche import (
+    AvalancheSimState,
+    SimTelemetry,
+    capped_poll_mask,
+    popcnt_plane,
+)
+from go_avalanche_tpu.ops import voterecord as vr
+from go_avalanche_tpu.ops.bitops import pack_bool_plane, unpack_bool_plane
+from go_avalanche_tpu.ops.sampling import (
+    sample_peers_uniform,
+    sample_peers_weighted,
+    self_sample_mask,
+)
+from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS
+
+
+def state_specs() -> AvalancheSimState:
+    """PartitionSpecs for every leaf of `AvalancheSimState`."""
+    return AvalancheSimState(
+        records=vr.VoteRecordState(
+            votes=P(NODES_AXIS, TXS_AXIS),
+            consider=P(NODES_AXIS, TXS_AXIS),
+            confidence=P(NODES_AXIS, TXS_AXIS),
+        ),
+        added=P(NODES_AXIS, TXS_AXIS),
+        valid=P(TXS_AXIS),
+        score_rank=P(TXS_AXIS),
+        byzantine=P(),           # replicated [N]: peer lookups need all rows
+        alive=P(),
+        latency_weight=P(),      # replicated [N]: global sampling CDF
+        finalized_at=P(NODES_AXIS, TXS_AXIS),
+        round=P(),
+        key=P(),
+    )
+
+
+def shard_state(state: AvalancheSimState, mesh) -> AvalancheSimState:
+    """Place a host-built state onto the mesh with the canonical shardings."""
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        state, state_specs())
+
+
+def _local_round(
+    state: AvalancheSimState,
+    cfg: AvalancheConfig,
+    n_global: int,
+    n_tx_shards: int,
+) -> Tuple[AvalancheSimState, SimTelemetry]:
+    """One round on this shard's block; collectives on the nodes axis only."""
+    n_local, t_local = state.records.votes.shape
+    nshard = lax.axis_index(NODES_AXIS)
+    offset = nshard * n_local
+
+    # Per-round keys: base split is replicated; sampling/fault draws fold in
+    # the nodes-shard index (NOT the txs index — see module docstring).
+    k_sample, k_byz, k_drop, k_churn, k_next = jax.random.split(state.key, 5)
+    k_sample = jax.random.fold_in(k_sample, nshard)
+    k_byz = jax.random.fold_in(k_byz, nshard)
+    k_drop = jax.random.fold_in(k_drop, nshard)
+    k_churn = jax.random.fold_in(k_churn, nshard)
+
+    fin = vr.has_finalized(state.records.confidence, cfg)
+    alive_local = lax.dynamic_slice(state.alive, (offset,), (n_local,))
+
+    # --- GetInvsForNextPoll on the local block.  With txs sharding the poll
+    # cap is applied per shard at cap/n_tx_shards (exact when T fits the cap,
+    # approximate otherwise — a global cap would need a cross-shard cumsum).
+    pollable = (state.added & alive_local[:, None] & state.valid[None, :]
+                & jnp.logical_not(fin))
+    local_cap = max(1, cfg.max_element_poll // n_tx_shards)
+    polled = capped_poll_mask(pollable, state.score_rank, local_cap)
+
+    # --- sample k global peer ids for the local rows (uniform or
+    # latency-weighted; the weighted CDF is global/replicated).
+    if cfg.weighted_sampling:
+        w = state.latency_weight * state.alive.astype(jnp.float32)
+        peers = sample_peers_weighted(k_sample, w, n_local, cfg.k)
+        self_draw = self_sample_mask(peers, id_offset=offset)
+    else:
+        peers = sample_peers_uniform(k_sample, n_global, cfg.k,
+                                     cfg.exclude_self,
+                                     n_local=n_local, id_offset=offset)
+        self_draw = None
+
+    flip = (state.byzantine[peers]
+            & jax.random.bernoulli(k_byz, cfg.flip_probability, peers.shape))
+    responded = state.alive[peers]
+    if self_draw is not None:
+        responded &= jnp.logical_not(self_draw)
+    if cfg.drop_probability > 0.0:
+        responded &= ~jax.random.bernoulli(k_drop, cfg.drop_probability,
+                                           peers.shape)
+
+    # --- gossip-on-poll across shards: scatter into a global-height plane,
+    # reduce-scatter back to owners.
+    added = state.added
+    admissions = jnp.int32(0)
+    if cfg.gossip:
+        heard_global = jnp.zeros((n_global, t_local), jnp.uint8)
+        polled_u8 = polled.astype(jnp.uint8)
+        for j in range(cfg.k):
+            heard_global = heard_global.at[peers[:, j]].max(polled_u8)
+        heard = lax.psum_scatter(heard_global, NODES_AXIS,
+                                 scatter_dimension=0, tiled=True)
+        new_adds = ((heard > 0) & jnp.logical_not(added)
+                    & alive_local[:, None] & state.valid[None, :])
+        admissions = new_adds.sum().astype(jnp.int32)
+        added = added | new_adds
+
+    # --- preference exchange: pack local plane, all-gather, gather rows.
+    prefs_local = vr.is_accepted(state.records.confidence)
+    packed_local = pack_bool_plane(prefs_local)        # [n_local, ceil(t/8)]
+    packed_global = lax.all_gather(packed_local, NODES_AXIS, axis=0,
+                                   tiled=True)         # [n_global, ceil(t/8)]
+
+    yes_pack = jnp.zeros((n_local, t_local), jnp.uint8)
+    consider_pack = jnp.zeros((n_local, t_local), jnp.uint8)
+    for j in range(cfg.k):
+        vote_j = unpack_bool_plane(packed_global[peers[:, j]], t_local)
+        vote_j = jnp.logical_xor(vote_j, flip[:, j][:, None])
+        yes_pack |= vote_j.astype(jnp.uint8) << jnp.uint8(j)
+        consider_pack |= (responded[:, j].astype(jnp.uint8)
+                          << jnp.uint8(j))[:, None]
+
+    # --- ingest.
+    if cfg.vote_mode is VoteMode.SEQUENTIAL:
+        records, changed = vr.register_packed_votes(
+            state.records, yes_pack, consider_pack, cfg.k, cfg,
+            update_mask=polled)
+        votes_applied = (popcnt_plane(consider_pack) * polled).sum()
+    else:
+        thresh = math.ceil(cfg.alpha * cfg.k)
+        yes_cnt = popcnt_plane(yes_pack & consider_pack)
+        no_cnt = popcnt_plane(~yes_pack & consider_pack)
+        err = jnp.where(yes_cnt >= thresh, jnp.int32(0),
+                        jnp.where(no_cnt >= thresh, jnp.int32(1),
+                                  jnp.int32(-1)))
+        records, changed = vr.register_vote(state.records, err, cfg,
+                                            update_mask=polled)
+        votes_applied = ((err >= 0) & polled).sum()
+
+    # --- lifecycle.
+    fin_after = vr.has_finalized(records.confidence, cfg)
+    newly_final = fin_after & jnp.logical_not(fin)
+    finalized_at = jnp.where(newly_final & (state.finalized_at < 0),
+                             state.round, state.finalized_at)
+
+    alive = state.alive
+    if cfg.churn_probability > 0.0:
+        toggle = jax.random.bernoulli(k_churn, cfg.churn_probability,
+                                      (n_local,))
+        alive_local_new = jnp.logical_xor(alive_local, toggle)
+        alive = lax.all_gather(alive_local_new, NODES_AXIS, axis=0,
+                               tiled=True)
+
+    # --- global telemetry: psum over both axes => replicated scalars.
+    def _global_sum(x):
+        return lax.psum(x.astype(jnp.int32), (NODES_AXIS, TXS_AXIS))
+
+    telemetry = SimTelemetry(
+        polls=_global_sum(polled.sum()),
+        votes_applied=_global_sum(votes_applied),
+        flips=_global_sum((changed & jnp.logical_not(newly_final)).sum()),
+        finalizations=_global_sum(newly_final.sum()),
+        admissions=_global_sum(admissions),
+    )
+    new_state = AvalancheSimState(
+        records=records,
+        added=added,
+        valid=state.valid,
+        score_rank=state.score_rank,
+        byzantine=state.byzantine,
+        alive=alive,
+        latency_weight=state.latency_weight,
+        finalized_at=finalized_at,
+        round=state.round + 1,
+        key=k_next,
+    )
+    return new_state, telemetry
+
+
+def _shard_mapped(mesh, fn):
+    specs = state_specs()
+    tel_specs = SimTelemetry(*([P()] * len(SimTelemetry._fields)))
+    return jax.shard_map(fn, mesh=mesh, in_specs=(specs,),
+                         out_specs=(specs, tel_specs), check_vma=False)
+
+
+def make_sharded_round_step(mesh, cfg: AvalancheConfig = DEFAULT_CONFIG):
+    """Build a jitted one-round step over the mesh; call it with a (global)
+    `AvalancheSimState` placed by `shard_state`."""
+    n_tx = mesh.shape[TXS_AXIS]
+    cache = {}
+
+    def step(state: AvalancheSimState):
+        n_global = state.records.votes.shape[0]
+        if n_global not in cache:
+            cache[n_global] = jax.jit(_shard_mapped(
+                mesh, lambda s: _local_round(s, cfg, n_global, n_tx)))
+        return cache[n_global](state)
+
+    return step
+
+
+def run_scan_sharded(
+    mesh,
+    state: AvalancheSimState,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    n_rounds: int = 100,
+) -> Tuple[AvalancheSimState, SimTelemetry]:
+    """Fixed-round sharded run; one jit, collectives inside the scan."""
+    n_global = state.records.votes.shape[0]
+    n_tx = mesh.shape[TXS_AXIS]
+
+    def local_scan(s):
+        def body(carry, _):
+            new_s, tel = _local_round(carry, cfg, n_global, n_tx)
+            return new_s, tel
+        return lax.scan(body, s, None, length=n_rounds)
+
+    return jax.jit(_shard_mapped(mesh, local_scan))(state)
+
+
+def run_sharded(
+    mesh,
+    state: AvalancheSimState,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    max_rounds: int = 2000,
+) -> AvalancheSimState:
+    """Run until globally settled (psum'd flag) or `max_rounds`; one jit."""
+    n_global = state.records.votes.shape[0]
+    n_tx = mesh.shape[TXS_AXIS]
+
+    def local_run(s):
+        def unsettled(st):
+            n_local = st.records.votes.shape[0]
+            nshard = lax.axis_index(NODES_AXIS)
+            alive_local = lax.dynamic_slice(
+                st.alive, (nshard * n_local,), (n_local,))
+            fin = vr.has_finalized(st.records.confidence, cfg)
+            pollable = (st.added & alive_local[:, None]
+                        & st.valid[None, :] & jnp.logical_not(fin))
+            return lax.psum(pollable.any().astype(jnp.int32),
+                            (NODES_AXIS, TXS_AXIS)) > 0
+
+        def cond(carry):
+            st, live = carry
+            return live & (st.round < max_rounds)
+
+        def body(carry):
+            st, _ = carry
+            new_st, _ = _local_round(st, cfg, n_global, n_tx)
+            return new_st, unsettled(new_st)
+
+        final, _ = lax.while_loop(cond, body, (s, unsettled(s)))
+        return final
+
+    specs = state_specs()
+    fn = jax.shard_map(local_run, mesh=mesh, in_specs=(specs,),
+                       out_specs=specs, check_vma=False)
+    return jax.jit(fn)(state)

@@ -2,13 +2,22 @@
 
 Tests run on the CPU backend with 8 virtual XLA devices so the multi-chip
 sharding path (`parallel/`) is exercised without TPU hardware (SURVEY.md
-section 4 test plan, item d).  Must run before the first `import jax`.
+section 4 test plan, item d).
+
+NOTE: this environment's axon plugin force-sets
+``jax.config.update("jax_platforms", "axon,cpu")`` at interpreter start (via
+sitecustomize), overriding the ``JAX_PLATFORMS`` env var — so the config must
+be re-overridden *after* importing jax, and ``XLA_FLAGS`` must be set before
+the CPU backend initializes.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

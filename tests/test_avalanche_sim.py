@@ -1,0 +1,143 @@
+"""Multi-target Avalanche network simulator tests.
+
+Batched equivalent of the reference example workload
+(`examples/basic-preconcensus/main.go`: 100 nodes × 100 txs, all-honest,
+all finalize) plus the capability-gap features: gossip admission, poll cap,
+invalidation, adversaries (SURVEY.md sections 2.4, 4 item c).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from go_avalanche_tpu.config import AvalancheConfig
+from go_avalanche_tpu.models import avalanche as av
+from go_avalanche_tpu.ops import voterecord as vr
+
+
+def test_reference_example_workload_converges():
+    # 100 nodes x 100 txs, every node pre-fed every tx (`main.go:49-53`),
+    # honest votes: every node finalizes every tx as accepted.
+    cfg = AvalancheConfig()
+    state = av.init(jax.random.key(0), 100, 100, cfg)
+    final = av.run(state, cfg, max_rounds=200)
+    fin = vr.has_finalized(final.records.confidence)
+    assert bool(fin.all())
+    assert bool(vr.is_accepted(final.records.confidence).all())
+    # k=8 votes per round per tx: ~ceil(134/8)=17 rounds plus jitter.
+    assert 17 <= int(final.round) <= 60
+
+
+def test_rejected_prior_finalizes_invalid():
+    # Targets whose prior is rejection finalize as rejected (INVALID status),
+    # mirroring the finalized-rejection path (`avalanche_test.go:196-246`).
+    cfg = AvalancheConfig()
+    state = av.init(jax.random.key(0), 32, 4, cfg,
+                    init_pref=jnp.zeros((4,), jnp.bool_))
+    final = av.run(state, cfg, max_rounds=200)
+    assert bool(vr.has_finalized(final.records.confidence).all())
+    assert not bool(vr.is_accepted(final.records.confidence).any())
+    assert set(np.asarray(
+        vr.status(final.records.confidence)).ravel()) == {0}  # INVALID
+
+
+def test_gossip_spreads_targets():
+    # Seed only node 0 with the targets; gossip-on-poll (`main.go:177`) must
+    # propagate them to (virtually) the whole network and finalize.
+    cfg = AvalancheConfig()
+    n, t = 48, 6
+    added = jnp.zeros((n, t), jnp.bool_).at[0, :].set(True)
+    state = av.init(jax.random.key(1), n, t, cfg, added=added)
+    assert int(np.asarray(state.added).sum()) == t
+    final = av.run(state, cfg, max_rounds=400)
+    added_frac = np.asarray(final.added).mean()
+    assert added_frac > 0.95, f"gossip only reached {added_frac:.0%}"
+    fin = np.asarray(vr.has_finalized(final.records.confidence))
+    assert fin[np.asarray(final.added)].all()
+
+
+def test_gossip_disabled_stays_seeded():
+    cfg = AvalancheConfig(gossip=False)
+    n, t = 16, 3
+    added = jnp.zeros((n, t), jnp.bool_).at[0, :].set(True)
+    state = av.init(jax.random.key(1), n, t, cfg, added=added)
+    final = av.run(state, cfg, max_rounds=100)
+    assert int(np.asarray(final.added).sum()) == t  # nothing spread
+
+
+def test_poll_cap_limits_polls_and_prioritizes_score():
+    cfg = AvalancheConfig(max_element_poll=4)
+    n, t = 16, 12
+    scores = jnp.arange(t, dtype=jnp.int32)  # target t-1 has highest score
+    state = av.init(jax.random.key(2), n, t, cfg, scores=scores)
+    _, tel = av.round_step(state, cfg)
+    assert int(tel.polls) == n * 4  # capped at 4 per node
+    # Drive to completion: high-score targets must finalize no later than
+    # low-score ones (they are always polled first).
+    final = av.run(state, cfg, max_rounds=400)
+    fat = np.asarray(final.finalized_at)
+    assert (fat >= 0).all()
+    mean_by_target = fat.mean(axis=0)
+    assert mean_by_target[-4:].mean() <= mean_by_target[:4].mean()
+
+
+def test_invalid_targets_never_polled_or_finalized():
+    cfg = AvalancheConfig()
+    n, t = 16, 5
+    valid = jnp.array([True, True, False, True, True])
+    state = av.init(jax.random.key(3), n, t, cfg, valid=valid)
+    final = av.run(state, cfg, max_rounds=200)
+    fin = np.asarray(vr.has_finalized(final.records.confidence))
+    assert fin[:, [0, 1, 3, 4]].all()
+    assert not fin[:, 2].any()  # invalid target untouched
+    conf = np.asarray(vr.get_confidence(final.records.confidence))
+    assert (conf[:, 2] == 0).all()
+
+
+def test_byzantine_fraction_slows_but_converges():
+    cfg_honest = AvalancheConfig()
+    cfg_byz = AvalancheConfig(byzantine_fraction=0.2)
+    s0 = av.init(jax.random.key(4), 64, 8, cfg_honest)
+    s1 = av.init(jax.random.key(4), 64, 8, cfg_byz)
+    honest_final = av.run(s0, cfg_honest, max_rounds=400)
+    byz_final = av.run(s1, cfg_byz, max_rounds=1000)
+    honest_nodes = ~np.asarray(byz_final.byzantine)
+    fin = np.asarray(vr.has_finalized(byz_final.records.confidence))
+    assert fin[honest_nodes].mean() > 0.95
+    assert int(byz_final.round) >= int(honest_final.round)
+
+
+def test_telemetry_votes_accounting():
+    cfg = AvalancheConfig()
+    n, t = 32, 4
+    state = av.init(jax.random.key(5), n, t, cfg)
+    _, tel = av.round_step(state, cfg)
+    # All-honest, no drops: every polled pair ingests exactly k votes.
+    assert int(tel.polls) == n * t
+    assert int(tel.votes_applied) == n * t * cfg.k
+    assert int(tel.admissions) == 0  # everyone already has everything
+
+
+def test_determinism():
+    cfg = AvalancheConfig(byzantine_fraction=0.1, drop_probability=0.1)
+    a = av.run(av.init(jax.random.key(9), 32, 6, cfg), cfg, max_rounds=400)
+    b = av.run(av.init(jax.random.key(9), 32, 6, cfg), cfg, max_rounds=400)
+    np.testing.assert_array_equal(np.asarray(a.records.confidence),
+                                  np.asarray(b.records.confidence))
+    np.testing.assert_array_equal(np.asarray(a.finalized_at),
+                                  np.asarray(b.finalized_at))
+    assert int(a.round) == int(b.round)
+
+
+def test_scan_and_while_loop_agree_on_settled_state():
+    cfg = AvalancheConfig()
+    s = av.init(jax.random.key(6), 24, 3, cfg)
+    final_while = av.run(s, cfg, max_rounds=100)
+    final_scan, tel = av.run_scan(s, cfg, n_rounds=100)
+    # Same PRNG stream per round => identical records once both settled.
+    np.testing.assert_array_equal(
+        np.asarray(vr.is_accepted(final_while.records.confidence)),
+        np.asarray(vr.is_accepted(final_scan.records.confidence)))
+    assert bool(av.all_settled(final_scan, cfg))
+    # Telemetry: total finalizations = every (node, tx) pair once.
+    assert int(np.asarray(tel.finalizations).sum()) == 24 * 3

@@ -1,0 +1,93 @@
+"""Peer-sampling tests: uniformity, self-exclusion, weighting, shard offsets."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_avalanche_tpu.config import AvalancheConfig
+from go_avalanche_tpu.models import avalanche as av
+from go_avalanche_tpu.ops import voterecord as vr
+from go_avalanche_tpu.ops.sampling import (
+    sample_peers_uniform,
+    sample_peers_weighted,
+    self_sample_mask,
+)
+
+
+def test_uniform_excludes_self_and_covers_range():
+    peers = sample_peers_uniform(jax.random.key(0), 64, 8)
+    p = np.asarray(peers)
+    assert p.shape == (64, 8)
+    assert (p >= 0).all() and (p < 64).all()
+    assert not (p == np.arange(64)[:, None]).any()  # never self
+    assert len(np.unique(p)) > 32  # actually spread out
+
+
+def test_uniform_is_unbiased_modulo_self():
+    # Each node's draws are uniform over the OTHER nodes: global histogram
+    # over many draws is flat.
+    n, k = 16, 8
+    counts = np.zeros(n)
+    for seed in range(64):
+        p = np.asarray(sample_peers_uniform(jax.random.key(seed), n, k))
+        counts += np.bincount(p.ravel(), minlength=n)
+    freq = counts / counts.sum()
+    assert abs(freq.max() - freq.min()) < 0.02
+
+
+def test_uniform_sharded_offset_matches_global_ids():
+    # A shard owning rows [32, 48) of a 64-node network never draws its own
+    # global ids on the diagonal.
+    peers = sample_peers_uniform(jax.random.key(1), 64, 8,
+                                 n_local=16, id_offset=32)
+    p = np.asarray(peers)
+    assert p.shape == (16, 8)
+    assert not (p == (np.arange(16) + 32)[:, None]).any()
+    assert (p >= 0).all() and (p < 64).all()
+
+
+def test_weighted_sampling_respects_weights():
+    n = 32
+    weights = jnp.ones((n,)).at[0].set(100.0)
+    p = np.asarray(sample_peers_weighted(jax.random.key(0), weights, 4096, 8))
+    freq0 = (p == 0).mean()
+    # node 0 carries 100/131 of the mass.
+    assert 0.6 < freq0 < 0.9
+
+
+def test_weighted_sampling_never_draws_zero_weight():
+    n = 16
+    weights = jnp.ones((n,)).at[3].set(0.0).at[7].set(0.0)
+    p = np.asarray(sample_peers_weighted(jax.random.key(2), weights, 1024, 8))
+    assert not np.isin(p, [3, 7]).any()
+
+
+def test_self_sample_mask_with_offset():
+    # Rows hold global ids 5 and 6.
+    peers = jnp.array([[5, 6], [6, 9]], jnp.int32)
+    mask = np.asarray(self_sample_mask(peers, id_offset=5))
+    np.testing.assert_array_equal(mask, [[True, False], [True, False]])
+
+
+def test_weighted_network_converges():
+    # End-to-end: latency-weighted avalanche sim still finalizes everything.
+    cfg = AvalancheConfig(weighted_sampling=True)
+    n, t = 48, 6
+    weights = jnp.linspace(0.5, 2.0, n)
+    state = av.init(jax.random.key(0), n, t, cfg, latency_weights=weights)
+    final = av.run(state, cfg, max_rounds=200)
+    assert bool(vr.has_finalized(final.records.confidence).all())
+
+
+def test_weighted_network_sharded_converges():
+    from go_avalanche_tpu.parallel import sharded
+    from go_avalanche_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh(n_node_shards=4, n_tx_shards=2)
+    cfg = AvalancheConfig(weighted_sampling=True)
+    n, t = 32, 8
+    weights = jnp.linspace(0.5, 2.0, n)
+    state = sharded.shard_state(
+        av.init(jax.random.key(0), n, t, cfg, latency_weights=weights), mesh)
+    final = sharded.run_sharded(mesh, state, cfg, max_rounds=100)
+    assert bool(vr.has_finalized(final.records.confidence).all())

@@ -1,0 +1,120 @@
+"""Single-decree Snowball network convergence (SURVEY.md section 4, item c).
+
+The batched equivalent of the example's integration workload: an honest
+network must finalize every node, on one agreed value, in about
+warm-up + finalization_score conclusive votes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_avalanche_tpu.config import AvalancheConfig, VoteMode
+from go_avalanche_tpu.models import snowball
+from go_avalanche_tpu.ops import voterecord as vr
+
+
+def run_network(cfg, n_nodes=64, yes_fraction=1.0, max_rounds=400, seed=0):
+    state = snowball.init(jax.random.key(seed), n_nodes, cfg, yes_fraction)
+    return snowball.run(state, cfg, max_rounds)
+
+
+def test_unanimous_honest_network_finalizes_yes():
+    cfg = AvalancheConfig()
+    final = run_network(cfg, yes_fraction=1.0)
+    fin = vr.has_finalized(final.records.confidence)
+    assert bool(fin.all())
+    assert bool(vr.is_accepted(final.records.confidence).all())
+    # Sequential mode pushes k=8 votes/round: ~134 conclusive votes needed,
+    # so finalization lands near ceil(134/8) = 17 rounds.
+    rounds = int(final.round)
+    assert 17 <= rounds <= 40, rounds
+
+
+def test_split_network_reaches_agreement():
+    # The point of Snowball: a 50/50 split must still converge to ONE value.
+    cfg = AvalancheConfig()
+    final = run_network(cfg, n_nodes=128, yes_fraction=0.5, max_rounds=600)
+    fin = vr.has_finalized(final.records.confidence)
+    assert bool(fin.all()), "split network failed to finalize"
+    prefs = np.asarray(vr.is_accepted(final.records.confidence))
+    assert prefs.all() or (~prefs).all(), "network finalized on mixed values"
+
+
+def test_majority_mode_converges():
+    cfg = AvalancheConfig(vote_mode=VoteMode.MAJORITY)
+    final = run_network(cfg, n_nodes=64, yes_fraction=1.0, max_rounds=400)
+    assert bool(vr.has_finalized(final.records.confidence).all())
+    # One chit per round: needs ~134 conclusive rounds.
+    assert 130 <= int(final.round) <= 250
+
+
+def test_finalized_at_is_recorded():
+    cfg = AvalancheConfig()
+    final = run_network(cfg, yes_fraction=1.0)
+    fat = np.asarray(final.finalized_at)
+    assert (fat >= 0).all()
+    assert (fat < int(final.round)).all()
+
+
+def test_neutral_drops_slow_convergence():
+    cfg_fast = AvalancheConfig()
+    cfg_slow = AvalancheConfig(drop_probability=0.3)
+    fast = run_network(cfg_fast, yes_fraction=1.0)
+    slow = run_network(cfg_slow, yes_fraction=1.0, max_rounds=800)
+    assert bool(vr.has_finalized(slow.records.confidence).all())
+    assert int(slow.round) > int(fast.round)
+
+
+def test_byzantine_minority_does_not_stop_finalization():
+    # 10% always-flipping voters: 7-of-8 quorum still reachable, honest
+    # majority finalizes.
+    cfg = AvalancheConfig(byzantine_fraction=0.10)
+    final = run_network(cfg, n_nodes=128, yes_fraction=1.0, max_rounds=800)
+    honest = ~np.asarray(final.byzantine)
+    fin = np.asarray(vr.has_finalized(final.records.confidence))
+    assert fin[honest].mean() > 0.95
+
+
+def test_churn_runs_and_live_nodes_finalize():
+    cfg = AvalancheConfig(churn_probability=0.001)
+    state = snowball.init(jax.random.key(3), 64, cfg, 1.0)
+    final = snowball.run(state, cfg, max_rounds=400)
+    fin = np.asarray(vr.has_finalized(final.records.confidence))
+    alive = np.asarray(final.alive)
+    assert fin[alive].mean() > 0.9
+
+
+def test_determinism_same_key_same_outcome():
+    # Fixed PRNG keys => bit-identical runs (the framework's replacement for
+    # race detection, SURVEY.md section 5).
+    cfg = AvalancheConfig()
+    a = run_network(cfg, n_nodes=32, yes_fraction=0.5, seed=7)
+    b = run_network(cfg, n_nodes=32, yes_fraction=0.5, seed=7)
+    assert int(a.round) == int(b.round)
+    np.testing.assert_array_equal(np.asarray(a.records.confidence),
+                                  np.asarray(b.records.confidence))
+    np.testing.assert_array_equal(np.asarray(a.finalized_at),
+                                  np.asarray(b.finalized_at))
+
+
+def test_scan_telemetry_counts():
+    cfg = AvalancheConfig()
+    state = snowball.init(jax.random.key(0), 64, cfg, 1.0)
+    final, tel = snowball.run_scan(state, cfg, n_rounds=40)
+    fins = np.asarray(tel.finalizations)
+    assert fins.sum() == 64  # every node finalizes exactly once
+    assert bool(vr.has_finalized(final.records.confidence).all())
+    # yes_preferences telemetry is the full population once converged.
+    assert int(np.asarray(tel.yes_preferences)[-1]) == 64
+
+
+def test_round_step_is_jittable_and_shapes_stable():
+    cfg = AvalancheConfig()
+    state = snowball.init(jax.random.key(0), 16, cfg, 1.0)
+    step = jax.jit(lambda s: snowball.round_step(s, cfg))
+    s1, t1 = step(state)
+    s2, _ = step(s1)
+    assert s2.records.votes.shape == (16,)
+    assert int(s2.round) == 2
