@@ -1,0 +1,232 @@
+"""Conflict-set Avalanche: double-spend resolution over [nodes, txs].
+
+The reference has no conflict DAG — its records are independent single
+targets — but Avalanche-the-protocol (the paper linked from the reference
+README, `README.md:15`) and BASELINE config 3 ("Avalanche DAG: 10k nodes,
+10k-tx UTXO conflict graph") demand one (SURVEY.md section 2.4 item 4).
+
+Model: transactions partition into **conflict sets** (the UTXO double-spend
+model: txs spending the same output conflict; `conflict_set[t]` gives tx t's
+set id).  Per node and per set, the *preferred* tx is the one with the
+highest confidence counter (ties -> accepted bit, then lowest tx index — the
+deterministic stand-in for first-seen).  A node answers a poll about tx t
+with yes iff t is preferred in its set, so the per-tx sliding-window records
+(`ops/voterecord`) accumulate chits only for set winners; losers bleed
+confidence and flip to rejected.  A set settles for a node once any of its
+txs finalizes accepted — remaining rivals stop being polled (the same
+mask-freeze that models the reference's delete-on-finalize,
+`processor.go:114-116`).
+
+Everything is segment_max/min over the txs axis — no [T, T] conflict matrix
+— so the state stays SoA and the step stays one fused pass; the txs axis
+remains collective-free, which keeps this compatible with the
+`parallel/sharded` mesh layout when conflict sets do not straddle tx shards.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
+from go_avalanche_tpu.models import avalanche as av
+from go_avalanche_tpu.ops import voterecord as vr
+from go_avalanche_tpu.ops.sampling import sample_peers_uniform
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class DagSimState:
+    """Avalanche sim state plus the conflict partition.
+
+    `n_sets` is static pytree aux data (segment ops need a concrete segment
+    count under jit/scan), not a traced leaf.
+    """
+
+    base: av.AvalancheSimState
+    conflict_set: jax.Array   # int32 [T] — set id per tx
+    n_sets: int               # static
+
+    def tree_flatten(self):
+        return (self.base, self.conflict_set), self.n_sets
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux)
+
+
+def init(
+    key: jax.Array,
+    n_nodes: int,
+    conflict_set: jax.Array,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    init_pref: Optional[jax.Array] = None,
+    scores: Optional[jax.Array] = None,
+) -> DagSimState:
+    """Fresh conflicted network.
+
+    `conflict_set` is an int32 [T] partition.  `init_pref` defaults to
+    "every node initially prefers the lowest-index tx of each set" (the
+    deterministic first-seen stand-in); pass a bool [T] to model nodes
+    seeing double-spends in a different global order.
+    """
+    conflict_set = jnp.asarray(conflict_set, jnp.int32)
+    n_txs = conflict_set.shape[0]
+    n_sets = int(jax.device_get(conflict_set.max())) + 1
+    if init_pref is None:
+        first_of_set = jnp.zeros((n_sets,), jnp.int32).at[
+            conflict_set[::-1]].set(jnp.arange(n_txs - 1, -1, -1,
+                                               dtype=jnp.int32))
+        init_pref = jnp.zeros((n_txs,), jnp.bool_).at[first_of_set].set(True)
+    base = av.init(key, n_nodes, n_txs, cfg, init_pref=init_pref,
+                   scores=scores)
+    return DagSimState(base=base, conflict_set=conflict_set, n_sets=n_sets)
+
+
+def preferred_in_set(
+    confidence: jax.Array,
+    conflict_set: jax.Array,
+    n_sets: int,
+) -> jax.Array:
+    """Bool [N, T]: is tx t this node's preferred member of its set?
+
+    Preference order within a set: highest confidence counter, then the
+    accepted bit, then lowest tx index.  Two segment passes, no [T,T] blow-up.
+    """
+    conf = vr.get_confidence(confidence).astype(jnp.int32)
+    acc = vr.is_accepted(confidence).astype(jnp.int32)
+    strength = (conf << 1) | acc                       # int32 [N, T]
+
+    best = jax.ops.segment_max(strength.T, conflict_set,
+                               num_segments=n_sets)    # [S, N]
+    is_best = strength == best.T[:, conflict_set]      # broadcast per tx
+    # Tie-break to the lowest tx index among the maxima.
+    t = confidence.shape[-1]
+    idx = jnp.arange(t, dtype=jnp.int32)
+    idx_masked = jnp.where(is_best, idx, t)            # non-best -> sentinel
+    first_best = jax.ops.segment_min(idx_masked.T, conflict_set,
+                                     num_segments=n_sets)  # [S, N]
+    return idx[None, :] == first_best.T[:, conflict_set]
+
+
+def round_step(
+    state: DagSimState,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+) -> Tuple[DagSimState, av.SimTelemetry]:
+    """One conflicted-network round.
+
+    Like `avalanche.round_step` but responses vote conflict-set preference,
+    and finalizing a set freezes its losers.
+    """
+    base = state.base
+    n, t = base.records.votes.shape
+    k_sample, k_byz, k_drop, k_next = jax.random.split(base.key, 4)
+
+    fin = vr.has_finalized(base.records.confidence, cfg)
+    fin_acc = fin & vr.is_accepted(base.records.confidence)
+
+    # A set is settled for a node once any member finalized accepted.
+    set_done = jax.ops.segment_max(fin_acc.astype(jnp.int32).T,
+                                   state.conflict_set,
+                                   num_segments=state.n_sets)  # [S, N]
+    rival_settled = (set_done.T[:, state.conflict_set] > 0) \
+        & jnp.logical_not(fin_acc)
+
+    pollable = (base.added & base.alive[:, None] & base.valid[None, :]
+                & jnp.logical_not(fin) & jnp.logical_not(rival_settled))
+    polled = av.capped_poll_mask(pollable, base.score_rank,
+                                 cfg.max_element_poll)
+
+    peers = sample_peers_uniform(k_sample, n, cfg.k, cfg.exclude_self)
+    flip = (base.byzantine[peers]
+            & jax.random.bernoulli(k_byz, cfg.flip_probability, peers.shape))
+    responded = base.alive[peers]
+    if cfg.drop_probability > 0.0:
+        responded &= ~jax.random.bernoulli(k_drop, cfg.drop_probability,
+                                           peers.shape)
+
+    # Responses: yes iff the tx is the peer's preferred member of its set.
+    prefs = preferred_in_set(base.records.confidence, state.conflict_set,
+                             state.n_sets)
+    yes_pack = jnp.zeros((n, t), jnp.uint8)
+    consider_pack = jnp.zeros((n, t), jnp.uint8)
+    for j in range(cfg.k):
+        vote_j = prefs[peers[:, j]]
+        vote_j = jnp.logical_xor(vote_j, flip[:, j][:, None])
+        yes_pack |= vote_j.astype(jnp.uint8) << jnp.uint8(j)
+        consider_pack |= (responded[:, j].astype(jnp.uint8)
+                          << jnp.uint8(j))[:, None]
+
+    records, changed = vr.register_packed_votes(
+        base.records, yes_pack, consider_pack, cfg.k, cfg, update_mask=polled)
+
+    fin_after = vr.has_finalized(records.confidence, cfg)
+    newly_final = fin_after & jnp.logical_not(fin)
+    finalized_at = jnp.where(newly_final & (base.finalized_at < 0),
+                             base.round, base.finalized_at)
+
+    telemetry = av.SimTelemetry(
+        polls=polled.sum().astype(jnp.int32),
+        votes_applied=(av.popcnt_plane(consider_pack)
+                       * polled).sum().astype(jnp.int32),
+        flips=(changed & jnp.logical_not(newly_final)).sum().astype(jnp.int32),
+        finalizations=newly_final.sum().astype(jnp.int32),
+        admissions=jnp.int32(0),
+    )
+    new_base = av.AvalancheSimState(
+        records=records,
+        added=base.added,
+        valid=base.valid,
+        score_rank=base.score_rank,
+        byzantine=base.byzantine,
+        alive=base.alive,
+        latency_weight=base.latency_weight,
+        finalized_at=finalized_at,
+        round=base.round + 1,
+        key=k_next,
+    )
+    return DagSimState(new_base, state.conflict_set, state.n_sets), telemetry
+
+
+def settled(state: DagSimState,
+            cfg: AvalancheConfig = DEFAULT_CONFIG) -> jax.Array:
+    """True when every (live node, set) resolved: a member finalized accepted
+    for every set on every live node."""
+    fin_acc = (vr.has_finalized(state.base.records.confidence, cfg)
+               & vr.is_accepted(state.base.records.confidence))
+    set_done = jax.ops.segment_max(fin_acc.astype(jnp.int32).T,
+                                   state.conflict_set,
+                                   num_segments=state.n_sets)   # [S, N]
+    return jnp.where(state.base.alive[None, :], set_done > 0, True).all()
+
+
+def run(
+    state: DagSimState,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    max_rounds: int = 2000,
+) -> DagSimState:
+    """Run until every conflict set resolved on every live node."""
+
+    def cond(s: DagSimState) -> jax.Array:
+        return jnp.logical_not(settled(s, cfg)) & (s.base.round < max_rounds)
+
+    def body(s: DagSimState) -> DagSimState:
+        new_s, _ = round_step(s, cfg)
+        return new_s
+
+    return lax.while_loop(cond, body, state)
+
+
+def run_scan(
+    state: DagSimState,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    n_rounds: int = 200,
+) -> Tuple[DagSimState, av.SimTelemetry]:
+    def step(s, _):
+        return round_step(s, cfg)
+
+    return lax.scan(step, state, None, length=n_rounds)

@@ -1,0 +1,77 @@
+"""Checkpoint / resume for simulator states.
+
+The reference has no checkpointing — its entire state is three Go maps
+(`processor.go:16-19`) that die with the process (SURVEY.md section 5).  The
+batched states here are pytrees of dense arrays + a PRNG key + the round
+counter, so a checkpoint is an exact, bit-for-bit resumable snapshot: restore
+and the simulation continues on the identical deterministic trajectory.
+
+Format: a single .npz holding the flattened leaves (typed PRNG keys are
+serialized via `jax.random.key_data`) plus the pytree structure is supplied
+by the caller as a template state — the same pattern orbax's
+`PyTreeCheckpointer.restore(..., item=template)` uses, without pulling a
+directory-format dependency into the hot loop.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+_KEY_PREFIX = "__prngkey__"
+
+
+def _is_key(leaf: Any) -> bool:
+    return isinstance(leaf, jax.Array) and jax.dtypes.issubdtype(
+        leaf.dtype, jax.dtypes.prng_key)
+
+
+def save_checkpoint(path: str, state: Any) -> None:
+    """Save any simulator state pytree to `path` (.npz)."""
+    leaves, _ = jax.tree_util.tree_flatten(state)
+    payload = {}
+    for i, leaf in enumerate(leaves):
+        if _is_key(leaf):
+            payload[f"{_KEY_PREFIX}{i}"] = np.asarray(
+                jax.random.key_data(leaf))
+        else:
+            payload[f"leaf_{i}"] = np.asarray(jax.device_get(leaf))
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **payload)
+    os.replace(tmp, path)  # atomic: no torn checkpoints on interruption
+
+
+def restore_checkpoint(path: str, template: Any) -> Any:
+    """Restore a state saved by `save_checkpoint`.
+
+    `template` is any state with the same pytree structure (e.g. a freshly
+    `init()`-ed one); its structure and static aux data are reused, its array
+    values are replaced.  Shape/dtype mismatches raise ValueError.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    with np.load(path) as data:
+        restored = []
+        for i, leaf in enumerate(leaves):
+            key_name, plain_name = f"{_KEY_PREFIX}{i}", f"leaf_{i}"
+            if _is_key(leaf):
+                if key_name not in data:
+                    raise ValueError(
+                        f"checkpoint leaf {i}: expected a PRNG key")
+                restored.append(jax.random.wrap_key_data(
+                    jax.numpy.asarray(data[key_name])))
+                continue
+            if plain_name not in data:
+                raise ValueError(f"checkpoint missing leaf {i} "
+                                 f"(template/checkpoint structure mismatch)")
+            arr = data[plain_name]
+            want = jax.numpy.asarray(leaf)
+            if arr.shape != want.shape or arr.dtype != want.dtype:
+                raise ValueError(
+                    f"checkpoint leaf {i}: got {arr.dtype}{list(arr.shape)}, "
+                    f"template has {want.dtype}{list(want.shape)}")
+            restored.append(jax.numpy.asarray(arr))
+    return jax.tree_util.tree_unflatten(treedef, restored)

@@ -1,0 +1,82 @@
+"""Observability: finality statistics, status-update extraction, throughput.
+
+The reference's only observability is a logging flag and the `StatusUpdate`
+stream (`avalanche.go:59-62`, example `main.go:143-157`); SURVEY.md section 5
+calls for keeping that stream concept plus the north-star metrics
+(votes/sec, rounds-to-finality histograms).  Everything here consumes the
+on-device telemetry/state and reduces on host — nothing runs in the hot loop.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
+from go_avalanche_tpu.ops import voterecord as vr
+from go_avalanche_tpu.types import Status, StatusUpdate
+
+
+def rounds_to_finality(finalized_at) -> Dict[str, float]:
+    """Summary statistics of the `finalized_at` plane (-1 = never).
+
+    The paper-curve metric (BASELINE.json): min / mean / median / p90 / max
+    rounds until finalization, plus the unfinalized fraction.
+    """
+    fat = np.asarray(jax.device_get(finalized_at)).ravel()
+    done = fat[fat >= 0]
+    out = {"unfinalized_fraction": float((fat < 0).mean())}
+    if done.size:
+        out.update(
+            min=float(done.min()),
+            mean=float(done.mean()),
+            median=float(np.median(done)),
+            p90=float(np.percentile(done, 90)),
+            max=float(done.max()),
+        )
+    return out
+
+
+def finality_curve(finalizations, population: int) -> np.ndarray:
+    """Cumulative finalized fraction per round from stacked telemetry — the
+    rounds-to-finality curve to plot against the Avalanche paper's."""
+    f = np.asarray(jax.device_get(finalizations)).astype(np.float64)
+    return np.cumsum(f) / float(population)
+
+
+def status_plane(confidence, cfg: AvalancheConfig = DEFAULT_CONFIG):
+    """Per-record Status codes (int8 plane), device-side."""
+    return vr.status(confidence, cfg)
+
+
+def extract_status_updates(
+    changed,
+    confidence,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+) -> List[StatusUpdate]:
+    """Host-side StatusUpdate list for one node's row (or any 1-D slice).
+
+    The batched equivalent of the `updates` out-param of RegisterVotes
+    (`processor.go:111`): records whose `changed` flag fired, with their new
+    status.  Target "hash" is the array index.
+    """
+    changed = np.asarray(jax.device_get(changed)).ravel()
+    codes = np.asarray(jax.device_get(status_plane(confidence, cfg))).ravel()
+    return [StatusUpdate(int(i), Status(int(codes[i])))
+            for i in np.nonzero(changed)[0]]
+
+
+def votes_per_second(total_votes: int, seconds: float) -> float:
+    return total_votes / seconds if seconds > 0 else float("inf")
+
+
+def telemetry_summary(telemetry) -> Dict[str, int]:
+    """Sum stacked per-round telemetry into run totals."""
+    return {
+        field: int(np.asarray(jax.device_get(getattr(telemetry, field)))
+                   .sum())
+        for field in telemetry._fields
+    }

@@ -1,0 +1,111 @@
+"""Conflict-set (double-spend) resolution tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from go_avalanche_tpu.config import AvalancheConfig
+from go_avalanche_tpu.models import dag
+from go_avalanche_tpu.ops import voterecord as vr
+
+
+def winners_per_set(state):
+    """[N, S] winning tx index per (node, set); -1 if unresolved."""
+    fin_acc = np.asarray(
+        vr.has_finalized(state.base.records.confidence)
+        & vr.is_accepted(state.base.records.confidence))
+    cs = np.asarray(state.conflict_set)
+    n = fin_acc.shape[0]
+    out = np.full((n, state.n_sets), -1)
+    for t in range(cs.shape[0]):
+        rows = fin_acc[:, t]
+        out[rows, cs[t]] = t
+    return out
+
+
+def test_preferred_in_set_basic():
+    # Two sets: {0,1}, {2}.  Node prefers higher confidence; ties -> accepted
+    # bit, then lowest index.
+    conflict_set = jnp.array([0, 0, 1], jnp.int32)
+    conf = jnp.array([
+        [5 << 1, 3 << 1, 0],          # node 0: tx0 stronger
+        [2 << 1, (7 << 1) | 1, 1],    # node 1: tx1 stronger
+        [0, 0, 0],                    # node 2: tie -> lowest index
+    ], jnp.uint16)
+    pref = np.asarray(dag.preferred_in_set(conf, conflict_set, 2))
+    np.testing.assert_array_equal(pref, [
+        [True, False, True],
+        [False, True, True],
+        [True, False, True],
+    ])
+
+
+def test_double_spend_resolves_to_single_winner():
+    # 4 conflict sets of 2 txs each; all nodes initially prefer the
+    # lower-index tx.  Exactly one tx per set finalizes accepted, everywhere,
+    # and it's the same tx on every node.
+    cfg = AvalancheConfig()
+    conflict_set = jnp.array([0, 0, 1, 1, 2, 2, 3, 3], jnp.int32)
+    state = dag.init(jax.random.key(0), 64, conflict_set, cfg)
+    final = dag.run(state, cfg, max_rounds=400)
+    assert bool(dag.settled(final, cfg))
+    w = winners_per_set(final)
+    assert (w >= 0).all()
+    # Network-wide agreement on every set.
+    assert (w == w[0]).all(), "nodes disagree on double-spend winners"
+    # The losing tx never finalizes accepted anywhere.
+    fin_acc = np.asarray(
+        vr.has_finalized(final.base.records.confidence)
+        & vr.is_accepted(final.base.records.confidence))
+    assert fin_acc.sum(axis=1).max() == 4  # one winner per set per node
+
+
+def test_split_initial_preference_still_agrees():
+    # Half the network initially prefers tx0, half tx1 — the adversarial
+    # double-spend race.  The network must still converge on ONE winner.
+    cfg = AvalancheConfig()
+    conflict_set = jnp.array([0, 0], jnp.int32)
+    n = 128
+    state = dag.init(jax.random.key(1), n, conflict_set, cfg)
+    # Rebuild records: even nodes prefer tx0, odd nodes tx1.
+    node_pref = (jnp.arange(n) % 2).astype(jnp.bool_)
+    accepted = jnp.stack([~node_pref, node_pref], axis=1)
+    state = dag.DagSimState(
+        base=state.base._replace(records=vr.init_state(accepted)),
+        conflict_set=state.conflict_set, n_sets=state.n_sets)
+    final = dag.run(state, cfg, max_rounds=600)
+    assert bool(dag.settled(final, cfg))
+    w = winners_per_set(final)
+    assert (w == w[0]).all(), "double-spend race split the network"
+
+
+def test_singleton_sets_behave_like_plain_avalanche():
+    # With every tx in its own set, preference == accepted-with-max-conf
+    # trivially, and everything finalizes accepted like the base model.
+    cfg = AvalancheConfig()
+    conflict_set = jnp.arange(6, dtype=jnp.int32)
+    state = dag.init(jax.random.key(2), 32, conflict_set, cfg)
+    final = dag.run(state, cfg, max_rounds=200)
+    fin = vr.has_finalized(final.base.records.confidence)
+    assert bool(fin.all())
+    assert bool(vr.is_accepted(final.base.records.confidence).all())
+
+
+def test_losers_stop_being_polled():
+    cfg = AvalancheConfig()
+    conflict_set = jnp.array([0, 0, 0], jnp.int32)  # 3-way conflict
+    state = dag.init(jax.random.key(3), 48, conflict_set, cfg)
+    final = dag.run(state, cfg, max_rounds=400)
+    assert bool(dag.settled(final, cfg))
+    _, tel = dag.round_step(final, cfg)
+    assert int(tel.polls) == 0  # nothing left to poll once settled
+
+
+def test_dag_telemetry_and_determinism():
+    cfg = AvalancheConfig()
+    conflict_set = jnp.array([0, 0, 1, 1], jnp.int32)
+    a = dag.run(dag.init(jax.random.key(4), 32, conflict_set, cfg), cfg, 400)
+    b = dag.run(dag.init(jax.random.key(4), 32, conflict_set, cfg), cfg, 400)
+    np.testing.assert_array_equal(np.asarray(a.base.records.confidence),
+                                  np.asarray(b.base.records.confidence))
+    assert int(a.base.round) == int(b.base.round)

@@ -1,0 +1,44 @@
+"""Observability helpers: finality stats, curves, status-update extraction."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from go_avalanche_tpu.config import AvalancheConfig
+from go_avalanche_tpu.models import snowball
+from go_avalanche_tpu.ops import voterecord as vr
+from go_avalanche_tpu.types import Status
+from go_avalanche_tpu.utils import metrics
+
+
+def test_rounds_to_finality_stats():
+    fat = jnp.array([[-1, 10], [20, 30]], jnp.int32)
+    s = metrics.rounds_to_finality(fat)
+    assert s["unfinalized_fraction"] == 0.25
+    assert s["min"] == 10 and s["max"] == 30 and s["median"] == 20
+
+
+def test_finality_curve_reaches_one():
+    cfg = AvalancheConfig()
+    state = snowball.init(jax.random.key(0), 64, cfg, 1.0)
+    _, tel = snowball.run_scan(state, cfg, n_rounds=40)
+    curve = metrics.finality_curve(tel.finalizations, population=64)
+    assert curve[-1] == 1.0
+    assert (np.diff(curve) >= 0).all()
+
+
+def test_extract_status_updates():
+    # One record just flipped to accepted, one just finalized, one unchanged.
+    conf = jnp.array([0 | 1, (128 << 1) | 1, 5 << 1], jnp.uint16)
+    changed = jnp.array([True, True, False])
+    updates = metrics.extract_status_updates(changed, conf)
+    assert updates == [(0, Status.ACCEPTED), (1, Status.FINALIZED)]
+
+
+def test_telemetry_summary():
+    cfg = AvalancheConfig()
+    state = snowball.init(jax.random.key(0), 32, cfg, 1.0)
+    _, tel = snowball.run_scan(state, cfg, n_rounds=30)
+    summary = metrics.telemetry_summary(tel)
+    assert summary["finalizations"] == 32
+    assert set(summary) == set(tel._fields)
