@@ -49,19 +49,24 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
                           max_element_poll=max(4096, n_txs))
     state = av.init(jax.random.key(0), n_nodes, n_txs, cfg)
 
-    step = jax.jit(lambda s: av.round_step(s, cfg)[0])
+    # The round loop runs ON DEVICE (lax.scan inside one jit): dispatching
+    # rounds one by one from Python pays a fixed per-call latency (~6ms
+    # through the axon tunnel) that would dominate the measurement.
+    @jax.jit
+    def run(s):
+        def body(st, _):
+            new_s, _ = av.round_step(st, cfg)
+            return new_s, None
+        out, _ = jax.lax.scan(body, s, None, length=n_rounds)
+        return out
 
-    # Warm-up: compile + one executed round.
-    state = step(state)
-    _sync(state)
+    # Warm-up: compile + one executed sweep.
+    _sync(run(state))
 
     best_dt = None
     for _ in range(repeats):
-        s = state
         t0 = time.perf_counter()
-        for _ in range(n_rounds):
-            s = step(s)
-        _sync(s)
+        _sync(run(state))
         dt = time.perf_counter() - t0
         best_dt = dt if best_dt is None else min(best_dt, dt)
 
@@ -81,7 +86,7 @@ def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--nodes", type=int, default=8192)
     parser.add_argument("--txs", type=int, default=8192)
-    parser.add_argument("--rounds", type=int, default=20)
+    parser.add_argument("--rounds", type=int, default=50)
     parser.add_argument("--k", type=int, default=8)
     args = parser.parse_args()
     print(json.dumps(bench(args.nodes, args.txs, args.rounds, args.k)))
