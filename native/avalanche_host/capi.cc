@@ -1,0 +1,196 @@
+// C ABI for the native host runtime — consumed by go_avalanche_tpu.native
+// via ctypes (the Python<->C++ binding boundary; no pybind11 in this image).
+//
+// Conventions: every processor function takes the opaque handle returned by
+// avh_processor_new; int returns are 1/0 booleans unless noted; output
+// arrays are caller-allocated with an explicit capacity, and functions
+// return the count written (or the required count if it exceeds capacity —
+// callers can retry with a bigger buffer).
+
+#include <cstdint>
+#include <vector>
+
+#include "processor.h"
+#include "vote_record.h"
+
+using avalanche_host::Processor;
+using avalanche_host::ProtocolConfig;
+using avalanche_host::StatusOut;
+using avalanche_host::VoteIn;
+using avalanche_host::VoteRecord;
+
+namespace {
+
+ProtocolConfig MakeConfig(int window, int quorum, int finalization_score,
+                          int max_element_poll, double time_step_s,
+                          double request_timeout_s, int strict_validation,
+                          int advance_round) {
+  ProtocolConfig cfg;
+  cfg.window = window;
+  cfg.quorum = quorum;
+  cfg.finalization_score = finalization_score;
+  cfg.max_element_poll = max_element_poll;
+  cfg.time_step_s = time_step_s;
+  cfg.request_timeout_s = request_timeout_s;
+  cfg.strict_validation = strict_validation != 0;
+  cfg.advance_round = advance_round != 0;
+  return cfg;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------- VoteRecord
+// Packed scalar state for the standalone kernel API:
+//   bits 0..7   votes window
+//   bits 8..15  consider window
+//   bits 16..31 confidence halfword
+// This keeps the ctypes surface to plain uint32 round-trips.
+
+uint32_t avh_vote_record_new(int accepted) {
+  return accepted ? (1u << 16) : 0u;
+}
+
+// Applies one vote to a packed state.  *changed_out (may be null) receives
+// the reference's bool return (vote.go:54).  Returns the new packed state.
+// Routed through VoteRecord::RegisterVote — one authority for the kernel.
+uint32_t avh_vote_record_step(uint32_t packed, int32_t err, int window,
+                              int quorum, int finalization_score,
+                              int* changed_out) {
+  ProtocolConfig cfg;
+  cfg.window = window;
+  cfg.quorum = quorum;
+  cfg.finalization_score = finalization_score;
+  VoteRecord vr = VoteRecord::FromBits(packed & 0xFFu, (packed >> 8) & 0xFFu,
+                                       (packed >> 16) & 0xFFFFu, cfg);
+  const bool changed = vr.RegisterVote(err);
+  if (changed_out) *changed_out = changed ? 1 : 0;
+  return vr.votes_bits() | (vr.consider_bits() << 8) |
+         (vr.confidence_bits() << 16);
+}
+
+// Replay a whole err stream through one record; writes the per-vote packed
+// state and changed flag.  Returns the final packed state.
+uint32_t avh_vote_record_replay(int accepted, const int32_t* errs, int n,
+                                int window, int quorum, int finalization_score,
+                                uint32_t* states_out, int* changed_out) {
+  uint32_t s = avh_vote_record_new(accepted);
+  for (int i = 0; i < n; ++i) {
+    int changed = 0;
+    s = avh_vote_record_step(s, errs[i], window, quorum, finalization_score,
+                             &changed);
+    if (states_out) states_out[i] = s;
+    if (changed_out) changed_out[i] = changed;
+  }
+  return s;
+}
+
+// ----------------------------------------------------------------- Processor
+
+void* avh_processor_new(int window, int quorum, int finalization_score,
+                        int max_element_poll, double time_step_s,
+                        double request_timeout_s, int strict_validation,
+                        int advance_round, int random_selection,
+                        uint64_t seed) {
+  return new Processor(
+      MakeConfig(window, quorum, finalization_score, max_element_poll,
+                 time_step_s, request_timeout_s, strict_validation,
+                 advance_round),
+      random_selection ? Processor::NodeSelection::kRandom
+                       : Processor::NodeSelection::kLowest,
+      seed);
+}
+
+void avh_processor_free(void* p) { delete static_cast<Processor*>(p); }
+
+void avh_set_stub_time(void* p, double t) {
+  static_cast<Processor*>(p)->SetStubTime(t);
+}
+
+void avh_use_real_clock(void* p) {
+  static_cast<Processor*>(p)->UseRealClock();
+}
+
+void avh_add_node(void* p, int64_t id) {
+  static_cast<Processor*>(p)->AddNode(id);
+}
+
+int avh_node_ids(void* p, int64_t* out, int cap) {
+  auto ids = static_cast<Processor*>(p)->NodeIds();
+  const int n = static_cast<int>(ids.size());
+  for (int i = 0; i < n && i < cap; ++i) out[i] = ids[i];
+  return n;
+}
+
+int avh_add_target(void* p, int64_t hash, int accepted, int valid,
+                   int64_t score) {
+  return static_cast<Processor*>(p)->AddTargetToReconcile(
+             hash, accepted != 0, valid != 0, score)
+             ? 1
+             : 0;
+}
+
+int avh_set_target_valid(void* p, int64_t hash, int valid) {
+  return static_cast<Processor*>(p)->SetTargetValid(hash, valid != 0) ? 1 : 0;
+}
+
+int64_t avh_get_round(void* p) {
+  return static_cast<Processor*>(p)->GetRound();
+}
+
+int avh_is_accepted(void* p, int64_t hash) {
+  return static_cast<Processor*>(p)->IsAccepted(hash) ? 1 : 0;
+}
+
+int avh_get_confidence(void* p, int64_t hash) {
+  return static_cast<Processor*>(p)->GetConfidence(hash);
+}
+
+int avh_outstanding_requests(void* p) {
+  return static_cast<Processor*>(p)->OutstandingRequests();
+}
+
+int avh_get_invs(void* p, int64_t* out, int cap) {
+  auto invs = static_cast<Processor*>(p)->GetInvsForNextPoll();
+  const int n = static_cast<int>(invs.size());
+  for (int i = 0; i < n && i < cap; ++i) out[i] = invs[i];
+  return n;
+}
+
+int64_t avh_suitable_node(void* p) {
+  return static_cast<Processor*>(p)->GetSuitableNodeToQuery();
+}
+
+// Returns 1 if the response was accepted (votes applied), 0 if rejected by
+// strict validation.  *n_updates receives the number of StatusOut entries
+// written to (update_hashes, update_statuses), capped at cap.
+int avh_register_votes(void* p, int64_t node_id, int64_t resp_round,
+                       const int64_t* hashes, const int32_t* errs, int n,
+                       int64_t* update_hashes, int8_t* update_statuses,
+                       int cap, int* n_updates) {
+  std::vector<VoteIn> votes(n);
+  for (int i = 0; i < n; ++i) votes[i] = {hashes[i], errs[i]};
+  std::vector<StatusOut> updates;
+  const bool ok = static_cast<Processor*>(p)->RegisterVotes(
+      node_id, resp_round, votes, &updates);
+  int written = 0;
+  for (const StatusOut& u : updates) {
+    if (written >= cap) break;
+    update_hashes[written] = u.hash;
+    update_statuses[written] = u.status;
+    ++written;
+  }
+  if (n_updates) *n_updates = written;
+  return ok ? 1 : 0;
+}
+
+int avh_event_loop_tick(void* p) {
+  return static_cast<Processor*>(p)->EventLoopTick() ? 1 : 0;
+}
+
+int avh_start(void* p) { return static_cast<Processor*>(p)->Start() ? 1 : 0; }
+
+int avh_stop(void* p) { return static_cast<Processor*>(p)->Stop() ? 1 : 0; }
+
+}  // extern "C"

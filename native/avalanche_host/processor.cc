@@ -1,0 +1,225 @@
+#include "processor.h"
+
+#include <algorithm>
+#include <chrono>
+
+namespace avalanche_host {
+
+double Processor::Now() const {
+  if (use_stub_clock_) return stub_time_;
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+void Processor::SetStubTime(double t) {
+  std::lock_guard<std::mutex> lock(mu_);
+  use_stub_clock_ = true;
+  stub_time_ = t;
+}
+
+void Processor::UseRealClock() {
+  std::lock_guard<std::mutex> lock(mu_);
+  use_stub_clock_ = false;
+}
+
+void Processor::AddNode(int64_t id) {
+  std::lock_guard<std::mutex> lock(mu_);
+  nodes_.insert(id);
+}
+
+std::vector<int64_t> Processor::NodeIds() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return {nodes_.begin(), nodes_.end()};
+}
+
+bool Processor::AddTargetToReconcile(int64_t hash, bool accepted, bool valid,
+                                     int64_t score) {
+  std::lock_guard<std::mutex> lock(mu_);
+  TargetInfo t{hash, score, valid};
+  if (!IsWorthyPolling(t)) return false;            // processor.go:46
+  if (records_.count(hash)) return false;           // idempotent, :50-53
+  targets_[hash] = t;
+  records_.emplace(hash, VoteRecord(accepted, cfg_));  // :55-56
+  return true;
+}
+
+bool Processor::SetTargetValid(int64_t hash, bool valid) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = targets_.find(hash);
+  if (it == targets_.end()) return false;
+  it->second.valid = valid;
+  return true;
+}
+
+int64_t Processor::GetRound() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return round_;
+}
+
+bool Processor::IsAccepted(int64_t hash) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = records_.find(hash);
+  return it != records_.end() && it->second.is_accepted();
+}
+
+int Processor::GetConfidence(int64_t hash) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = records_.find(hash);
+  return it == records_.end() ? -1 : it->second.get_confidence();
+}
+
+int Processor::OutstandingRequests() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return static_cast<int>(queries_.size());
+}
+
+std::vector<int64_t> Processor::PollInvsLocked() const {
+  // processor.go:144-170, with the intended score-descending order
+  // (the disabled sortBlockInvsByWork, processor.go:163) restored; ties
+  // break by ascending hash for determinism.
+  std::vector<int64_t> hashes;
+  hashes.reserve(records_.size());
+  for (const auto& [hash, record] : records_) {
+    if (record.has_finalized()) continue;           // :147-150
+    auto it = targets_.find(hash);
+    if (it == targets_.end() || !IsWorthyPolling(it->second))
+      continue;                                     // :155-157
+    hashes.push_back(hash);
+  }
+  std::sort(hashes.begin(), hashes.end(), [this](int64_t a, int64_t b) {
+    const int64_t sa = targets_.at(a).score, sb = targets_.at(b).score;
+    if (sa != sb) return sa > sb;
+    return a < b;
+  });
+  if (hashes.size() > static_cast<size_t>(cfg_.max_element_poll))
+    hashes.resize(cfg_.max_element_poll);           // :165-167
+  return hashes;
+}
+
+std::vector<int64_t> Processor::GetInvsForNextPoll() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return PollInvsLocked();
+}
+
+std::vector<int64_t> Processor::AvailableNodesLocked() const {
+  std::vector<int64_t> out{nodes_.begin(), nodes_.end()};  // sorted (std::set)
+  if (!cfg_.strict_validation) return out;
+  // Availability timer: peers with an outstanding unexpired request are not
+  // re-queried (the TODO at avalanche_test.go:453-454).
+  const double now = Now();
+  std::set<int64_t> busy;
+  for (const auto& [key, record] : queries_) {
+    if (now - record.timestamp <= cfg_.request_timeout_s)
+      busy.insert(key.second);
+  }
+  std::vector<int64_t> avail;
+  for (int64_t id : out)
+    if (!busy.count(id)) avail.push_back(id);
+  return avail;
+}
+
+int64_t Processor::SelectNodeLocked() {
+  auto avail = AvailableNodesLocked();
+  if (avail.empty()) return kNoNode;                // processor.go:177-179
+  if (selection_ == NodeSelection::kRandom) {
+    std::uniform_int_distribution<size_t> d(0, avail.size() - 1);
+    return avail[d(rng_)];
+  }
+  return avail[0];                                  // placeholder parity, :181
+}
+
+int64_t Processor::GetSuitableNodeToQuery() {
+  std::lock_guard<std::mutex> lock(mu_);
+  return SelectNodeLocked();
+}
+
+bool Processor::RegisterVotes(int64_t node_id, int64_t resp_round,
+                              const std::vector<VoteIn>& votes,
+                              std::vector<StatusOut>* updates) {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (!cfg_.strict_validation) {
+    // Sim mode: opportunistically consume a matching pending query so the
+    // queries map stays bounded (the reference leaks these).
+    queries_.erase({resp_round, node_id});
+  } else {
+    // The validation contract the reference compiled out (processor.go:62-90).
+    auto it = queries_.find({resp_round, node_id});
+    if (it == queries_.end()) return false;         // unsolicited
+    RequestRecordNative record = std::move(it->second);
+    queries_.erase(it);                             // always consume the key
+    if (Now() - record.timestamp > cfg_.request_timeout_s) return false;
+    if (votes.size() != record.invs.size()) return false;
+    for (size_t i = 0; i < votes.size(); ++i)
+      if (votes[i].hash != record.invs[i]) return false;  // 1:1, in order
+  }
+
+  for (const VoteIn& v : votes) {                   // processor.go:94-117
+    auto rit = records_.find(v.hash);
+    if (rit == records_.end()) continue;            // not voting on this
+    auto tit = targets_.find(v.hash);
+    if (tit == targets_.end() || !IsWorthyPolling(tit->second)) continue;
+    if (!rit->second.RegisterVote(v.err)) continue; // no new information
+    if (updates)
+      updates->push_back(
+          {v.hash, static_cast<int8_t>(rit->second.status())});
+    if (rit->second.has_finalized()) records_.erase(rit);  // :114-116
+  }
+  responders_.insert(node_id);  // p.nodeIDs bookkeeping, not membership
+  return true;
+}
+
+void Processor::ReapExpiredLocked() {
+  const double now = Now();
+  for (auto it = queries_.begin(); it != queries_.end();) {
+    if (now - it->second.timestamp > cfg_.request_timeout_s)
+      it = queries_.erase(it);
+    else
+      ++it;
+  }
+}
+
+bool Processor::EventLoopTick() {
+  std::lock_guard<std::mutex> lock(mu_);
+  ReapExpiredLocked();
+  auto invs = PollInvsLocked();                     // processor.go:236
+  if (invs.empty()) return false;
+  const int64_t node = SelectNodeLocked();          // :241
+  if (node == kNoNode) return false;
+  queries_[{round_, node}] = {Now(), std::move(invs)};  // :242
+  if (cfg_.advance_round) ++round_;  // the reference never advances p.round
+  return true;
+}
+
+bool Processor::Start() {
+  std::lock_guard<std::mutex> lock(run_mu_);        // processor.go:190-216
+  if (running_) return false;
+  running_ = true;
+  stop_flag_ = false;
+  ticker_ = std::thread([this] {
+    std::unique_lock<std::mutex> lk(stop_mu_);
+    while (!stop_cv_.wait_for(
+        lk, std::chrono::duration<double>(cfg_.time_step_s),
+        [this] { return stop_flag_; })) {
+      lk.unlock();
+      EventLoopTick();
+      lk.lock();
+    }
+  });
+  return true;
+}
+
+bool Processor::Stop() {
+  std::lock_guard<std::mutex> lock(run_mu_);        // processor.go:219-232
+  if (!running_) return false;
+  {
+    std::lock_guard<std::mutex> lk(stop_mu_);
+    stop_flag_ = true;
+  }
+  stop_cv_.notify_all();
+  if (ticker_.joinable()) ticker_.join();
+  running_ = false;
+  return true;
+}
+
+}  // namespace avalanche_host

@@ -1,0 +1,133 @@
+// Native host Processor — the per-node poll/response engine (layer L2).
+//
+// C++ twin of go_avalanche_tpu/processor.py with the same reference parity
+// (processor.go:11-248) and the same deliberate fixes (SURVEY.md §2.3):
+// explicit strict-validation mode, deterministic score-descending polls,
+// a round counter that actually advances, and an availability timer on peer
+// selection in strict mode.  Internally locked; the ticker runs on a
+// std::thread (replacing the reference's goroutine, processor.go:202-213).
+
+#ifndef AVALANCHE_HOST_PROCESSOR_H_
+#define AVALANCHE_HOST_PROCESSOR_H_
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <random>
+#include <set>
+#include <thread>
+#include <unordered_map>
+#include <utility>
+#include <vector>
+
+#include "vote_record.h"
+
+namespace avalanche_host {
+
+constexpr int64_t kNoNode = -1;  // avalanche.go:28
+
+struct TargetInfo {
+  int64_t hash = 0;
+  int64_t score = 0;   // Target.Score() ordering weight (avalanche.go:86)
+  bool valid = true;   // Target.IsValid (avalanche.go:90)
+};
+
+struct VoteIn {
+  int64_t hash = 0;
+  int32_t err = 0;
+};
+
+struct StatusOut {
+  int64_t hash = 0;
+  int8_t status = 0;
+};
+
+struct RequestRecordNative {
+  double timestamp = 0;
+  std::vector<int64_t> invs;  // target hashes, poll order
+};
+
+class Processor {
+ public:
+  enum class NodeSelection { kLowest, kRandom };
+
+  Processor(const ProtocolConfig& cfg, NodeSelection sel, uint64_t seed)
+      : cfg_(cfg), selection_(sel), rng_(seed) {}
+  ~Processor() { Stop(); }
+
+  // --- clock (stubbed for tests, avalanche.go:93-108) -----------------------
+  void SetStubTime(double t);
+  void UseRealClock();
+
+  // --- membership (net.go:11-31) --------------------------------------------
+  void AddNode(int64_t id);
+  std::vector<int64_t> NodeIds() const;
+
+  // --- admission (processor.go:45-58) ---------------------------------------
+  bool AddTargetToReconcile(int64_t hash, bool accepted, bool valid,
+                            int64_t score);
+  bool SetTargetValid(int64_t hash, bool valid);
+
+  // --- state queries (processor.go:125-142) ---------------------------------
+  int64_t GetRound() const;
+  // is_accepted: unknown targets report false (reference behavior).
+  bool IsAccepted(int64_t hash) const;
+  // Returns -1 for unknown targets (the reference panics).
+  int GetConfidence(int64_t hash) const;
+  int OutstandingRequests() const;
+
+  // --- polls (processor.go:144-182) -----------------------------------------
+  std::vector<int64_t> GetInvsForNextPoll() const;
+  int64_t GetSuitableNodeToQuery();
+
+  // --- ingest (processor.go:61-122) -----------------------------------------
+  bool RegisterVotes(int64_t node_id, int64_t resp_round,
+                     const std::vector<VoteIn>& votes,
+                     std::vector<StatusOut>* updates);
+
+  // --- event loop (processor.go:190-243) ------------------------------------
+  // One tick: reap expired queries, snapshot the poll, record the pending
+  // query.  Returns true iff a query was recorded.
+  bool EventLoopTick();
+  bool Start();
+  bool Stop();
+
+ private:
+  double Now() const;
+  bool IsWorthyPolling(const TargetInfo& t) const { return t.valid; }
+  std::vector<int64_t> PollInvsLocked() const;
+  std::vector<int64_t> AvailableNodesLocked() const;
+  int64_t SelectNodeLocked();
+  void ReapExpiredLocked();
+
+  ProtocolConfig cfg_;
+  NodeSelection selection_;
+  std::mt19937_64 rng_;
+
+  mutable std::mutex mu_;
+  int64_t round_ = 0;
+  std::unordered_map<int64_t, TargetInfo> targets_;
+  std::unordered_map<int64_t, VoteRecord> records_;
+  std::set<int64_t> nodes_;       // queryable membership (AddNode / Connman)
+  std::set<int64_t> responders_;  // nodes that answered (p.nodeIDs); never
+                                  // used for peer selection, matching the
+                                  // Python twin where Connman is the sole
+                                  // membership source
+  std::map<std::pair<int64_t, int64_t>, RequestRecordNative> queries_;
+
+  bool use_stub_clock_ = false;
+  double stub_time_ = 0;
+
+  std::mutex run_mu_;
+  bool running_ = false;
+  std::thread ticker_;
+  std::condition_variable stop_cv_;
+  std::mutex stop_mu_;
+  bool stop_flag_ = false;
+};
+
+}  // namespace avalanche_host
+
+#endif  // AVALANCHE_HOST_PROCESSOR_H_

@@ -1,0 +1,81 @@
+// Minimal native smoke test (no gtest in this image): replays the headline
+// golden behaviors of the reference's TestVoteRecord (avalanche_test.go:13-92)
+// and a tiny Processor lifecycle.  The full parity suite lives in
+// tests/test_native.py, which property-tests this runtime against the Python
+// scalar oracle through the C ABI.
+
+#include <cstdio>
+#include <cstdlib>
+
+#include "processor.h"
+#include "vote_record.h"
+
+using avalanche_host::Processor;
+using avalanche_host::ProtocolConfig;
+using avalanche_host::VoteRecord;
+
+#define CHECK(cond)                                                    \
+  do {                                                                 \
+    if (!(cond)) {                                                     \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__,     \
+                   #cond);                                             \
+      std::exit(1);                                                    \
+    }                                                                  \
+  } while (0)
+
+int main() {
+  ProtocolConfig cfg;
+
+  // --- vote record: warm-up, flip, finalize.
+  VoteRecord vr(false, cfg);
+  for (int i = 0; i < 6; ++i) {  // 6 warm-up yes votes: inconclusive
+    CHECK(!vr.RegisterVote(0));
+    CHECK(!vr.is_accepted());
+  }
+  CHECK(vr.RegisterVote(0));  // 7th flips to accepted
+  CHECK(vr.is_accepted());
+  CHECK(vr.get_confidence() == 0);
+  CHECK(!vr.RegisterVote(-1));  // one neutral: harmless
+  CHECK(vr.get_confidence() == 1);
+  int finalize_vote = -1;
+  for (int i = 0; i < 400 && !vr.has_finalized(); ++i) {
+    if (vr.RegisterVote(0)) finalize_vote = i;
+  }
+  CHECK(vr.has_finalized());
+  CHECK(vr.get_confidence() == cfg.finalization_score);
+  CHECK(finalize_vote >= 0);
+  CHECK(vr.status() == 3);  // FINALIZED
+
+  // --- processor: admission, ingest, finalize-and-remove.
+  Processor p(cfg, Processor::NodeSelection::kLowest, 0);
+  p.AddNode(7);
+  p.AddNode(3);
+  CHECK(p.AddTargetToReconcile(65, true, true, 100));
+  CHECK(!p.AddTargetToReconcile(65, true, true, 100));  // idempotent
+  CHECK(p.GetSuitableNodeToQuery() == 3);               // lowest
+  CHECK(p.GetInvsForNextPoll().size() == 1);
+
+  std::vector<avalanche_host::StatusOut> updates;
+  for (int i = 0; i < 200 && !p.GetInvsForNextPoll().empty(); ++i) {
+    CHECK(p.RegisterVotes(3, 0, {{65, 0}}, &updates));
+  }
+  CHECK(!updates.empty());
+  CHECK(updates.back().status == 3);          // FINALIZED
+  CHECK(p.GetInvsForNextPoll().empty());      // record removed
+  CHECK(!p.IsAccepted(65));                   // unknown -> false (reference)
+
+  // --- event loop records queries and advances the round.
+  Processor q(cfg, Processor::NodeSelection::kLowest, 0);
+  q.SetStubTime(1000.0);
+  q.AddNode(1);
+  CHECK(q.AddTargetToReconcile(9, true, true, 1));
+  CHECK(q.EventLoopTick());
+  CHECK(q.GetRound() == 1);
+  CHECK(q.OutstandingRequests() == 1);
+  q.SetStubTime(1000.0 + 61.0);  // past the 1-minute request timeout
+  CHECK(q.EventLoopTick());      // reaps the expired query, records anew
+  CHECK(q.OutstandingRequests() == 1);
+
+  std::puts("native host smoke test: OK");
+  return 0;
+}

@@ -1,0 +1,100 @@
+// Scalar Snowball vote-record state machine — native host runtime.
+//
+// Same semantics as the Python scalar oracle (go_avalanche_tpu/utils/golden.py)
+// and the vectorized JAX kernel (go_avalanche_tpu/ops/voterecord.py), which in
+// turn mirror the reference's per-target state machine (vote.go:24-98, see
+// SURVEY.md §2.2):
+//   votes      : 8-bit sliding window of yes bits         (vote.go:55)
+//   consider   : 8-bit sliding window of non-neutral bits (vote.go:56)
+//   confidence : bit 0 = preference, bits 1..15 = counter (vote.go:38-45)
+// The counter saturates at its 15-bit ceiling instead of wrapping (the
+// reference deletes finalized records before overflow could matter,
+// processor.go:114-116; long-lived records must not wrap uint16).
+
+#ifndef AVALANCHE_HOST_VOTE_RECORD_H_
+#define AVALANCHE_HOST_VOTE_RECORD_H_
+
+#include <cstdint>
+
+namespace avalanche_host {
+
+struct ProtocolConfig {
+  int window = 8;                 // vote.go:55 (uint8 window)
+  int quorum = 7;                 // vote.go:58 (> 6 popcount test)
+  int finalization_score = 128;   // avalanche.go:10
+  int max_element_poll = 4096;    // avalanche.go:17
+  double time_step_s = 0.010;     // avalanche.go:13
+  double request_timeout_s = 60;  // avalanche.go:21
+  bool strict_validation = false; // the if-false block, processor.go:62-90
+  bool advance_round = true;      // reference never bumps p.round (SURVEY §2.3)
+};
+
+inline int Popcount8(uint32_t x) { return __builtin_popcount(x & 0xFFu); }
+
+class VoteRecord {
+ public:
+  VoteRecord() = default;
+  VoteRecord(bool accepted, const ProtocolConfig& cfg)
+      : confidence_(accepted ? 1 : 0), cfg_(cfg) {}
+
+  // Rehydrate a record from raw window/confidence bits (the packed C-ABI
+  // form); the single authority for the step semantics stays RegisterVote.
+  static VoteRecord FromBits(uint32_t votes, uint32_t consider,
+                             uint32_t confidence, const ProtocolConfig& cfg) {
+    VoteRecord vr;
+    vr.votes_ = votes & 0xFFu;
+    vr.consider_ = consider & 0xFFu;
+    vr.confidence_ = confidence & 0xFFFFu;
+    vr.cfg_ = cfg;
+    return vr;
+  }
+
+  bool is_accepted() const { return (confidence_ & 1) == 1; }
+  int get_confidence() const { return confidence_ >> 1; }
+  bool has_finalized() const {
+    return get_confidence() >= cfg_.finalization_score;
+  }
+
+  // Status codes matching go_avalanche_tpu.types.Status (avalanche.go:44-56,
+  // mapping vote.go:77-91).
+  int status() const {
+    const bool fin = has_finalized(), acc = is_accepted();
+    if (fin) return acc ? 3 /*FINALIZED*/ : 0 /*INVALID*/;
+    return acc ? 2 /*ACCEPTED*/ : 1 /*REJECTED*/;
+  }
+
+  // Apply one vote; true iff acceptance/finalization state changed
+  // (vote.go:54-75).  err: 0 = yes, positive = no, negative = neutral.
+  bool RegisterVote(int32_t err) {
+    const uint32_t window_mask = (1u << cfg_.window) - 1u;
+    votes_ = ((votes_ << 1) | (err == 0 ? 1u : 0u)) & window_mask;
+    consider_ = ((consider_ << 1) | (err >= 0 ? 1u : 0u)) & window_mask;
+
+    const int threshold = cfg_.quorum - 1;
+    const bool yes = Popcount8(votes_ & consider_) > threshold;
+    const bool no = Popcount8(~votes_ & consider_ & window_mask) > threshold;
+    if (!yes && !no) return false;  // inconclusive (vote.go:61-63)
+
+    if (is_accepted() == yes) {
+      if (get_confidence() < 0x7FFF) confidence_ += 2;
+      // True only at the exact finalization moment (vote.go:68: ==).
+      return get_confidence() == cfg_.finalization_score;
+    }
+    confidence_ = yes ? 1 : 0;  // flip + reset (vote.go:72-74)
+    return true;
+  }
+
+  uint32_t votes_bits() const { return votes_; }
+  uint32_t consider_bits() const { return consider_; }
+  uint32_t confidence_bits() const { return confidence_; }
+
+ private:
+  uint32_t votes_ = 0;
+  uint32_t consider_ = 0;
+  uint32_t confidence_ = 0;
+  ProtocolConfig cfg_;
+};
+
+}  // namespace avalanche_host
+
+#endif  // AVALANCHE_HOST_VOTE_RECORD_H_

@@ -1,0 +1,207 @@
+"""Parity tests: native C++ host runtime vs the Python oracle/Processor.
+
+The native runtime (`native/avalanche_host`, bound in `go_avalanche_tpu.native`)
+must match the Python scalar oracle (`utils/golden.py`) bit-for-bit on the
+vote-record kernel — including the reference's golden sequence
+(`avalanche_test.go:13-92`) — and the Python `Processor` on the control-plane
+contract (`processor.go:11-248`).
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
+from go_avalanche_tpu.types import Response, Status, Vote
+from go_avalanche_tpu.utils.golden import (
+    ScalarVoteRecord,
+    golden_vector_sequence,
+    replay,
+)
+
+native = pytest.importorskip("go_avalanche_tpu.native")
+
+try:
+    native.load_library()
+except native.NativeBuildError as e:  # pragma: no cover - env without g++
+    pytest.skip(f"native runtime unavailable: {e}", allow_module_level=True)
+
+
+# ---------------------------------------------------------------- vote record
+
+
+def test_native_golden_sequence():
+    vr = native.NativeVoteRecord(False)
+    for i, (err, want_acc, want_fin, want_conf) in enumerate(
+            golden_vector_sequence()):
+        vr.register_vote(err)
+        assert vr.is_accepted() == want_acc, f"vote {i}"
+        assert vr.has_finalized() == want_fin, f"vote {i}"
+        assert vr.get_confidence() == want_conf, f"vote {i}"
+
+
+@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("initial_accepted", [False, True])
+def test_native_matches_oracle_random_streams(seed, initial_accepted):
+    rng = random.Random(seed)
+    errs = [rng.choice([0, 0, 0, 1, 1, -1]) for _ in range(600)]
+    assert (native.native_replay(initial_accepted, errs)
+            == replay(initial_accepted, errs))
+
+
+def test_native_changed_flag_matches_oracle():
+    rng = random.Random(42)
+    errs = [rng.choice([0, 1, -1]) for _ in range(400)]
+    py = ScalarVoteRecord.new(True)
+    nat = native.NativeVoteRecord(True)
+    for e in errs:
+        assert nat.register_vote(e) == py.register_vote(e)
+        assert nat.status() == py.status()
+
+
+def test_native_custom_config():
+    cfg = AvalancheConfig(window=4, quorum=3, finalization_score=5)
+    assert (native.native_replay(False, [0] * 40, cfg)
+            == replay(False, [0] * 40, cfg))
+
+
+# ------------------------------------------------------------------ processor
+
+
+def _drive_to_finalization(p, hash_, node=1, max_votes=300):
+    updates = []
+    for _ in range(max_votes):
+        if not p.get_invs_for_next_poll():
+            break
+        p.register_votes(node, Response(0, 0, [Vote(0, hash_)]), updates)
+    return updates
+
+
+def test_native_processor_lifecycle():
+    with native.NativeProcessor() as p:
+        p.add_node(7)
+        p.add_node(3)
+        assert p.get_suitable_node_to_query() == 3  # lowest
+        assert p.add_target_to_reconcile(65, accepted=True, score=100)
+        assert not p.add_target_to_reconcile(65, accepted=True, score=100)
+        assert p.is_accepted(65)
+        assert p.get_confidence(65) == 0
+
+        updates = _drive_to_finalization(p, 65)
+        assert updates[-1] == (65, Status.FINALIZED)
+        assert p.get_invs_for_next_poll() == []  # record removed
+        assert not p.is_accepted(65)             # unknown -> False
+        with pytest.raises(KeyError):
+            p.get_confidence(65)
+
+
+def test_native_matches_python_processor_trace():
+    """Same vote stream through both runtimes -> same update stream."""
+    from go_avalanche_tpu.net import Connman
+    from go_avalanche_tpu.processor import Processor
+    from go_avalanche_tpu.types import Block
+
+    rng = random.Random(7)
+    errs = [rng.choice([0, 0, 1, -1]) for _ in range(500)]
+
+    cm = Connman()
+    cm.add_node(1)
+    py = Processor(cm)
+    py.add_target_to_reconcile(Block(65, 99, True, True))
+    nat = native.NativeProcessor()
+    nat.add_node(1)
+    nat.add_target_to_reconcile(65, accepted=True, valid=True, score=99)
+
+    py_updates, nat_updates = [], []
+    for e in errs:
+        py.register_votes(1, Response(0, 0, [Vote(e, 65)]), py_updates)
+        nat.register_votes(1, Response(0, 0, [Vote(e, 65)]), nat_updates)
+    nat.close()
+    assert nat_updates == py_updates
+
+
+def test_native_score_descending_poll_order_and_cap():
+    cfg = AvalancheConfig(max_element_poll=2)
+    with native.NativeProcessor(cfg) as p:
+        p.add_target_to_reconcile(1, accepted=True, score=10)
+        p.add_target_to_reconcile(2, accepted=True, score=30)
+        p.add_target_to_reconcile(3, accepted=True, score=20)
+        p.add_target_to_reconcile(4, accepted=True, score=30)
+        assert p.get_invs_for_next_poll() == [2, 4]  # score desc, hash asc
+
+
+def test_native_invalidate_stops_polling():
+    with native.NativeProcessor() as p:
+        p.add_target_to_reconcile(9, accepted=True)
+        assert p.get_invs_for_next_poll() == [9]
+        assert p.invalidate(9)
+        assert p.get_invs_for_next_poll() == []
+        updates = []
+        p.register_votes(1, Response(0, 0, [Vote(0, 9)]), updates)
+        assert updates == []  # invalid targets take no votes
+
+
+def test_native_strict_validation_contract():
+    cfg = AvalancheConfig(strict_validation=True)
+    with native.NativeProcessor(cfg) as p:
+        p.set_stub_time(1000.0)
+        p.add_node(1)
+        p.add_target_to_reconcile(65, accepted=True)
+
+        updates = []
+        # Unsolicited response rejected.
+        assert not p.register_votes(1, Response(0, 0, [Vote(0, 65)]), updates)
+
+        # Record a query via the event loop; round advances to 1.
+        assert p.event_loop()
+        assert p.get_round() == 1
+        assert p.outstanding_requests() == 1
+        # Busy peer is unavailable until it answers (availability timer).
+        assert p.get_suitable_node_to_query() == -1
+
+        # Wrong-round response rejected; the recorded (0, 1) request is kept
+        # (the reference only consumes the key it actually matched).
+        assert not p.register_votes(1, Response(5, 0, [Vote(0, 65)]), updates)
+        assert p.outstanding_requests() == 1
+
+        # In-order response for the recorded round accepted; frees the peer.
+        assert p.register_votes(1, Response(0, 0, [Vote(0, 65)]), updates)
+        assert p.outstanding_requests() == 0
+        assert p.get_suitable_node_to_query() == 1
+
+        # Expired request rejected.
+        assert p.event_loop()
+        rnd = p.get_round() - 1
+        p.set_stub_time(1000.0 + 120.0)
+        assert not p.register_votes(1, Response(rnd, 0, [Vote(0, 65)]),
+                                    updates)
+
+
+def test_native_responder_is_not_promoted_to_queryable_peer():
+    """A sim-mode response from an un-added node must not make it queryable —
+    membership comes only from add_node (Connman parity with the Python
+    Processor)."""
+    with native.NativeProcessor() as p:
+        p.add_target_to_reconcile(5, accepted=True)
+        updates = []
+        assert p.register_votes(99, Response(0, 0, [Vote(0, 5)]), updates)
+        assert p.get_suitable_node_to_query() == -1
+        assert p.nodes_ids() == []
+
+
+def test_native_ticker_thread():
+    cfg = AvalancheConfig(time_step_s=0.002)
+    with native.NativeProcessor(cfg) as p:
+        import time
+
+        p.add_node(1)
+        p.add_target_to_reconcile(5, accepted=True)
+        assert p.start()
+        assert not p.start()  # idempotent
+        time.sleep(0.05)
+        assert p.stop()
+        assert not p.stop()
+        assert p.get_round() > 0
+        assert p.outstanding_requests() >= 1
