@@ -1,0 +1,328 @@
+"""Connector server: hosts consensus engines behind the wire boundary.
+
+One server process owns a registry of per-node engines (the native C++
+Processor when buildable, else the Python twin) plus, optionally, the
+batched TPU simulator.  External harnesses — e.g. the C++ example in
+`native/connector/harness_main.cc` — connect and reproduce the reference
+example's drive loop (`examples/basic-preconcensus/main.go`) over TCP:
+
+    CREATE_NODE x N                (the per-node Processors, main.go:73-87)
+    ADD_TARGET                     (feed txs, main.go:49-53)
+    loop: GET_INVS -> QUERY peer -> REGISTER_VOTES     (main.go:110-161)
+
+`QUERY` implements the polled peer's seam (`main.go:168-193`): gossip-on-poll
+admission of unseen targets (`main.go:177`, attributes from the shared target
+registry, the wire stand-in for the example's global tx list) and a vote per
+inv from the peer's own acceptance state (`main.go:179-183`).
+
+Thread model: one thread per connection (ThreadingTCPServer); engines are
+internally locked, the registries by `_mu`.  The sim backend initializes JAX
+lazily so pure control-plane servers stay light.
+"""
+
+from __future__ import annotations
+
+import socketserver
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from go_avalanche_tpu.config import AvalancheConfig
+from go_avalanche_tpu.connector import protocol as proto
+from go_avalanche_tpu.types import Response, Vote
+
+try:
+    from go_avalanche_tpu import native as _native
+    _native.load_library()
+    _HAVE_NATIVE = True
+except Exception:  # pragma: no cover - env without g++
+    _native = None
+    _HAVE_NATIVE = False
+
+
+class _ScalarTarget:
+    """Target adapter for the Python engine (wire targets are scalar)."""
+
+    def __init__(self, hash_: int, accepted: bool, valid: bool,
+                 score: int) -> None:
+        self._hash, self._accepted = hash_, accepted
+        self.valid, self._score = valid, score
+
+    def hash(self) -> int:
+        return self._hash
+
+    def type(self) -> str:
+        return "wire"
+
+    def is_accepted(self) -> bool:
+        return self._accepted
+
+    def is_valid(self) -> bool:
+        return self.valid
+
+    def score(self) -> int:
+        return self._score
+
+
+class _PyEngine:
+    """Python Processor behind the same scalar API as NativeProcessor."""
+
+    def __init__(self, cfg: AvalancheConfig) -> None:
+        from go_avalanche_tpu.net import Connman
+        from go_avalanche_tpu.processor import Processor
+
+        self._targets: Dict[int, _ScalarTarget] = {}
+        self._p = Processor(Connman(), cfg)
+
+    def add_target_to_reconcile(self, h: int, accepted: bool, valid: bool,
+                                score: int) -> bool:
+        t = self._targets.setdefault(h, _ScalarTarget(h, accepted, valid,
+                                                      score))
+        return self._p.add_target_to_reconcile(t)
+
+    def get_invs_for_next_poll(self) -> List[int]:
+        return [inv.target_hash for inv in self._p.get_invs_for_next_poll()]
+
+    def register_votes(self, node_id, resp, updates) -> bool:
+        return self._p.register_votes(node_id, resp, updates)
+
+    def is_accepted(self, h: int) -> bool:
+        t = self._targets.get(h)
+        return self._p.is_accepted(t) if t is not None else False
+
+    def get_confidence(self, h: int) -> int:
+        t = self._targets.get(h)
+        if t is None:
+            raise KeyError(h)
+        return self._p.get_confidence(t)
+
+    def get_round(self) -> int:
+        return self._p.get_round()
+
+    def close(self) -> None:
+        pass
+
+
+class _SimBackend:
+    """Lazy wrapper over the batched TPU simulator (models/avalanche)."""
+
+    def __init__(self) -> None:
+        self._state = None
+        self._cfg: Optional[AvalancheConfig] = None
+        self._totals = [0, 0, 0, 0]  # polls, votes, flips, finalizations
+
+    def init(self, n_nodes: int, n_txs: int, seed: int,
+             cfg: AvalancheConfig) -> None:
+        import jax
+        from go_avalanche_tpu.models import avalanche as av
+
+        self._cfg = cfg
+        self._state = av.init(jax.random.key(seed), n_nodes, n_txs, cfg)
+        self._totals = [0, 0, 0, 0]
+
+    def run(self, n_rounds: int) -> Tuple[int, float, List[int]]:
+        import jax
+        import numpy as np
+        from go_avalanche_tpu.models import avalanche as av
+        from go_avalanche_tpu.ops import voterecord as vr
+
+        if self._state is None or self._cfg is None:
+            raise proto.ProtocolError("SIM_INIT required before SIM_RUN")
+        state, tel = jax.jit(
+            av.run_scan, static_argnames=("cfg", "n_rounds"))(
+                self._state, self._cfg, n_rounds)
+        self._state = state
+        sums = [int(np.asarray(jax.device_get(x)).sum())
+                for x in (tel.polls, tel.votes_applied, tel.flips,
+                          tel.finalizations)]
+        self._totals = [a + b for a, b in zip(self._totals, sums)]
+        fin = np.asarray(jax.device_get(
+            vr.has_finalized(state.records.confidence, self._cfg)))
+        return int(jax.device_get(state.round)), float(fin.mean()), \
+            self._totals
+
+
+class ConnectorServer:
+    """Threaded TCP server exposing the Connector protocol.
+
+    `backend` chooses the engine: "native" (default if buildable), "python".
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 cfg: Optional[AvalancheConfig] = None,
+                 backend: Optional[str] = None) -> None:
+        self._cfg = cfg if cfg is not None else AvalancheConfig()
+        if backend is None:
+            backend = "native" if _HAVE_NATIVE else "python"
+        if backend == "native" and not _HAVE_NATIVE:
+            raise RuntimeError("native backend requested but unavailable")
+        self._backend = backend
+        self._mu = threading.Lock()
+        self._engines: Dict[int, object] = {}
+        self._target_attrs: Dict[int, Tuple[bool, bool, int]] = {}
+        self._sim = _SimBackend()
+        self._shutdown_requested = threading.Event()
+
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:
+                while True:
+                    try:
+                        frame = proto.recv_frame(self.request)
+                    except (proto.ProtocolError, OSError):
+                        return
+                    if frame is None:
+                        return
+                    msg_type, payload = frame
+                    try:
+                        reply = outer._dispatch(msg_type, payload)
+                    except Exception as e:  # engine errors -> ERROR frame
+                        reply = (proto.MsgType.ERROR, proto.pack_error(str(e)))
+                    if reply is not None:
+                        proto.send_frame(self.request, *reply)
+                    if msg_type == proto.MsgType.SHUTDOWN:
+                        outer._shutdown_requested.set()
+                        return
+
+        class _Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = _Server((host, port), _Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- lifecycle
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._server.server_address  # type: ignore[return-value]
+
+    def start(self) -> "ConnectorServer":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join()
+        with self._mu:
+            # Do NOT close() engines here: daemon handler threads may still
+            # be mid-dispatch (shutdown() stops only the accept loop), and
+            # freeing a native engine under a live call is a use-after-free.
+            # Dropping the references instead lets refcounting destroy each
+            # engine once the last in-flight handler releases it.
+            self._engines.clear()
+
+    def wait_for_shutdown_request(self, timeout: Optional[float] = None
+                                  ) -> bool:
+        """Block until a client sent SHUTDOWN (the harness-driven exit)."""
+        return self._shutdown_requested.wait(timeout)
+
+    def __enter__(self) -> "ConnectorServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -------------------------------------------------------------- engines
+    def _new_engine(self):
+        if self._backend == "native":
+            return _native.NativeProcessor(self._cfg)
+        return _PyEngine(self._cfg)
+
+    def _engine(self, node_id: int):
+        with self._mu:
+            engine = self._engines.get(node_id)
+            if engine is None:
+                raise proto.ProtocolError(f"unknown node {node_id}")
+            return engine
+
+    # ------------------------------------------------------------- dispatch
+    def _dispatch(self, msg_type: int,
+                  payload: bytes) -> Optional[Tuple[int, bytes]]:
+        M = proto.MsgType
+        if msg_type == M.PING:
+            return M.PONG, b""
+
+        if msg_type == M.CREATE_NODE:
+            (node_id,) = struct.unpack_from("<q", payload, 0)
+            with self._mu:
+                created = node_id not in self._engines
+                if created:
+                    self._engines[node_id] = self._new_engine()
+            return M.OK, struct.pack("<B", 1 if created else 0)
+
+        if msg_type == M.ADD_TARGET:
+            node_id, h, accepted, valid, score = struct.unpack_from(
+                "<qqBBq", payload, 0)
+            with self._mu:
+                self._target_attrs[h] = (bool(accepted), bool(valid), score)
+            ok = self._engine(node_id).add_target_to_reconcile(
+                h, bool(accepted), bool(valid), score)
+            return M.OK, struct.pack("<B", 1 if ok else 0)
+
+        if msg_type == M.GET_INVS:
+            (node_id,) = struct.unpack_from("<q", payload, 0)
+            invs = self._engine(node_id).get_invs_for_next_poll()
+            return M.INVS, proto.pack_i64s(invs)
+
+        if msg_type == M.QUERY:
+            (node_id,) = struct.unpack_from("<q", payload, 0)
+            hashes, _ = proto.unpack_i64s(payload, 8)
+            engine = self._engine(node_id)
+            votes = []
+            for h in hashes:
+                with self._mu:
+                    accepted, valid, score = self._target_attrs.get(
+                        h, (True, True, 1))
+                engine.add_target_to_reconcile(h, accepted, valid, score)
+                votes.append((h, 0 if engine.is_accepted(h) else 1))
+            return M.VOTES, proto.pack_votes(votes)
+
+        if msg_type == M.REGISTER_VOTES:
+            node_id, from_node, round_ = struct.unpack_from("<qqq", payload, 0)
+            votes, _ = proto.unpack_votes(payload, 24)
+            resp = Response(round_, 0, [Vote(err, h) for h, err in votes])
+            updates: List = []
+            ok = self._engine(node_id).register_votes(from_node, resp, updates)
+            return M.UPDATES, proto.pack_updates(
+                ok, [(u.hash, int(u.status)) for u in updates])
+
+        if msg_type == M.IS_ACCEPTED:
+            node_id, h = struct.unpack_from("<qq", payload, 0)
+            return M.OK, struct.pack(
+                "<B", 1 if self._engine(node_id).is_accepted(h) else 0)
+
+        if msg_type == M.GET_CONFIDENCE:
+            node_id, h = struct.unpack_from("<qq", payload, 0)
+            try:
+                conf = self._engine(node_id).get_confidence(h)
+            except KeyError:
+                conf = -1
+            return M.I64, struct.pack("<q", conf)
+
+        if msg_type == M.GET_ROUND:
+            (node_id,) = struct.unpack_from("<q", payload, 0)
+            return M.I64, struct.pack("<q", self._engine(node_id).get_round())
+
+        if msg_type == M.SIM_INIT:
+            n_nodes, n_txs, seed, k, fin, gossip, byz, drop = \
+                struct.unpack_from("<IIIIIBdd", payload, 0)
+            cfg = AvalancheConfig(
+                k=k, finalization_score=fin, gossip=bool(gossip),
+                byzantine_fraction=byz, drop_probability=drop)
+            self._sim.init(n_nodes, n_txs, seed, cfg)
+            return M.OK, struct.pack("<B", 1)
+
+        if msg_type == M.SIM_RUN:
+            (rounds,) = struct.unpack_from("<I", payload, 0)
+            rnd, fin_frac, totals = self._sim.run(rounds)
+            return M.SIM_STATS, struct.pack("<Id4q", rnd, fin_frac, *totals)
+
+        if msg_type == M.SHUTDOWN:
+            return M.OK, struct.pack("<B", 1)
+
+        raise proto.ProtocolError(f"unknown message type {msg_type}")

@@ -42,14 +42,23 @@ class NativeBuildError(RuntimeError):
 
 
 def ensure_built(force: bool = False) -> str:
-    """Build `libavalanche_host.so` if missing; returns its path."""
-    if force or not os.path.exists(_LIB_PATH):
-        try:
-            subprocess.run(
-                ["make", "-C", _NATIVE_DIR, "all"],
-                check=True, capture_output=True, text=True)
-        except (OSError, subprocess.CalledProcessError) as e:
-            detail = getattr(e, "stderr", "") or str(e)
+    """Build `libavalanche_host.so`; returns its path.
+
+    Always invokes make — its dependency tracking makes this a no-op when
+    the library is newer than the sources, and it means edited C++ sources
+    are never silently served stale to a fresh process.  `force` does a
+    clean rebuild.
+    """
+    try:
+        if force:
+            subprocess.run(["make", "-C", _NATIVE_DIR, "clean"],
+                           check=True, capture_output=True, text=True)
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR, "all"],
+            check=True, capture_output=True, text=True)
+    except (OSError, subprocess.CalledProcessError) as e:
+        detail = getattr(e, "stderr", "") or str(e)
+        if not os.path.exists(_LIB_PATH):
             raise NativeBuildError(
                 f"building native runtime failed: {detail}") from e
     return _LIB_PATH
@@ -201,8 +210,6 @@ class NativeProcessor:
     `invalidate(hash)` replaces mutating a Target's is_valid.
     """
 
-    _UPDATE_CAP = 65536
-
     def __init__(
         self,
         cfg: AvalancheConfig = DEFAULT_CONFIG,
@@ -222,9 +229,16 @@ class NativeProcessor:
 
     def close(self) -> None:
         if self._handle is not None:
-            self._lib.avh_stop(self._handle)
-            self._lib.avh_processor_free(self._handle)
+            self._lib.avh_stop(self._h())
+            self._lib.avh_processor_free(self._h())
             self._handle = None
+
+    def _h(self):
+        """Live handle, or a clean error after close() (never pass NULL —
+        a closed handle must not reach the C ABI)."""
+        if self._handle is None:
+            raise RuntimeError("NativeProcessor is closed")
+        return self._handle
 
     def __del__(self) -> None:  # best-effort; prefer close()
         try:
@@ -240,20 +254,20 @@ class NativeProcessor:
 
     # --- clock ------------------------------------------------------------
     def set_stub_time(self, t: float) -> None:
-        self._lib.avh_set_stub_time(self._handle, t)
+        self._lib.avh_set_stub_time(self._h(), t)
 
     # --- membership -------------------------------------------------------
     def add_node(self, node_id: int) -> None:
-        self._lib.avh_add_node(self._handle, node_id)
+        self._lib.avh_add_node(self._h(), node_id)
 
     def nodes_ids(self) -> List[int]:
         cap = 4096
         buf = (ctypes.c_int64 * cap)()
-        n = self._lib.avh_node_ids(self._handle, buf, cap)
+        n = self._lib.avh_node_ids(self._h(), buf, cap)
         if n > cap:
             cap = n
             buf = (ctypes.c_int64 * cap)()
-            n = self._lib.avh_node_ids(self._handle, buf, cap)
+            n = self._lib.avh_node_ids(self._h(), buf, cap)
         return [int(buf[i]) for i in range(min(n, cap))]
 
     # --- admission / state ------------------------------------------------
@@ -264,33 +278,33 @@ class NativeProcessor:
             1 if valid else 0, score))
 
     def invalidate(self, target_hash: int) -> bool:
-        return bool(self._lib.avh_set_target_valid(self._handle,
+        return bool(self._lib.avh_set_target_valid(self._h(),
                                                    target_hash, 0))
 
     def get_round(self) -> int:
-        return int(self._lib.avh_get_round(self._handle))
+        return int(self._lib.avh_get_round(self._h()))
 
     def is_accepted(self, target_hash: int) -> bool:
-        return bool(self._lib.avh_is_accepted(self._handle, target_hash))
+        return bool(self._lib.avh_is_accepted(self._h(), target_hash))
 
     def get_confidence(self, target_hash: int) -> int:
-        c = self._lib.avh_get_confidence(self._handle, target_hash)
+        c = self._lib.avh_get_confidence(self._h(), target_hash)
         if c < 0:
             raise KeyError(f"VoteRecord not found for hash {target_hash}")
         return c
 
     def outstanding_requests(self) -> int:
-        return int(self._lib.avh_outstanding_requests(self._handle))
+        return int(self._lib.avh_outstanding_requests(self._h()))
 
     # --- polls ------------------------------------------------------------
     def get_invs_for_next_poll(self) -> List[int]:
         cap = max(self._cfg.max_element_poll, 1)
         buf = (ctypes.c_int64 * cap)()
-        n = self._lib.avh_get_invs(self._handle, buf, cap)
+        n = self._lib.avh_get_invs(self._h(), buf, cap)
         return [int(buf[i]) for i in range(min(n, cap))]
 
     def get_suitable_node_to_query(self) -> int:
-        return int(self._lib.avh_suitable_node(self._handle))
+        return int(self._lib.avh_suitable_node(self._h()))
 
     # --- ingest -----------------------------------------------------------
     def register_votes(self, node_id: int, resp: Response,
@@ -300,22 +314,24 @@ class NativeProcessor:
         hashes = (ctypes.c_int64 * max(n, 1))(*[v.get_hash() for v in votes])
         errs = (ctypes.c_int32 * max(n, 1))(
             *[normalize_err(v.get_error()) for v in votes])
-        out_h = (ctypes.c_int64 * self._UPDATE_CAP)()
-        out_s = (ctypes.c_int8 * self._UPDATE_CAP)()
+        # At most one status update per vote, so n slots always suffice.
+        cap = max(n, 1)
+        out_h = (ctypes.c_int64 * cap)()
+        out_s = (ctypes.c_int8 * cap)()
         n_up = ctypes.c_int32(0)
         ok = self._lib.avh_register_votes(
-            self._handle, node_id, resp.get_round(), hashes, errs, n,
-            out_h, out_s, self._UPDATE_CAP, ctypes.byref(n_up))
+            self._h(), node_id, resp.get_round(), hashes, errs, n,
+            out_h, out_s, cap, ctypes.byref(n_up))
         for i in range(n_up.value):
             updates.append(StatusUpdate(int(out_h[i]), Status(int(out_s[i]))))
         return bool(ok)
 
     # --- event loop -------------------------------------------------------
     def event_loop(self) -> bool:
-        return bool(self._lib.avh_event_loop_tick(self._handle))
+        return bool(self._lib.avh_event_loop_tick(self._h()))
 
     def start(self) -> bool:
-        return bool(self._lib.avh_start(self._handle))
+        return bool(self._lib.avh_start(self._h()))
 
     def stop(self) -> bool:
-        return bool(self._lib.avh_stop(self._handle))
+        return bool(self._lib.avh_stop(self._h()))

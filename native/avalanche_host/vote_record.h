@@ -19,7 +19,12 @@
 namespace avalanche_host {
 
 struct ProtocolConfig {
-  int window = 8;                 // vote.go:55 (uint8 window)
+  int window = 8;                 // vote.go:55 (uint8 window); must be in
+                                  // (0, 8] — the state packs both windows
+                                  // into 8 bits and popcounts 8 bits.  The
+                                  // Python binding validates this
+                                  // (AvalancheConfig.__post_init__); the C
+                                  // ABI clamps defensively (see Clamp below).
   int quorum = 7;                 // vote.go:58 (> 6 popcount test)
   int finalization_score = 128;   // avalanche.go:10
   int max_element_poll = 4096;    // avalanche.go:17
@@ -30,6 +35,12 @@ struct ProtocolConfig {
 };
 
 inline int Popcount8(uint32_t x) { return __builtin_popcount(x & 0xFFu); }
+
+// Windows wider than the 8-bit packed state would silently diverge from the
+// oracle; clamp into the representable range.
+inline int ClampWindow(int window) {
+  return window < 1 ? 1 : (window > 8 ? 8 : window);
+}
 
 class VoteRecord {
  public:
@@ -66,7 +77,7 @@ class VoteRecord {
   // Apply one vote; true iff acceptance/finalization state changed
   // (vote.go:54-75).  err: 0 = yes, positive = no, negative = neutral.
   bool RegisterVote(int32_t err) {
-    const uint32_t window_mask = (1u << cfg_.window) - 1u;
+    const uint32_t window_mask = (1u << ClampWindow(cfg_.window)) - 1u;
     votes_ = ((votes_ << 1) | (err == 0 ? 1u : 0u)) & window_mask;
     consider_ = ((consider_ << 1) | (err >= 0 ? 1u : 0u)) & window_mask;
 

@@ -1,0 +1,231 @@
+#include "client.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+
+namespace avalanche_connector {
+
+namespace {
+
+void SendAll(int fd, const uint8_t* data, size_t n) {
+  size_t sent = 0;
+  while (sent < n) {
+    ssize_t w = ::send(fd, data + sent, n - sent, 0);
+    if (w <= 0) throw std::runtime_error("connector: send failed");
+    sent += static_cast<size_t>(w);
+  }
+}
+
+void RecvAll(int fd, uint8_t* data, size_t n) {
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = ::recv(fd, data + got, n - got, 0);
+    if (r <= 0) throw std::runtime_error("connector: connection closed");
+    got += static_cast<size_t>(r);
+  }
+}
+
+}  // namespace
+
+ConnectorClient::ConnectorClient(const std::string& host, int port) {
+  addrinfo hints{};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  const std::string port_s = std::to_string(port);
+  if (getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res) != 0 || !res)
+    throw std::runtime_error("connector: cannot resolve " + host);
+  fd_ = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd_ < 0 || ::connect(fd_, res->ai_addr, res->ai_addrlen) != 0) {
+    freeaddrinfo(res);
+    if (fd_ >= 0) ::close(fd_);
+    throw std::runtime_error("connector: cannot connect to " + host + ":" +
+                             port_s);
+  }
+  freeaddrinfo(res);
+  int one = 1;
+  setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+ConnectorClient::~ConnectorClient() {
+  if (fd_ >= 0) ::close(fd_);
+}
+
+std::pair<MsgType, std::vector<uint8_t>> ConnectorClient::Call(
+    MsgType type, const std::vector<uint8_t>& payload, MsgType expect) {
+  // Frame: u32be length, u8 type, payload.
+  const uint32_t body_len = static_cast<uint32_t>(payload.size() + 1);
+  std::vector<uint8_t> frame;
+  frame.reserve(4 + body_len);
+  frame.push_back(static_cast<uint8_t>(body_len >> 24));
+  frame.push_back(static_cast<uint8_t>(body_len >> 16));
+  frame.push_back(static_cast<uint8_t>(body_len >> 8));
+  frame.push_back(static_cast<uint8_t>(body_len));
+  frame.push_back(static_cast<uint8_t>(type));
+  frame.insert(frame.end(), payload.begin(), payload.end());
+  SendAll(fd_, frame.data(), frame.size());
+
+  uint8_t header[4];
+  RecvAll(fd_, header, 4);
+  const uint32_t reply_len = (uint32_t{header[0]} << 24) |
+                             (uint32_t{header[1]} << 16) |
+                             (uint32_t{header[2]} << 8) | uint32_t{header[3]};
+  if (reply_len == 0 || reply_len > (64u << 20))
+    throw std::runtime_error("connector: bad frame length");
+  std::vector<uint8_t> body(reply_len);
+  RecvAll(fd_, body.data(), reply_len);
+  const MsgType reply_type = static_cast<MsgType>(body[0]);
+  std::vector<uint8_t> reply(body.begin() + 1, body.end());
+  if (reply_type == MsgType::kError) {
+    std::string msg = "connector: server error";
+    if (reply.size() >= 4) {
+      const uint32_t n = GetLE<uint32_t>(reply.data());
+      if (4 + n <= reply.size())
+        msg = std::string(reply.begin() + 4, reply.begin() + 4 + n);
+    }
+    throw std::runtime_error(msg);
+  }
+  if (reply_type != expect)
+    throw std::runtime_error("connector: unexpected reply type");
+  return {reply_type, std::move(reply)};
+}
+
+bool ConnectorClient::Ping() {
+  Call(MsgType::kPing, {}, MsgType::kPong);
+  return true;
+}
+
+bool ConnectorClient::CreateNode(int64_t node_id) {
+  std::vector<uint8_t> p;
+  PutLE(&p, node_id);
+  auto [t, r] = Call(MsgType::kCreateNode, p, MsgType::kOk);
+  return !r.empty() && r[0] != 0;
+}
+
+bool ConnectorClient::AddTarget(int64_t node_id, int64_t hash, bool accepted,
+                                bool valid, int64_t score) {
+  std::vector<uint8_t> p;
+  PutLE(&p, node_id);
+  PutLE(&p, hash);
+  PutU8(&p, accepted ? 1 : 0);
+  PutU8(&p, valid ? 1 : 0);
+  PutLE(&p, score);
+  auto [t, r] = Call(MsgType::kAddTarget, p, MsgType::kOk);
+  return !r.empty() && r[0] != 0;
+}
+
+std::vector<int64_t> ConnectorClient::GetInvs(int64_t node_id) {
+  std::vector<uint8_t> p;
+  PutLE(&p, node_id);
+  auto [t, r] = Call(MsgType::kGetInvs, p, MsgType::kInvs);
+  const uint32_t count = GetLE<uint32_t>(r.data());
+  std::vector<int64_t> invs(count);
+  for (uint32_t i = 0; i < count; ++i)
+    invs[i] = GetLE<int64_t>(r.data() + 4 + 8 * i);
+  return invs;
+}
+
+std::vector<VoteWire> ConnectorClient::Query(
+    int64_t node_id, const std::vector<int64_t>& hashes) {
+  std::vector<uint8_t> p;
+  PutLE(&p, node_id);
+  PutLE(&p, static_cast<uint32_t>(hashes.size()));
+  for (int64_t h : hashes) PutLE(&p, h);
+  auto [t, r] = Call(MsgType::kQuery, p, MsgType::kVotes);
+  const uint32_t count = GetLE<uint32_t>(r.data());
+  std::vector<VoteWire> votes(count);
+  for (uint32_t i = 0; i < count; ++i) {
+    votes[i].hash = GetLE<int64_t>(r.data() + 4 + 12 * i);
+    votes[i].err = GetLE<int32_t>(r.data() + 4 + 12 * i + 8);
+  }
+  return votes;
+}
+
+bool ConnectorClient::RegisterVotes(int64_t node_id, int64_t from_node,
+                                    int64_t round,
+                                    const std::vector<VoteWire>& votes,
+                                    std::vector<UpdateWire>* updates) {
+  std::vector<uint8_t> p;
+  PutLE(&p, node_id);
+  PutLE(&p, from_node);
+  PutLE(&p, round);
+  PutLE(&p, static_cast<uint32_t>(votes.size()));
+  for (const VoteWire& v : votes) {
+    PutLE(&p, v.hash);
+    PutLE(&p, v.err);
+  }
+  auto [t, r] = Call(MsgType::kRegisterVotes, p, MsgType::kUpdates);
+  const bool ok = r[0] != 0;
+  const uint32_t count = GetLE<uint32_t>(r.data() + 1);
+  for (uint32_t i = 0; i < count; ++i) {
+    UpdateWire u;
+    u.hash = GetLE<int64_t>(r.data() + 5 + 9 * i);
+    u.status = static_cast<int8_t>(r[5 + 9 * i + 8]);
+    if (updates) updates->push_back(u);
+  }
+  return ok;
+}
+
+bool ConnectorClient::IsAccepted(int64_t node_id, int64_t hash) {
+  std::vector<uint8_t> p;
+  PutLE(&p, node_id);
+  PutLE(&p, hash);
+  auto [t, r] = Call(MsgType::kIsAccepted, p, MsgType::kOk);
+  return !r.empty() && r[0] != 0;
+}
+
+int64_t ConnectorClient::GetConfidence(int64_t node_id, int64_t hash) {
+  std::vector<uint8_t> p;
+  PutLE(&p, node_id);
+  PutLE(&p, hash);
+  auto [t, r] = Call(MsgType::kGetConfidence, p, MsgType::kI64);
+  return GetLE<int64_t>(r.data());
+}
+
+int64_t ConnectorClient::GetRound(int64_t node_id) {
+  std::vector<uint8_t> p;
+  PutLE(&p, node_id);
+  auto [t, r] = Call(MsgType::kGetRound, p, MsgType::kI64);
+  return GetLE<int64_t>(r.data());
+}
+
+bool ConnectorClient::SimInit(uint32_t n_nodes, uint32_t n_txs, uint32_t seed,
+                              uint32_t k, uint32_t finalization_score,
+                              bool gossip, double byzantine, double drop) {
+  std::vector<uint8_t> p;
+  PutLE(&p, n_nodes);
+  PutLE(&p, n_txs);
+  PutLE(&p, seed);
+  PutLE(&p, k);
+  PutLE(&p, finalization_score);
+  PutU8(&p, gossip ? 1 : 0);
+  PutLE(&p, byzantine);
+  PutLE(&p, drop);
+  auto [t, r] = Call(MsgType::kSimInit, p, MsgType::kOk);
+  return !r.empty() && r[0] != 0;
+}
+
+SimStats ConnectorClient::SimRun(uint32_t rounds) {
+  std::vector<uint8_t> p;
+  PutLE(&p, rounds);
+  auto [t, r] = Call(MsgType::kSimRun, p, MsgType::kSimStats);
+  SimStats s;
+  s.round = GetLE<uint32_t>(r.data());
+  s.finalized_fraction = GetLE<double>(r.data() + 4);
+  s.polls = GetLE<int64_t>(r.data() + 12);
+  s.votes_applied = GetLE<int64_t>(r.data() + 20);
+  s.flips = GetLE<int64_t>(r.data() + 28);
+  s.finalizations = GetLE<int64_t>(r.data() + 36);
+  return s;
+}
+
+void ConnectorClient::ShutdownServer() {
+  Call(MsgType::kShutdown, {}, MsgType::kOk);
+}
+
+}  // namespace avalanche_connector

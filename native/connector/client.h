@@ -1,0 +1,65 @@
+// C++ Connector client: drives a go_avalanche_tpu ConnectorServer over TCP.
+//
+// Mirrors go_avalanche_tpu/connector/client.py method-for-method; see
+// harness_main.cc for the reference-example drive loop using it.
+
+#ifndef AVALANCHE_CONNECTOR_CLIENT_H_
+#define AVALANCHE_CONNECTOR_CLIENT_H_
+
+#include <cstdint>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "protocol.h"
+
+namespace avalanche_connector {
+
+struct SimStats {
+  uint32_t round = 0;
+  double finalized_fraction = 0;
+  int64_t polls = 0;
+  int64_t votes_applied = 0;
+  int64_t flips = 0;
+  int64_t finalizations = 0;
+};
+
+class ConnectorClient {
+ public:
+  ConnectorClient(const std::string& host, int port);
+  ~ConnectorClient();
+  ConnectorClient(const ConnectorClient&) = delete;
+  ConnectorClient& operator=(const ConnectorClient&) = delete;
+
+  bool Ping();
+  bool CreateNode(int64_t node_id);
+  bool AddTarget(int64_t node_id, int64_t hash, bool accepted, bool valid,
+                 int64_t score);
+  std::vector<int64_t> GetInvs(int64_t node_id);
+  std::vector<VoteWire> Query(int64_t node_id,
+                              const std::vector<int64_t>& hashes);
+  // Returns server "ok"; status updates appended to *updates.
+  bool RegisterVotes(int64_t node_id, int64_t from_node, int64_t round,
+                     const std::vector<VoteWire>& votes,
+                     std::vector<UpdateWire>* updates);
+  bool IsAccepted(int64_t node_id, int64_t hash);
+  int64_t GetConfidence(int64_t node_id, int64_t hash);  // -1 if unknown
+  int64_t GetRound(int64_t node_id);
+  bool SimInit(uint32_t n_nodes, uint32_t n_txs, uint32_t seed, uint32_t k,
+               uint32_t finalization_score, bool gossip, double byzantine,
+               double drop);
+  SimStats SimRun(uint32_t rounds);
+  void ShutdownServer();
+
+ private:
+  // Sends one frame and reads the reply; throws std::runtime_error on
+  // transport errors or an ERROR reply.
+  std::pair<MsgType, std::vector<uint8_t>> Call(
+      MsgType type, const std::vector<uint8_t>& payload, MsgType expect);
+
+  int fd_ = -1;
+};
+
+}  // namespace avalanche_connector
+
+#endif  // AVALANCHE_CONNECTOR_CLIENT_H_

@@ -1,0 +1,110 @@
+// Native example harness: the reference example's drive loop over the wire.
+//
+// Reproduces examples/basic-preconcensus/main.go against a
+// go_avalanche_tpu ConnectorServer: create N nodes, feed every tx to one
+// node each, then loop  GetInvs -> Query(random peer) -> RegisterVotes
+// (gossip-on-poll spreads targets, main.go:177) until every node finalized
+// every tx, and print the wall-clock + finalization summary (main.go:63-64).
+//
+// Usage: avalanche_harness <host> <port> [n_nodes] [n_txs] [--sim]
+//   --sim additionally drives the batched TPU simulator remotely
+//   (SIM_INIT/SIM_RUN) and prints its stats.
+
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <random>
+#include <set>
+#include <vector>
+
+#include "client.h"
+
+using avalanche_connector::ConnectorClient;
+using avalanche_connector::UpdateWire;
+using avalanche_connector::VoteWire;
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::fprintf(stderr,
+                 "usage: %s <host> <port> [n_nodes] [n_txs] [--sim]\n",
+                 argv[0]);
+    return 2;
+  }
+  const std::string host = argv[1];
+  const int port = std::atoi(argv[2]);
+  bool run_sim = false;
+  std::vector<int> positional;
+  for (int i = 3; i < argc; ++i) {
+    if (std::strcmp(argv[i], "--sim") == 0)
+      run_sim = true;
+    else
+      positional.push_back(std::atoi(argv[i]));
+  }
+  const int n_nodes = positional.size() > 0 ? positional[0] : 10;
+  const int n_txs = positional.size() > 1 ? positional[1] : 5;
+  if (n_nodes < 2 || n_txs < 1) {
+    std::fprintf(stderr, "need n_nodes >= 2 and n_txs >= 1\n");
+    return 2;
+  }
+
+  try {
+    ConnectorClient client(host, port);
+    if (!client.Ping()) throw std::runtime_error("ping failed");
+
+    const auto t0 = std::chrono::steady_clock::now();
+    for (int i = 0; i < n_nodes; ++i) client.CreateNode(i);
+    // Feed each tx to one node; gossip must spread it (main.go:49-53 feeds
+    // all nodes — seeding one is the stricter variant).
+    for (int t = 0; t < n_txs; ++t)
+      client.AddTarget(t % n_nodes, t, /*accepted=*/true, /*valid=*/true,
+                       /*score=*/1);
+
+    std::mt19937_64 rng(0);
+    std::vector<std::set<int64_t>> finalized(n_nodes);
+    int nodes_fully_finalized = 0;
+    long long polls = 0;
+    for (int round = 0; round < 100000 && nodes_fully_finalized < n_nodes;
+         ++round) {
+      for (int i = 0; i < n_nodes; ++i) {
+        auto invs = client.GetInvs(i);
+        if (invs.empty()) continue;
+        int peer = static_cast<int>(rng() % (n_nodes - 1));
+        if (peer >= i) ++peer;
+        auto votes = client.Query(peer, invs);
+        std::vector<UpdateWire> updates;
+        client.RegisterVotes(i, peer, 0, votes, &updates);
+        ++polls;
+        for (const UpdateWire& u : updates) {
+          // Duplicate FINALIZED updates are possible (a finalized target can
+          // be gossip-re-admitted); count a node only on the insert that
+          // completes its set.
+          if (u.status == 3 /*FINALIZED*/ &&
+              finalized[i].insert(u.hash).second &&
+              static_cast<int>(finalized[i].size()) == n_txs)
+            ++nodes_fully_finalized;
+        }
+      }
+    }
+    const double secs =
+        std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
+            .count();
+    std::printf("nodes_fully_finalized=%d/%d polls=%lld seconds=%.3f\n",
+                nodes_fully_finalized, n_nodes, polls, secs);
+    if (nodes_fully_finalized != n_nodes) return 1;
+
+    if (run_sim) {
+      client.SimInit(64, 32, /*seed=*/0, /*k=*/8, /*finalization_score=*/32,
+                     /*gossip=*/true, /*byzantine=*/0.0, /*drop=*/0.0);
+      auto stats = client.SimRun(80);
+      std::printf("sim round=%u finalized_fraction=%.3f votes=%lld\n",
+                  stats.round, stats.finalized_fraction,
+                  static_cast<long long>(stats.votes_applied));
+      if (stats.finalized_fraction < 1.0) return 1;
+    }
+    return 0;
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "harness error: %s\n", e.what());
+    return 1;
+  }
+}

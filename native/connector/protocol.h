@@ -1,0 +1,68 @@
+// Connector wire protocol — C++ mirror of
+// go_avalanche_tpu/connector/protocol.py (the single source of truth).
+//
+// Frames: u32 big-endian length, then u8 message type + little-endian
+// payload.  Only plain sockets are required, so any C++ harness can drive
+// the framework's host boundary.
+
+#ifndef AVALANCHE_CONNECTOR_PROTOCOL_H_
+#define AVALANCHE_CONNECTOR_PROTOCOL_H_
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace avalanche_connector {
+
+enum class MsgType : uint8_t {
+  kPing = 1,
+  kPong = 2,
+  kCreateNode = 3,
+  kAddTarget = 4,
+  kGetInvs = 5,
+  kQuery = 6,
+  kRegisterVotes = 7,
+  kIsAccepted = 8,
+  kGetConfidence = 9,
+  kGetRound = 10,
+  kSimInit = 11,
+  kSimRun = 12,
+  kOk = 14,
+  kI64 = 15,
+  kShutdown = 16,
+  kInvs = 17,
+  kVotes = 18,
+  kUpdates = 19,
+  kSimStats = 20,
+  kError = 21,
+};
+
+struct VoteWire {
+  int64_t hash;
+  int32_t err;
+};
+
+struct UpdateWire {
+  int64_t hash;
+  int8_t status;  // 0 INVALID, 1 REJECTED, 2 ACCEPTED, 3 FINALIZED
+};
+
+// Little-endian append helpers (x86/ARM LE hosts; memcpy keeps it UB-free).
+inline void PutU8(std::vector<uint8_t>* b, uint8_t v) { b->push_back(v); }
+template <typename T>
+inline void PutLE(std::vector<uint8_t>* b, T v) {
+  uint8_t raw[sizeof(T)];
+  std::memcpy(raw, &v, sizeof(T));
+  b->insert(b->end(), raw, raw + sizeof(T));
+}
+template <typename T>
+inline T GetLE(const uint8_t* p) {
+  T v;
+  std::memcpy(&v, p, sizeof(T));
+  return v;
+}
+
+}  // namespace avalanche_connector
+
+#endif  // AVALANCHE_CONNECTOR_PROTOCOL_H_

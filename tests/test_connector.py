@@ -1,0 +1,129 @@
+"""Connector service tests: the host boundary drives real consensus.
+
+Covers the wire protocol round-trips, the reference-example drive loop
+(`main.go:110-161`) over TCP with gossip-on-poll, both engine backends, and
+remote control of the batched simulator.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from go_avalanche_tpu.connector import ConnectorClient, ConnectorServer
+from go_avalanche_tpu.connector import protocol as proto
+from go_avalanche_tpu.connector.server import _HAVE_NATIVE
+from go_avalanche_tpu.types import Status
+
+BACKENDS = ["python"] + (["native"] if _HAVE_NATIVE else [])
+
+
+@pytest.fixture(params=BACKENDS)
+def server(request):
+    with ConnectorServer(backend=request.param) as srv:
+        yield srv
+
+
+def _client(srv: ConnectorServer) -> ConnectorClient:
+    host, port = srv.address
+    return ConnectorClient(host, port)
+
+
+def test_ping_and_unknown_node_error(server):
+    with _client(server) as c:
+        assert c.ping()
+        with pytest.raises(proto.ProtocolError, match="unknown node"):
+            c.get_invs(123)
+
+
+def test_target_lifecycle_over_wire(server):
+    with _client(server) as c:
+        assert c.create_node(0)
+        assert not c.create_node(0)  # idempotent
+        assert c.add_target(0, 65, accepted=True, score=100)
+        assert not c.add_target(0, 65, accepted=True, score=100)
+        assert c.add_target(0, 66, accepted=False, score=50)
+        assert c.get_invs(0) == [65, 66]  # score-descending
+        assert c.is_accepted(0, 65)
+        assert not c.is_accepted(0, 66)
+        assert c.get_confidence(0, 65) == 0
+        assert c.get_confidence(0, 999) == -1  # unknown -> sentinel
+
+
+def test_register_votes_finalizes_over_wire(server):
+    with _client(server) as c:
+        c.create_node(0)
+        c.add_target(0, 7, accepted=True)
+        updates = []
+        for _ in range(200):
+            if not c.get_invs(0):
+                break
+            ok, ups = c.register_votes(0, 1, 0, [(7, 0)])
+            assert ok
+            updates.extend(ups)
+        assert updates[-1] == (7, Status.FINALIZED)
+        assert c.get_invs(0) == []
+
+
+def test_reference_example_drive_loop_over_wire(server):
+    """The main.go drive pattern across 8 nodes; one tx seeded at one node
+    spreads by gossip-on-poll and finalizes everywhere."""
+    n_nodes = 8
+    rng = random.Random(0)
+    with _client(server) as c:
+        for i in range(n_nodes):
+            c.create_node(i)
+        c.add_target(0, 42, accepted=True)
+
+        finalized = set()
+        for _ in range(3000):
+            if len(finalized) == n_nodes:
+                break
+            for i in range(n_nodes):
+                invs = c.get_invs(i)
+                if not invs:
+                    continue
+                peer = rng.randrange(n_nodes - 1)
+                peer = peer + 1 if peer >= i else peer
+                votes = c.query(peer, invs)
+                ok, ups = c.register_votes(i, peer, 0, votes)
+                assert ok
+                for u in ups:
+                    if u.status == Status.FINALIZED and u.hash == 42:
+                        finalized.add(i)
+        assert len(finalized) == n_nodes
+
+
+def test_sim_backend_over_wire(server):
+    with _client(server) as c:
+        assert c.sim_init(32, 16, seed=0, k=8, finalization_score=32)
+        stats = c.sim_run(80)
+        assert stats.round == 80
+        assert stats.finalized_fraction == 1.0
+        assert stats.votes_applied > 0
+        # Cumulative across calls.
+        stats2 = c.sim_run(10)
+        assert stats2.round == 90
+        assert stats2.votes_applied >= stats.votes_applied
+
+
+def test_sim_run_without_init_is_an_error(server):
+    with _client(server) as c:
+        with pytest.raises(proto.ProtocolError, match="SIM_INIT"):
+            c.sim_run(1)
+
+
+def test_two_clients_share_engines():
+    with ConnectorServer(backend=BACKENDS[0]) as srv:
+        with _client(srv) as c1, _client(srv) as c2:
+            c1.create_node(5)
+            c1.add_target(5, 9, accepted=True)
+            assert c2.get_invs(5) == [9]  # same registry
+
+
+def test_shutdown_request():
+    with ConnectorServer(backend=BACKENDS[0]) as srv:
+        with _client(srv) as c:
+            c.shutdown_server()
+        assert srv.wait_for_shutdown_request(timeout=5.0)

@@ -1,0 +1,49 @@
+"""Integration: the C++ harness drives the Connector server end to end.
+
+Builds `native/build/avalanche_harness` (make clients) and runs it against a
+live ConnectorServer — the cross-language proof of the host boundary: C++
+speaks the wire protocol, the server hosts the engines, consensus finalizes.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+import pytest
+
+from go_avalanche_tpu.connector import ConnectorServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native")
+HARNESS = os.path.join(NATIVE, "build", "avalanche_harness")
+
+
+@pytest.fixture(scope="module")
+def harness_bin() -> str:
+    try:
+        subprocess.run(["make", "-C", NATIVE, "clients"], check=True,
+                       capture_output=True, text=True)
+    except (OSError, subprocess.CalledProcessError) as e:
+        pytest.skip(f"cannot build C++ harness: {e}")
+    return HARNESS
+
+
+def test_cpp_harness_converges(harness_bin):
+    with ConnectorServer() as srv:
+        host, port = srv.address
+        out = subprocess.run(
+            [harness_bin, host, str(port), "6", "3"],
+            capture_output=True, text=True, timeout=300)
+        assert out.returncode == 0, out.stderr
+        assert "nodes_fully_finalized=6/6" in out.stdout
+
+
+def test_cpp_harness_drives_batched_sim(harness_bin):
+    with ConnectorServer() as srv:
+        host, port = srv.address
+        out = subprocess.run(
+            [harness_bin, host, str(port), "4", "2", "--sim"],
+            capture_output=True, text=True, timeout=600)
+        assert out.returncode == 0, out.stderr
+        assert "finalized_fraction=1.000" in out.stdout
