@@ -1,0 +1,98 @@
+"""Rounds-to-finality curves — the Avalanche paper's headline fidelity plot.
+
+The BASELINE.json north star asks the framework to "reproduce paper
+rounds-to-finality curves" (the Avalanche paper is linked from the reference
+README, `README.md:15`).  The paper's key qualitative claims:
+
+  * finality latency grows ~logarithmically with network size, and
+  * it degrades gracefully as Byzantine fraction rises toward the
+    ~O(sqrt(n)) safety threshold.
+
+This sweep measures both on the batched simulator: for each (network size,
+byzantine fraction) it runs the multi-target model to settlement and prints
+the rounds-to-finality percentiles plus the cumulative finality curve.
+
+    python examples/finality_curves.py                  # quick sweep
+    python examples/finality_curves.py --sizes 256,1024,4096 --txs 64
+    python examples/finality_curves.py --json > curves.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")  # allow running from the repo root
+
+import jax
+import numpy as np
+
+from go_avalanche_tpu.config import AvalancheConfig
+from go_avalanche_tpu.models import avalanche as av
+from go_avalanche_tpu.utils import metrics
+
+
+def run_point(n_nodes: int, n_txs: int, byzantine: float, seed: int,
+              max_rounds: int) -> dict:
+    cfg = AvalancheConfig(byzantine_fraction=byzantine)
+    state = av.init(jax.random.key(seed), n_nodes, n_txs, cfg)
+    t0 = time.perf_counter()
+    state = jax.jit(av.run, static_argnames=("cfg", "max_rounds"))(
+        state, cfg, max_rounds)
+    stats = metrics.rounds_to_finality(state.finalized_at)
+    return {
+        "nodes": n_nodes,
+        "txs": n_txs,
+        "byzantine": byzantine,
+        "rounds": int(jax.device_get(state.round)),
+        "elapsed_s": round(time.perf_counter() - t0, 3),
+        **{k: round(v, 2) for k, v in stats.items()},
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--sizes", type=str, default="128,512,2048")
+    parser.add_argument("--txs", type=int, default=32)
+    parser.add_argument("--byzantine", type=str, default="0.0,0.1,0.2")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--max-rounds", type=int, default=4000)
+    parser.add_argument("--json", action="store_true")
+    args = parser.parse_args()
+
+    sizes = [int(s) for s in args.sizes.split(",")]
+    byz_fracs = [float(b) for b in args.byzantine.split(",")]
+
+    results = [run_point(n, args.txs, b, args.seed, args.max_rounds)
+               for n in sizes for b in byz_fracs]
+
+    if args.json:
+        print(json.dumps(results, indent=2))
+        return
+
+    hdr = (f"{'nodes':>7} {'byz':>5} {'median':>7} {'p90':>7} {'max':>7} "
+           f"{'unfinal%':>9} {'secs':>7}")
+    print(hdr)
+    print("-" * len(hdr))
+    for r in results:
+        print(f"{r['nodes']:>7} {r['byzantine']:>5.2f} "
+              f"{r.get('median', float('nan')):>7.1f} "
+              f"{r.get('p90', float('nan')):>7.1f} "
+              f"{r.get('max', float('nan')):>7.0f} "
+              f"{100 * r['unfinalized_fraction']:>8.2f}% "
+              f"{r['elapsed_s']:>7.2f}")
+
+    # The paper's qualitative check: latency ~log(n) for the honest runs.
+    honest = [r for r in results if r["byzantine"] == 0.0 and "median" in r]
+    if len(honest) >= 2:
+        lo, hi = honest[0], honest[-1]
+        growth = (hi["median"] - lo["median"]) / max(
+            np.log2(hi["nodes"] / lo["nodes"]), 1e-9)
+        print(f"\nhonest-median growth: {growth:+.2f} rounds per doubling "
+              f"of network size ({lo['nodes']} -> {hi['nodes']} nodes)")
+
+
+if __name__ == "__main__":
+    main()

@@ -107,6 +107,10 @@ class _SimBackend:
     """Lazy wrapper over the batched TPU simulator (models/avalanche)."""
 
     def __init__(self) -> None:
+        # One lock for the whole backend: SIM_INIT/SIM_RUN from different
+        # connections must serialize (state/cfg/totals are read-modify-write
+        # triples; handler threads are per-connection).
+        self._lock = threading.Lock()
         self._state = None
         self._cfg: Optional[AvalancheConfig] = None
         self._totals = [0, 0, 0, 0]  # polls, votes, flips, finalizations
@@ -116,9 +120,10 @@ class _SimBackend:
         import jax
         from go_avalanche_tpu.models import avalanche as av
 
-        self._cfg = cfg
-        self._state = av.init(jax.random.key(seed), n_nodes, n_txs, cfg)
-        self._totals = [0, 0, 0, 0]
+        with self._lock:
+            self._cfg = cfg
+            self._state = av.init(jax.random.key(seed), n_nodes, n_txs, cfg)
+            self._totals = [0, 0, 0, 0]
 
     def run(self, n_rounds: int) -> Tuple[int, float, List[int]]:
         import jax
@@ -126,20 +131,21 @@ class _SimBackend:
         from go_avalanche_tpu.models import avalanche as av
         from go_avalanche_tpu.ops import voterecord as vr
 
-        if self._state is None or self._cfg is None:
-            raise proto.ProtocolError("SIM_INIT required before SIM_RUN")
-        state, tel = jax.jit(
-            av.run_scan, static_argnames=("cfg", "n_rounds"))(
-                self._state, self._cfg, n_rounds)
-        self._state = state
-        sums = [int(np.asarray(jax.device_get(x)).sum())
-                for x in (tel.polls, tel.votes_applied, tel.flips,
-                          tel.finalizations)]
-        self._totals = [a + b for a, b in zip(self._totals, sums)]
-        fin = np.asarray(jax.device_get(
-            vr.has_finalized(state.records.confidence, self._cfg)))
-        return int(jax.device_get(state.round)), float(fin.mean()), \
-            self._totals
+        with self._lock:
+            if self._state is None or self._cfg is None:
+                raise proto.ProtocolError("SIM_INIT required before SIM_RUN")
+            state, tel = jax.jit(
+                av.run_scan, static_argnames=("cfg", "n_rounds"))(
+                    self._state, self._cfg, n_rounds)
+            self._state = state
+            sums = [int(np.asarray(jax.device_get(x)).sum())
+                    for x in (tel.polls, tel.votes_applied, tel.flips,
+                              tel.finalizations)]
+            self._totals = [a + b for a, b in zip(self._totals, sums)]
+            fin = np.asarray(jax.device_get(
+                vr.has_finalized(state.records.confidence, self._cfg)))
+            return int(jax.device_get(state.round)), float(fin.mean()), \
+                list(self._totals)
 
 
 class ConnectorServer:

@@ -42,6 +42,7 @@ from go_avalanche_tpu.ops.sampling import (
     sample_peers_weighted,
     self_sample_mask,
 )
+from go_avalanche_tpu.utils.tracing import annotate
 
 
 def popcnt_plane(x: jax.Array) -> jax.Array:
@@ -169,22 +170,24 @@ def round_step(
     fin = vr.has_finalized(state.records.confidence, cfg)
 
     # --- GetInvsForNextPoll: live, valid, non-finalized, score-capped.
-    pollable = (state.added & state.alive[:, None] & state.valid[None, :]
-                & jnp.logical_not(fin))
-    polled = capped_poll_mask(pollable, state.score_rank,
-                              cfg.max_element_poll)
+    with annotate("poll_mask"):
+        pollable = (state.added & state.alive[:, None] & state.valid[None, :]
+                    & jnp.logical_not(fin))
+        polled = capped_poll_mask(pollable, state.score_rank,
+                                  cfg.max_element_poll)
 
     # --- peer sampling: uniform, or latency-weighted (BASELINE config 5).
     # In the weighted mode peers are drawn proportionally to latency_weight
     # times aliveness (dead peers are never drawn), and self-draws — which
     # per-row exclusion can't cheaply rule out — become abstentions.
-    if cfg.weighted_sampling:
-        w = state.latency_weight * state.alive.astype(jnp.float32)
-        peers = sample_peers_weighted(k_sample, w, n, cfg.k)
-        self_draw = self_sample_mask(peers)
-    else:
-        peers = sample_peers_uniform(k_sample, n, cfg.k, cfg.exclude_self)
-        self_draw = None
+    with annotate("sample_peers"):
+        if cfg.weighted_sampling:
+            w = state.latency_weight * state.alive.astype(jnp.float32)
+            peers = sample_peers_weighted(k_sample, w, n, cfg.k)
+            self_draw = self_sample_mask(peers)
+        else:
+            peers = sample_peers_uniform(k_sample, n, cfg.k, cfg.exclude_self)
+            self_draw = None
 
     # --- response model: byzantine flips and dropped responses, decided
     # per (poller, draw) — a byzantine peer flips its whole response.
@@ -202,48 +205,51 @@ def round_step(
     added = state.added
     admissions = jnp.int32(0)
     if cfg.gossip:
-        heard = jnp.zeros((n, t), jnp.uint8)
-        polled_u8 = polled.astype(jnp.uint8)
-        for j in range(cfg.k):
-            heard = heard.at[peers[:, j]].max(polled_u8)
-        new_adds = ((heard > 0) & jnp.logical_not(added)
-                    & state.alive[:, None] & state.valid[None, :])
-        admissions = new_adds.sum().astype(jnp.int32)
-        added = added | new_adds
+        with annotate("gossip_admission"):
+            heard = jnp.zeros((n, t), jnp.uint8)
+            polled_u8 = polled.astype(jnp.uint8)
+            for j in range(cfg.k):
+                heard = heard.at[peers[:, j]].max(polled_u8)
+            new_adds = ((heard > 0) & jnp.logical_not(added)
+                        & state.alive[:, None] & state.valid[None, :])
+            admissions = new_adds.sum().astype(jnp.int32)
+            added = added | new_adds
 
     # --- gather peer preferences and pack the k votes into bit planes.
     # The preference plane is bit-packed along txs BEFORE gathering, so each
     # of the k row-gathers reads T/8 bytes per row instead of T (measured
     # ~13% faster end-to-end at 8192x8192; it is also the sharded path's
     # wire format, `parallel/sharded.py`).
-    prefs = vr.is_accepted(state.records.confidence)       # [N, T]
-    packed_prefs = pack_bool_plane(prefs)                  # [N, ceil(T/8)]
-    yes_pack = jnp.zeros((n, t), jnp.uint8)
-    consider_pack = jnp.zeros((n, t), jnp.uint8)
-    for j in range(cfg.k):
-        vote_j = unpack_bool_plane(packed_prefs[peers[:, j]], t)
-        vote_j = jnp.logical_xor(vote_j, flip[:, j][:, None])
-        yes_pack |= vote_j.astype(jnp.uint8) << jnp.uint8(j)
-        consider_pack |= (responded[:, j].astype(jnp.uint8)
-                          << jnp.uint8(j))[:, None]
+    with annotate("gather_prefs"):
+        prefs = vr.is_accepted(state.records.confidence)   # [N, T]
+        packed_prefs = pack_bool_plane(prefs)              # [N, ceil(T/8)]
+        yes_pack = jnp.zeros((n, t), jnp.uint8)
+        consider_pack = jnp.zeros((n, t), jnp.uint8)
+        for j in range(cfg.k):
+            vote_j = unpack_bool_plane(packed_prefs[peers[:, j]], t)
+            vote_j = jnp.logical_xor(vote_j, flip[:, j][:, None])
+            yes_pack |= vote_j.astype(jnp.uint8) << jnp.uint8(j)
+            consider_pack |= (responded[:, j].astype(jnp.uint8)
+                              << jnp.uint8(j))[:, None]
 
     # --- ingest: k fused window updates on polled records only
     # (RegisterVotes, `processor.go:92-117`); finalized records freeze.
-    if cfg.vote_mode is VoteMode.SEQUENTIAL:
-        records, changed = vr.register_packed_votes(
-            state.records, yes_pack, consider_pack, cfg.k, cfg,
-            update_mask=polled)
-        votes_applied = (popcnt_plane(consider_pack) * polled).sum()
-    else:
-        thresh = math.ceil(cfg.alpha * cfg.k)
-        yes_cnt = popcnt_plane(yes_pack & consider_pack)
-        no_cnt = popcnt_plane(~yes_pack & consider_pack)
-        err = jnp.where(yes_cnt >= thresh, jnp.int32(0),
-                        jnp.where(no_cnt >= thresh, jnp.int32(1),
-                                  jnp.int32(-1)))
-        records, changed = vr.register_vote(state.records, err, cfg,
-                                            update_mask=polled)
-        votes_applied = ((err >= 0) & polled).sum()
+    with annotate("ingest_votes"):
+        if cfg.vote_mode is VoteMode.SEQUENTIAL:
+            records, changed = vr.register_packed_votes(
+                state.records, yes_pack, consider_pack, cfg.k, cfg,
+                update_mask=polled)
+            votes_applied = (popcnt_plane(consider_pack) * polled).sum()
+        else:
+            thresh = math.ceil(cfg.alpha * cfg.k)
+            yes_cnt = popcnt_plane(yes_pack & consider_pack)
+            no_cnt = popcnt_plane(~yes_pack & consider_pack)
+            err = jnp.where(yes_cnt >= thresh, jnp.int32(0),
+                            jnp.where(no_cnt >= thresh, jnp.int32(1),
+                                      jnp.int32(-1)))
+            records, changed = vr.register_vote(state.records, err, cfg,
+                                                update_mask=polled)
+            votes_applied = ((err >= 0) & polled).sum()
 
     # --- lifecycle + telemetry.
     fin_after = vr.has_finalized(records.confidence, cfg)

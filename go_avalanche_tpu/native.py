@@ -58,9 +58,10 @@ def ensure_built(force: bool = False) -> str:
             check=True, capture_output=True, text=True)
     except (OSError, subprocess.CalledProcessError) as e:
         detail = getattr(e, "stderr", "") or str(e)
-        if not os.path.exists(_LIB_PATH):
-            raise NativeBuildError(
-                f"building native runtime failed: {detail}") from e
+        # Always raise — even when a stale .so exists; silently serving it
+        # would run pre-edit code after a broken edit.
+        raise NativeBuildError(
+            f"building native runtime failed: {detail}") from e
     return _LIB_PATH
 
 

@@ -1,0 +1,178 @@
+"""Sweepable simulation runner CLI.
+
+SURVEY.md §5 (config system): the reference exposes exactly one flag
+(`-logging`, `main.go:24`) over four compile-time constants; here every
+protocol constant and fault knob of `AvalancheConfig` is a CLI flag, any
+model family can be selected, and results are emitted as JSON for sweep
+harnesses.
+
+    python -m go_avalanche_tpu.run_sim --model avalanche --nodes 1024 \
+        --txs 256 --byzantine 0.1 --json
+    python -m go_avalanche_tpu.run_sim --model dag --txs 64 --conflict-size 4
+    python -m go_avalanche_tpu.run_sim --model snowball --nodes 4096 \
+        --trace /tmp/xprof
+
+Models: `snowball` — [nodes] single-decree; `avalanche` — [nodes, txs]
+multi-target with gossip; `dag` — conflict-set double-spend resolution.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import time
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from go_avalanche_tpu.config import AvalancheConfig, VoteMode
+from go_avalanche_tpu.utils import metrics, tracing
+
+
+def build_config(args: argparse.Namespace) -> AvalancheConfig:
+    return AvalancheConfig(
+        finalization_score=args.finalization_score,
+        max_element_poll=args.max_element_poll,
+        window=args.window,
+        quorum=args.quorum,
+        k=args.k,
+        alpha=args.alpha,
+        vote_mode=VoteMode(args.vote_mode),
+        gossip=not args.no_gossip,
+        weighted_sampling=args.weighted,
+        byzantine_fraction=args.byzantine,
+        flip_probability=args.flip_probability,
+        drop_probability=args.drop,
+        churn_probability=args.churn,
+    )
+
+
+def run_snowball(args, cfg: AvalancheConfig) -> Dict:
+    from go_avalanche_tpu.models import snowball as sb
+    from go_avalanche_tpu.ops import voterecord as vr
+
+    state = sb.init(jax.random.key(args.seed), args.nodes, cfg,
+                    yes_fraction=args.yes_fraction)
+    state = jax.jit(sb.run, static_argnames=("cfg", "max_rounds"))(
+        state, cfg, args.max_rounds)
+    fin = np.asarray(jax.device_get(
+        vr.has_finalized(state.records.confidence, cfg)))
+    pref = np.asarray(jax.device_get(
+        vr.is_accepted(state.records.confidence)))
+    return {
+        "rounds": int(jax.device_get(state.round)),
+        "finalized_fraction": float(fin.mean()),
+        "yes_fraction": float(pref[fin].mean()) if fin.any() else None,
+    }
+
+
+def run_avalanche(args, cfg: AvalancheConfig) -> Dict:
+    from go_avalanche_tpu.models import avalanche as av
+    from go_avalanche_tpu.ops import voterecord as vr
+
+    state = av.init(jax.random.key(args.seed), args.nodes, args.txs, cfg)
+    state = jax.jit(av.run, static_argnames=("cfg", "max_rounds"))(
+        state, cfg, args.max_rounds)
+    fin = np.asarray(jax.device_get(
+        vr.has_finalized(state.records.confidence, cfg)))
+    out = {
+        "rounds": int(jax.device_get(state.round)),
+        "finalized_fraction": float(fin.mean()),
+        "nodes_fully_finalized": int(fin.all(axis=1).sum()),
+    }
+    out.update({f"finality_{k}": v for k, v in
+                metrics.rounds_to_finality(state.finalized_at).items()})
+    return out
+
+
+def run_dag(args, cfg: AvalancheConfig) -> Dict:
+    from go_avalanche_tpu.models import dag
+
+    conflict_set = jnp.arange(args.txs, dtype=jnp.int32) // args.conflict_size
+    state = dag.init(jax.random.key(args.seed), args.nodes, conflict_set, cfg)
+    state = jax.jit(dag.run, static_argnames=("cfg", "max_rounds"))(
+        state, cfg, args.max_rounds)
+    from go_avalanche_tpu.ops import voterecord as vr
+
+    conf = state.base.records.confidence
+    fin_acc = np.asarray(jax.device_get(
+        vr.has_finalized(conf, cfg) & vr.is_accepted(conf)))
+    cs = np.asarray(jax.device_get(conflict_set))
+    n_sets = int(cs.max()) + 1
+    # Every (node, set) must have exactly one finalized-accepted winner.
+    winners_per_set = np.zeros((args.nodes, n_sets), np.int64)
+    for s in range(n_sets):
+        winners_per_set[:, s] = fin_acc[:, cs == s].sum(axis=1)
+    return {
+        "rounds": int(jax.device_get(state.base.round)),
+        "sets_resolved_fraction": float((winners_per_set == 1).mean()),
+        "conflict_sets": n_sets,
+    }
+
+
+def main(argv=None) -> Dict:
+    parser = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--model", choices=["snowball", "avalanche", "dag"],
+                        default="avalanche")
+    parser.add_argument("--nodes", type=int, default=256)
+    parser.add_argument("--txs", type=int, default=64)
+    parser.add_argument("--max-rounds", type=int, default=2000)
+    parser.add_argument("--seed", type=int, default=0)
+    # protocol constants (reference parity defaults)
+    parser.add_argument("--finalization-score", type=int, default=128)
+    parser.add_argument("--max-element-poll", type=int, default=4096)
+    parser.add_argument("--window", type=int, default=8)
+    parser.add_argument("--quorum", type=int, default=7)
+    parser.add_argument("--k", type=int, default=8)
+    parser.add_argument("--alpha", type=float, default=0.8)
+    parser.add_argument("--vote-mode", choices=["sequential", "majority"],
+                        default="sequential")
+    # simulator knobs
+    parser.add_argument("--no-gossip", action="store_true")
+    parser.add_argument("--weighted", action="store_true",
+                        help="latency-weighted peer sampling")
+    parser.add_argument("--yes-fraction", type=float, default=1.0,
+                        help="snowball: initial yes-preference fraction")
+    parser.add_argument("--conflict-size", type=int, default=2,
+                        help="dag: txs per conflict set")
+    # fault model
+    parser.add_argument("--byzantine", type=float, default=0.0)
+    parser.add_argument("--flip-probability", type=float, default=1.0)
+    parser.add_argument("--drop", type=float, default=0.0)
+    parser.add_argument("--churn", type=float, default=0.0)
+    # output / tooling
+    parser.add_argument("--json", action="store_true",
+                        help="emit one JSON line instead of key=value text")
+    parser.add_argument("--trace", type=str, default=None,
+                        help="write a JAX profiler trace to this directory")
+    args = parser.parse_args(argv)
+
+    cfg = build_config(args)
+    runner = {"snowball": run_snowball, "avalanche": run_avalanche,
+              "dag": run_dag}[args.model]
+
+    ctx = tracing.trace(args.trace) if args.trace else contextlib.nullcontext()
+    t0 = time.perf_counter()
+    with ctx:
+        result = runner(args, cfg)
+    result = {
+        "model": args.model,
+        "nodes": args.nodes,
+        "txs": args.txs if args.model != "snowball" else 1,
+        "backend": jax.devices()[0].platform,
+        **result,
+        "elapsed_s": round(time.perf_counter() - t0, 3),
+    }
+    if args.json:
+        print(json.dumps(result))
+    else:
+        print(" ".join(f"{k}={v}" for k, v in result.items()))
+    return result
+
+
+if __name__ == "__main__":
+    main()

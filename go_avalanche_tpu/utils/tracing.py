@@ -1,0 +1,142 @@
+"""Tracing, profiling, and determinism auditing.
+
+SURVEY.md §5: the reference's only performance artifacts are a wall-clock
+print and a logging flag (`main.go:46,63`, `main.go:24-29`); its only safety
+net is caller-side locking with no `-race` in CI (`.travis.yml:12`).  The
+TPU-native replacements:
+
+  * `trace(dir)`       — JAX profiler traces (XPlane/TensorBoard format) of
+                         whole runs; `annotate(name)` names phases inside jit
+                         so profiles read as poll/sample/gossip/ingest.
+  * `TelemetryRecorder`— accumulates the on-device `SimTelemetry` stream and
+                         derives the north-star metrics (votes/sec,
+                         finalizations per round) host-side.
+  * `determinism_audit`— JAX's functional model makes data races structurally
+                         impossible; what remains to check is *determinism*
+                         (fixed PRNG key -> bit-identical trajectories),
+                         which this verifies by re-running a step function
+                         and comparing every state leaf bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Callable, Dict, Iterator, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture a JAX profiler trace of the enclosed block into `log_dir`.
+
+    View with TensorBoard's profile plugin or xprof.  Wraps
+    `jax.profiler.trace` so callers don't import the profiler directly.
+    """
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def annotate(name: str):
+    """Named region visible in profiler timelines AND in HLO metadata.
+
+    Usable as context manager inside traced code (`jax.named_scope`) — the
+    simulators annotate their phases with this.
+    """
+    return jax.named_scope(name)
+
+
+def start_server(port: int = 9999):
+    """Start the live profiler server (connect with TensorBoard capture)."""
+    return jax.profiler.start_server(port)
+
+
+class TelemetryRecorder:
+    """Accumulates per-round `SimTelemetry` pytrees and derives run metrics.
+
+    Keep everything on device during the run (append stacked telemetry from
+    `run_scan` once per chunk, not per round); fetches happen lazily at
+    report time.
+    """
+
+    def __init__(self) -> None:
+        self._chunks: List = []
+        self._t0 = time.perf_counter()
+        self._elapsed: Optional[float] = None
+
+    def append(self, telemetry) -> None:
+        """Add one telemetry pytree — scalar (one round) or stacked (scan)."""
+        self._chunks.append(telemetry)
+
+    def finish(self) -> None:
+        self._elapsed = time.perf_counter() - self._t0
+
+    @property
+    def elapsed_s(self) -> float:
+        return (self._elapsed if self._elapsed is not None
+                else time.perf_counter() - self._t0)
+
+    def _stacked(self) -> Dict[str, np.ndarray]:
+        if not self._chunks:
+            return {}
+        out: Dict[str, List[np.ndarray]] = {}
+        for chunk in self._chunks:
+            for field in chunk._fields:
+                arr = np.atleast_1d(np.asarray(jax.device_get(
+                    getattr(chunk, field))))
+                out.setdefault(field, []).append(arr)
+        return {k: np.concatenate(v) for k, v in out.items()}
+
+    def per_round(self) -> Dict[str, np.ndarray]:
+        """Per-round series, one entry per recorded round."""
+        return self._stacked()
+
+    def summary(self) -> Dict[str, float]:
+        """Run totals plus derived rates (votes/sec is the north star)."""
+        series = self._stacked()
+        out: Dict[str, float] = {f"total_{k}": float(v.sum())
+                                 for k, v in series.items()}
+        out["rounds"] = float(len(next(iter(series.values()), [])))
+        out["elapsed_s"] = self.elapsed_s
+        if "votes_applied" in series and self.elapsed_s > 0:
+            out["votes_per_sec"] = out["total_votes_applied"] / self.elapsed_s
+        return out
+
+
+def determinism_audit(
+    step_fn: Callable,
+    state,
+    n_repeats: int = 2,
+) -> Dict[str, object]:
+    """Replay `step_fn(state)` `n_repeats` times; compare outputs bit-exactly.
+
+    `step_fn` must be pure (state in, state/aux out) — true of every
+    simulator step in `models/` and `parallel/`.  Returns a report dict:
+    `deterministic` plus the leaf paths that mismatched, if any.
+    """
+
+    def _raw(x):
+        # Typed PRNG keys refuse numpy conversion; compare their key data.
+        if isinstance(x, jax.Array) and jax.dtypes.issubdtype(
+                x.dtype, jax.dtypes.prng_key):
+            return jax.random.key_data(x)
+        return x
+
+    outputs = [jax.device_get(jax.tree.map(_raw, step_fn(state)))
+               for _ in range(n_repeats)]
+    mismatched: List[str] = []
+
+    ref_leaves, treedef = jax.tree.flatten(outputs[0])
+    paths = [jax.tree_util.keystr(p)
+             for p, _ in jax.tree_util.tree_flatten_with_path(outputs[0])[0]]
+    for other in outputs[1:]:
+        leaves, other_def = jax.tree.flatten(other)
+        if other_def != treedef:
+            return {"deterministic": False, "mismatches": ["<structure>"]}
+        for path, a, b in zip(paths, ref_leaves, leaves):
+            if not np.array_equal(np.asarray(a), np.asarray(b)):
+                mismatched.append(path)
+    return {"deterministic": not mismatched,
+            "mismatches": sorted(set(mismatched))}

@@ -30,6 +30,14 @@ void RecvAll(int fd, uint8_t* data, size_t n) {
   }
 }
 
+// Wire counts must never be trusted: validate that `need` bytes exist at
+// `offset` before reading (truncated/corrupt replies raise, matching the
+// Python client's struct.unpack_from behavior, instead of reading OOB).
+void CheckSize(const std::vector<uint8_t>& r, size_t offset, size_t need) {
+  if (offset + need > r.size())
+    throw std::runtime_error("connector: truncated reply");
+}
+
 }  // namespace
 
 ConnectorClient::ConnectorClient(const std::string& host, int port) {
@@ -123,7 +131,9 @@ std::vector<int64_t> ConnectorClient::GetInvs(int64_t node_id) {
   std::vector<uint8_t> p;
   PutLE(&p, node_id);
   auto [t, r] = Call(MsgType::kGetInvs, p, MsgType::kInvs);
+  CheckSize(r, 0, 4);
   const uint32_t count = GetLE<uint32_t>(r.data());
+  CheckSize(r, 4, size_t{count} * 8);
   std::vector<int64_t> invs(count);
   for (uint32_t i = 0; i < count; ++i)
     invs[i] = GetLE<int64_t>(r.data() + 4 + 8 * i);
@@ -137,7 +147,9 @@ std::vector<VoteWire> ConnectorClient::Query(
   PutLE(&p, static_cast<uint32_t>(hashes.size()));
   for (int64_t h : hashes) PutLE(&p, h);
   auto [t, r] = Call(MsgType::kQuery, p, MsgType::kVotes);
+  CheckSize(r, 0, 4);
   const uint32_t count = GetLE<uint32_t>(r.data());
+  CheckSize(r, 4, size_t{count} * 12);
   std::vector<VoteWire> votes(count);
   for (uint32_t i = 0; i < count; ++i) {
     votes[i].hash = GetLE<int64_t>(r.data() + 4 + 12 * i);
@@ -160,8 +172,10 @@ bool ConnectorClient::RegisterVotes(int64_t node_id, int64_t from_node,
     PutLE(&p, v.err);
   }
   auto [t, r] = Call(MsgType::kRegisterVotes, p, MsgType::kUpdates);
+  CheckSize(r, 0, 5);
   const bool ok = r[0] != 0;
   const uint32_t count = GetLE<uint32_t>(r.data() + 1);
+  CheckSize(r, 5, size_t{count} * 9);
   for (uint32_t i = 0; i < count; ++i) {
     UpdateWire u;
     u.hash = GetLE<int64_t>(r.data() + 5 + 9 * i);
@@ -184,6 +198,7 @@ int64_t ConnectorClient::GetConfidence(int64_t node_id, int64_t hash) {
   PutLE(&p, node_id);
   PutLE(&p, hash);
   auto [t, r] = Call(MsgType::kGetConfidence, p, MsgType::kI64);
+  CheckSize(r, 0, 8);
   return GetLE<int64_t>(r.data());
 }
 
@@ -191,6 +206,7 @@ int64_t ConnectorClient::GetRound(int64_t node_id) {
   std::vector<uint8_t> p;
   PutLE(&p, node_id);
   auto [t, r] = Call(MsgType::kGetRound, p, MsgType::kI64);
+  CheckSize(r, 0, 8);
   return GetLE<int64_t>(r.data());
 }
 
@@ -214,6 +230,7 @@ SimStats ConnectorClient::SimRun(uint32_t rounds) {
   std::vector<uint8_t> p;
   PutLE(&p, rounds);
   auto [t, r] = Call(MsgType::kSimRun, p, MsgType::kSimStats);
+  CheckSize(r, 0, 44);
   SimStats s;
   s.round = GetLE<uint32_t>(r.data());
   s.finalized_fraction = GetLE<double>(r.data() + 4);

@@ -1,0 +1,51 @@
+"""CLI runner tests: every model family drives end-to-end from flags."""
+
+from __future__ import annotations
+
+import json
+
+from go_avalanche_tpu.run_sim import main
+
+
+def test_cli_snowball(capsys):
+    result = main(["--model", "snowball", "--nodes", "64",
+                   "--finalization-score", "16", "--json",
+                   "--yes-fraction", "1.0"])
+    assert result["finalized_fraction"] == 1.0
+    assert result["yes_fraction"] == 1.0
+    line = capsys.readouterr().out.strip()
+    assert json.loads(line)["model"] == "snowball"
+
+
+def test_cli_avalanche_with_faults(capsys):
+    result = main(["--model", "avalanche", "--nodes", "48", "--txs", "12",
+                   "--finalization-score", "16", "--byzantine", "0.1",
+                   "--drop", "0.05", "--json"])
+    assert result["finalized_fraction"] == 1.0
+    assert result["nodes_fully_finalized"] == 48
+    assert result["finality_median"] >= 1
+
+
+def test_cli_dag_resolves_conflicts(capsys):
+    result = main(["--model", "dag", "--nodes", "32", "--txs", "16",
+                   "--conflict-size", "4", "--finalization-score", "16",
+                   "--json"])
+    assert result["conflict_sets"] == 4
+    assert result["sets_resolved_fraction"] == 1.0
+
+
+def test_cli_text_output(capsys):
+    main(["--model", "snowball", "--nodes", "32",
+          "--finalization-score", "8"])
+    out = capsys.readouterr().out
+    assert "model=snowball" in out and "rounds=" in out
+
+
+def test_cli_trace_writes_profile(tmp_path, capsys):
+    import os
+
+    trace_dir = str(tmp_path / "prof")
+    main(["--model", "snowball", "--nodes", "32",
+          "--finalization-score", "8", "--trace", trace_dir])
+    found = [f for _, _, files in os.walk(trace_dir) for f in files]
+    assert found
