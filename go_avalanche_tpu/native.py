@@ -56,10 +56,18 @@ def ensure_built(force: bool = False) -> str:
         subprocess.run(
             ["make", "-C", _NATIVE_DIR, "all"],
             check=True, capture_output=True, text=True)
-    except (OSError, subprocess.CalledProcessError) as e:
+    except OSError as e:
+        # No make on this machine: a prebuilt library is the only candidate
+        # (and with no toolchain there can be no freshly-edited sources to
+        # go stale against it).
+        if os.path.exists(_LIB_PATH):
+            return _LIB_PATH
+        raise NativeBuildError(
+            f"no native toolchain and no prebuilt library: {e}") from e
+    except subprocess.CalledProcessError as e:
         detail = getattr(e, "stderr", "") or str(e)
-        # Always raise — even when a stale .so exists; silently serving it
-        # would run pre-edit code after a broken edit.
+        # Raise even when a stale .so exists; silently serving it would run
+        # pre-edit code after a broken edit.
         raise NativeBuildError(
             f"building native runtime failed: {detail}") from e
     return _LIB_PATH

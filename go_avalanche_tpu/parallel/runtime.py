@@ -1,0 +1,118 @@
+"""Multi-host / multi-slice runtime entry points.
+
+The reference has no distributed backend at all (SURVEY.md §5: no
+NCCL/MPI/Gloo; its "network hop" is a mutex-guarded method call,
+`examples/basic-preconcensus/main.go:168-193`).  This module is the
+scale-out half of ours: process-group bring-up via `jax.distributed` and
+mesh construction that is aware of the two interconnect tiers —
+
+  ICI  (intra-slice, fast):   carries the "nodes" axis, the only axis with
+                              per-round collectives (packed-preference
+                              all-gather, telemetry psum).
+  DCN  (inter-slice, slower): carries the "txs" axis, which needs no
+                              per-round collectives at all (a vote for
+                              target t only touches column t), so slices
+                              only talk when aggregating final statistics.
+
+On a single host this degrades gracefully to `mesh.make_mesh`, so the same
+driver script runs from a laptop CPU (with
+``--xla_force_host_platform_device_count``) to a multi-slice pod.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS, make_mesh
+
+_initialized = False
+
+
+def initialize_runtime(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> int:
+    """Bring up the multi-host process group; returns this process's index.
+
+    Single-process (all args None): no-op, returns 0.  Multi-host: calls
+    `jax.distributed.initialize` exactly once (idempotent thereafter) so
+    every host sees the global device set before any mesh is built.
+    """
+    global _initialized
+    if coordinator_address is None and num_processes is None:
+        return jax.process_index()
+    if not _initialized:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        _initialized = True
+    return jax.process_index()
+
+
+def _slice_index(d: jax.Device) -> int:
+    """Slice id of a device; 0 when the platform has no slice concept."""
+    return getattr(d, "slice_index", 0) or 0
+
+
+def group_devices_by_slice(
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> list[list[jax.Device]]:
+    """Devices grouped by slice (DCN domain), each group in stable id order."""
+    if devices is None:
+        devices = jax.devices()
+    groups: dict[int, list[jax.Device]] = {}
+    for d in sorted(devices, key=lambda d: (_slice_index(d), d.id)):
+        groups.setdefault(_slice_index(d), []).append(d)
+    return [groups[s] for s in sorted(groups)]
+
+
+def make_runtime_mesh(
+    n_tx_shards: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Interconnect-aware ``(nodes, txs)`` mesh over all slices.
+
+    Layout rule: the txs axis spans slices (DCN) because it never
+    communicates per round; the nodes axis stays inside a slice (ICI)
+    because it all-gathers every round.  With `n_tx_shards=None` the txs
+    axis gets exactly one shard per slice.  On a single slice (or CPU) this
+    is `make_mesh` with the same arithmetic.
+
+    The returned mesh uses the same axis names as `mesh.make_mesh`, so
+    `parallel.sharded` works unchanged on it.
+    """
+    groups = group_devices_by_slice(devices)
+    n_slices = len(groups)
+    per_slice = len(groups[0])
+    if any(len(g) != per_slice for g in groups):
+        raise ValueError("slices have unequal device counts: "
+                         f"{[len(g) for g in groups]}")
+    if n_tx_shards is None:
+        n_tx_shards = n_slices
+    if n_slices == 1:
+        return make_mesh(n_tx_shards=n_tx_shards, devices=groups[0])
+
+    if n_tx_shards % n_slices:
+        raise ValueError(
+            f"n_tx_shards={n_tx_shards} must be a multiple of the slice "
+            f"count {n_slices} so the DCN boundary falls between tx shards")
+    tx_per_slice = n_tx_shards // n_slices
+    if per_slice % tx_per_slice:
+        raise ValueError(
+            f"{per_slice} devices/slice not divisible by {tx_per_slice} "
+            "tx shards/slice")
+    node_shards = per_slice // tx_per_slice
+    # [n_slices, node_shards, tx_per_slice] -> (nodes, txs) with the txs
+    # axis ordered slice-major, so crossing a tx-shard boundary crosses DCN
+    # only every `tx_per_slice` shards.
+    arr = np.asarray([g for g in groups]).reshape(
+        n_slices, node_shards, tx_per_slice)
+    dev_array = np.transpose(arr, (1, 0, 2)).reshape(node_shards, n_tx_shards)
+    return Mesh(dev_array, (NODES_AXIS, TXS_AXIS))

@@ -174,5 +174,11 @@ def main(argv=None) -> Dict:
     return result
 
 
+def cli(argv=None) -> int:
+    """Console-script entry point: results go to stdout, exit status 0."""
+    main(argv)
+    return 0
+
+
 if __name__ == "__main__":
-    main()
+    raise SystemExit(cli())

@@ -136,7 +136,11 @@ def determinism_audit(
         if other_def != treedef:
             return {"deterministic": False, "mismatches": ["<structure>"]}
         for path, a, b in zip(paths, ref_leaves, leaves):
-            if not np.array_equal(np.asarray(a), np.asarray(b)):
+            a, b = np.asarray(a), np.asarray(b)
+            # Raw-bytes compare: bit-for-bit is the contract, and unlike
+            # np.array_equal it treats identical NaNs as equal.
+            if (a.shape != b.shape or a.dtype != b.dtype
+                    or a.tobytes() != b.tobytes()):
                 mismatched.append(path)
     return {"deterministic": not mismatched,
             "mismatches": sorted(set(mismatched))}
