@@ -47,7 +47,7 @@ def run_point(n_nodes: int, n_txs: int, byzantine: float, seed: int,
     # Cumulative finality curve: fraction of (node, tx) records finalized
     # by the end of each round — the paper's plot, from finalized_at stamps.
     per_round = np.bincount(fa[fa >= 0].ravel(), minlength=max(n_rounds, 1))
-    curve = np.cumsum(per_round) / float(fa.size)
+    curve = metrics.finality_curve(per_round, fa.size)
     return {
         "nodes": n_nodes,
         "txs": n_txs,

@@ -1,0 +1,299 @@
+"""Streaming backlog scheduler: 1M-scale tx throughput in bounded HBM.
+
+The north-star workload (BASELINE.json) is 100k nodes × 1M *pending* txs —
+but dense ``[nodes, txs]`` state at that size is ~400GB, far beyond any
+chip. The reference already contains the answer in miniature: a node never
+polls more than `AvalancheMaxElementPoll = 4096` targets at once
+(`avalanche.go:17`, truncation at `processor.go:165-167`), and finalized
+records are deleted to make room (`processor.go:114-116`). This module
+lifts that into a **working-set scheduler**: a bounded window of W active
+slots holds dense ``[nodes, W]`` consensus state, while the 1M-tx backlog
+lives as cheap ``[B]`` metadata. Slots whose tx the network has settled
+retire, their outcome is written to per-tx output arrays, and the freed
+slots refill from the backlog in the intended score-descending admission
+order (`avalanche.go:162-174`, the sort the reference disabled at
+`processor.go:163`) — all inside one jit; nothing round-trips to the host
+until the final results are fetched.
+
+Design notes (TPU-first):
+  * Retire/refill is pure masking + one cumsum (slot→backlog assignment by
+    prefix-sum over free slots) and one scatter into the [B] outputs —
+    static shapes throughout; XLA sees the same program every epoch.
+  * The inner consensus round is exactly `models/avalanche.round_step`, so
+    everything composes: fault knobs, weighted sampling, vote modes,
+    Pallas ingest, and the sharded nodes axis (slot metadata is replicated
+    across node shards; settling is an `all` over the nodes axis, which
+    under `shard_map` becomes one tiny psum).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
+from go_avalanche_tpu.models import avalanche as av
+from go_avalanche_tpu.ops import voterecord as vr
+
+NO_TX = -1  # empty-slot sentinel, in the spirit of NoNode (`avalanche.go:28`)
+
+
+class Backlog(NamedTuple):
+    """Per-tx metadata for the full pending set; ``[B]`` arrays.
+
+    Admission order is the array order: build with `make_backlog` to get
+    the intended score-descending order.
+    """
+
+    score: jax.Array      # int32 [B]
+    init_pref: jax.Array  # bool  [B] — Target.IsAccepted() prior
+    valid: jax.Array      # bool  [B] — Target.IsValid()
+
+
+class BacklogOutputs(NamedTuple):
+    """Per-tx settlement results, written as slots retire; ``[B]`` arrays."""
+
+    settled: jax.Array        # bool  [B]
+    accepted: jax.Array       # bool  [B] — network-majority final preference
+    accept_votes: jax.Array   # int32 [B] — nodes finalized-accepted
+    settle_round: jax.Array   # int32 [B] — global round at retirement
+    admit_round: jax.Array    # int32 [B] — global round at admission
+
+
+class BacklogSimState(NamedTuple):
+    """Active window + backlog + outputs; the full streaming-sim state."""
+
+    sim: av.AvalancheSimState  # dense [N, W] window state
+    slot_tx: jax.Array         # int32 [W] — backlog index per slot, NO_TX=empty
+    slot_admit_round: jax.Array  # int32 [W]
+    backlog: Backlog           # [B]
+    outputs: BacklogOutputs    # [B]
+    next_idx: jax.Array        # int32 — next unadmitted backlog position
+
+
+def make_backlog(
+    scores: jax.Array,
+    init_pref: Optional[jax.Array] = None,
+    valid: Optional[jax.Array] = None,
+) -> Backlog:
+    """Sort txs into score-descending admission order (stable on ties)."""
+    scores = jnp.asarray(scores, jnp.int32)
+    b = scores.shape[0]
+    if init_pref is None:
+        init_pref = jnp.ones((b,), jnp.bool_)
+    if valid is None:
+        valid = jnp.ones((b,), jnp.bool_)
+    order = jnp.argsort(-scores, stable=True)
+    return Backlog(score=scores[order],
+                   init_pref=jnp.asarray(init_pref, jnp.bool_)[order],
+                   valid=jnp.asarray(valid, jnp.bool_)[order])
+
+
+def init(
+    key: jax.Array,
+    n_nodes: int,
+    window: int,
+    backlog: Backlog,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+) -> BacklogSimState:
+    """Empty window over a fresh backlog; first `refill` happens in step 0."""
+    b = backlog.score.shape[0]
+    sim = av.init(key, n_nodes, window, cfg,
+                  added=jnp.zeros((n_nodes, window), jnp.bool_),
+                  valid=jnp.zeros((window,), jnp.bool_))
+    return BacklogSimState(
+        sim=sim,
+        slot_tx=jnp.full((window,), NO_TX, jnp.int32),
+        slot_admit_round=jnp.zeros((window,), jnp.int32),
+        backlog=backlog,
+        outputs=BacklogOutputs(
+            settled=jnp.zeros((b,), jnp.bool_),
+            accepted=jnp.zeros((b,), jnp.bool_),
+            accept_votes=jnp.zeros((b,), jnp.int32),
+            settle_round=jnp.full((b,), -1, jnp.int32),
+            admit_round=jnp.full((b,), -1, jnp.int32),
+        ),
+        next_idx=jnp.int32(0),
+    )
+
+
+def _settled_slots(state: BacklogSimState,
+                   cfg: AvalancheConfig) -> jax.Array:
+    """bool [W]: occupied slots the network is done with.
+
+    A slot settles when every live node that reconciles it has finalized
+    (the batched version of "all 100 nodes fully finalized",
+    `examples/basic-preconcensus/main.go:159-161`), or its tx is invalid
+    (invalid targets stop polling, `processor.go:155-157`). Slots nobody
+    reconciles settle too — with gossip on this only happens for invalid
+    txs; without gossip it cannot happen because admission seeds all nodes.
+    """
+    sim = state.sim
+    occupied = state.slot_tx != NO_TX
+    fin = vr.has_finalized(sim.records.confidence, cfg)
+    pending = sim.added & sim.alive[:, None] & jnp.logical_not(fin)
+    return occupied & (jnp.logical_not(pending.any(axis=0))
+                       | jnp.logical_not(sim.valid))
+
+
+def _retire_and_refill(
+    state: BacklogSimState,
+    cfg: AvalancheConfig,
+) -> Tuple[BacklogSimState, jax.Array]:
+    """Write settled slots' outcomes to [B] outputs; refill from backlog.
+
+    Returns (new_state, n_retired). One scatter per output plane plus a
+    cumsum for slot→backlog assignment; static shapes.
+    """
+    sim = state.sim
+    n, w = sim.records.votes.shape
+    settled = _settled_slots(state, cfg)
+
+    # --- retire: scatter outcomes at the retiring slots' tx indices.
+    # Scatter index NO_TX is out-of-range (mode="drop" semantics) for
+    # non-settled lanes via clamping to a dummy: use where on the index and
+    # drop writes with mask trick — scatter with indices set to B (OOB) is
+    # dropped by jnp .at[].set(mode="drop").
+    b = state.backlog.score.shape[0]
+    conf = sim.records.confidence
+    fin = vr.has_finalized(conf, cfg)
+    acc = vr.is_accepted(conf)
+    # Votes among nodes that reconcile + finalized; majority of live nodes
+    # decides the recorded network outcome.
+    accept_votes = (fin & acc & sim.added).sum(axis=0).astype(jnp.int32)
+    n_live = jnp.maximum(sim.alive.sum().astype(jnp.int32), 1)
+    accepted = accept_votes * 2 > n_live
+
+    idx = jnp.where(settled, state.slot_tx, b)  # b = dropped write
+    out = state.outputs
+    out = BacklogOutputs(
+        settled=out.settled.at[idx].set(True, mode="drop"),
+        accepted=out.accepted.at[idx].set(accepted, mode="drop"),
+        accept_votes=out.accept_votes.at[idx].set(accept_votes, mode="drop"),
+        settle_round=out.settle_round.at[idx].set(sim.round, mode="drop"),
+        admit_round=out.admit_round.at[idx].set(state.slot_admit_round,
+                                                mode="drop"),
+    )
+
+    # --- refill: free slots take the next backlog txs in admission order.
+    free = settled | (state.slot_tx == NO_TX)
+    rank = jnp.cumsum(free.astype(jnp.int32)) - 1        # rank among free
+    cand = state.next_idx + rank                          # backlog position
+    take = free & (cand < b)
+    new_tx = jnp.where(take, cand, jnp.where(settled, NO_TX, state.slot_tx))
+    n_taken = take.sum().astype(jnp.int32)
+
+    cand_safe = jnp.clip(cand, 0, b - 1)
+    pref = state.backlog.init_pref[cand_safe]             # bool [W]
+    fresh = vr.init_state(jnp.broadcast_to(pref[None, :], (n, w)))
+
+    def fill(plane, fresh_plane):
+        return jnp.where(take[None, :], fresh_plane, plane)
+
+    records = vr.VoteRecordState(
+        votes=fill(sim.records.votes, fresh.votes),
+        consider=fill(sim.records.consider, fresh.consider),
+        confidence=fill(sim.records.confidence, fresh.confidence),
+    )
+    occupied_after = new_tx != NO_TX
+    # Admission seeds every node, as the reference example feeds every tx
+    # to every node up front (`main.go:49-53`); retired slots clear.
+    added = jnp.where(take[None, :], True,
+                      sim.added & occupied_after[None, :])
+    valid = jnp.where(take, state.backlog.valid[cand_safe],
+                      sim.valid & occupied_after)
+    score = jnp.where(occupied_after,
+                      state.backlog.score[jnp.clip(new_tx, 0, b - 1)],
+                      jnp.int32(-2**31 + 1))
+    finalized_at = jnp.where(take[None, :], -1, sim.finalized_at)
+
+    new_sim = sim._replace(
+        records=records,
+        added=added,
+        valid=valid,
+        score_rank=av.score_ranks(score),
+        finalized_at=finalized_at,
+    )
+    return BacklogSimState(
+        sim=new_sim,
+        slot_tx=new_tx,
+        slot_admit_round=jnp.where(take, sim.round, state.slot_admit_round),
+        backlog=state.backlog,
+        outputs=out,
+        next_idx=state.next_idx + n_taken,
+    ), settled.sum().astype(jnp.int32)
+
+
+class BacklogTelemetry(NamedTuple):
+    """Per-step scalars: the inner round's telemetry plus scheduler stats."""
+
+    round: av.SimTelemetry
+    retired: jax.Array    # int32 — slots retired this step
+    occupied: jax.Array   # int32 — occupied slots after refill
+    backlog_left: jax.Array  # int32 — txs not yet admitted
+
+
+def step(
+    state: BacklogSimState,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+) -> Tuple[BacklogSimState, BacklogTelemetry]:
+    """Retire/refill, then one consensus round on the window. Pure; scans."""
+    state, retired = _retire_and_refill(state, cfg)
+    new_sim, round_tel = av.round_step(state.sim, cfg)
+    new_state = state._replace(sim=new_sim)
+    tel = BacklogTelemetry(
+        round=round_tel,
+        retired=retired,
+        occupied=(state.slot_tx != NO_TX).sum().astype(jnp.int32),
+        backlog_left=state.backlog.score.shape[0] - state.next_idx,
+    )
+    return new_state, tel
+
+
+def drained(state: BacklogSimState,
+            cfg: AvalancheConfig = DEFAULT_CONFIG) -> jax.Array:
+    """True when the backlog is exhausted and every occupied slot settled."""
+    b = state.backlog.score.shape[0]
+    exhausted = state.next_idx >= b
+    occupied = state.slot_tx != NO_TX
+    return exhausted & jnp.logical_not(
+        (occupied & jnp.logical_not(_settled_slots(state, cfg))).any())
+
+
+def run(
+    state: BacklogSimState,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    max_rounds: int = 100_000,
+) -> BacklogSimState:
+    """Stream the whole backlog through the window; single compile.
+
+    A final retire pass harvests the last settled slots' outputs.
+    """
+
+    def cond(s: BacklogSimState) -> jax.Array:
+        return jnp.logical_not(drained(s, cfg)) & (s.sim.round < max_rounds)
+
+    def body(s: BacklogSimState) -> BacklogSimState:
+        new_s, _ = step(s, cfg)
+        return new_s
+
+    final = lax.while_loop(cond, body, state)
+    final, _ = _retire_and_refill(final, cfg)
+    return final
+
+
+def run_scan(
+    state: BacklogSimState,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    n_rounds: int = 1000,
+) -> Tuple[BacklogSimState, BacklogTelemetry]:
+    """Fixed-round run with stacked telemetry (bench/throughput curves)."""
+
+    def body(s: BacklogSimState, _):
+        new_s, tel = step(s, cfg)
+        return new_s, tel
+
+    return lax.scan(body, state, None, length=n_rounds)

@@ -56,15 +56,16 @@ def ensure_built(force: bool = False) -> str:
         subprocess.run(
             ["make", "-C", _NATIVE_DIR, "all"],
             check=True, capture_output=True, text=True)
-    except OSError as e:
+    except FileNotFoundError as e:
         # No make on this machine: a prebuilt library is the only candidate
         # (and with no toolchain there can be no freshly-edited sources to
-        # go stale against it).
+        # go stale against it).  Other OSErrors (EACCES, ENOMEM) propagate:
+        # a toolchain exists there, so serving a stale .so is the hazard.
         if os.path.exists(_LIB_PATH):
             return _LIB_PATH
         raise NativeBuildError(
             f"no native toolchain and no prebuilt library: {e}") from e
-    except subprocess.CalledProcessError as e:
+    except (OSError, subprocess.CalledProcessError) as e:
         detail = getattr(e, "stderr", "") or str(e)
         # Raise even when a stale .so exists; silently serving it would run
         # pre-edit code after a broken edit.

@@ -1,0 +1,297 @@
+"""Mesh-sharded streaming backlog: north-star scale in bounded HBM.
+
+`models/backlog` re-expressed under `jax.shard_map`: the dense ``[N, W]``
+window shards exactly like the plain simulator (`parallel/sharded.py`), the
+per-slot metadata shards with the txs axis, and the ``[B]`` backlog /
+output planes stay replicated (1M txs of metadata is ~MBs — noise next to
+the window state). The scheduler's collectives per step:
+
+  * **settle test**     — `psum` over the nodes axis of the per-slot
+    "some node still pending" bit (the reference's all-nodes-finalized
+    condition, `examples/basic-preconcensus/main.go:159-161`).
+  * **admission rank**  — an exclusive prefix over tx shards (all-gather of
+    k scalars) so free slots across shards take backlog entries in the
+    intended global score order without a cross-shard sort.
+  * **output merge**    — retiring shards scatter their txs' outcomes into
+    zero-initialized [B] planes; a `psum` over the txs axis merges them
+    (each tx occupies exactly one slot, so writes never collide). On a
+    nodes-only mesh this psum is a no-op.
+
+The inner consensus round is `parallel/sharded._local_round`, unchanged.
+
+Divergence from the unsharded scheduler (documented, tested): poll-order
+score ranks are computed per tx shard (global rank needs a cross-shard
+sort); with W <= max_element_poll — the recommended configuration — ranks
+never matter because nothing is truncated.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
+from go_avalanche_tpu.models import avalanche as av
+from go_avalanche_tpu.models.backlog import (
+    NO_TX,
+    Backlog,
+    BacklogOutputs,
+    BacklogSimState,
+    BacklogTelemetry,
+)
+from go_avalanche_tpu.ops import voterecord as vr
+from go_avalanche_tpu.parallel import sharded
+from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS
+
+
+def backlog_state_specs() -> BacklogSimState:
+    """PartitionSpecs for every leaf of `BacklogSimState`."""
+    return BacklogSimState(
+        sim=sharded.state_specs(),
+        slot_tx=P(TXS_AXIS),
+        slot_admit_round=P(TXS_AXIS),
+        backlog=Backlog(score=P(), init_pref=P(), valid=P()),
+        outputs=BacklogOutputs(settled=P(), accepted=P(), accept_votes=P(),
+                               settle_round=P(), admit_round=P()),
+        next_idx=P(),
+    )
+
+
+def shard_backlog_state(state: BacklogSimState, mesh) -> BacklogSimState:
+    """Place a host-built backlog state onto the mesh."""
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        state, backlog_state_specs())
+
+
+def _merge_write(old, idx, value, b):
+    """Replicated [B] plane update from per-shard scatters.
+
+    `idx` entries == b are dropped. Writes are unique per tx across shards,
+    so a psum of one-hot planes reconstructs them exactly.
+    """
+    dtype = old.dtype
+    # psum promotes bools; carry bool planes through int32 and cast back so
+    # scan carries keep their types.
+    vdt = jnp.int32 if dtype == jnp.bool_ else dtype
+    written = (jnp.zeros((b,), jnp.int32).at[idx].set(1, mode="drop"))
+    vals = (jnp.zeros((b,), vdt).at[idx].set(value.astype(vdt), mode="drop"))
+    written = lax.psum(written, TXS_AXIS)
+    vals = lax.psum(vals, TXS_AXIS)
+    return jnp.where(written > 0, vals.astype(dtype), old)
+
+
+def _local_settled(state: BacklogSimState, cfg: AvalancheConfig) -> jax.Array:
+    """bool [w_local]: globally-settled occupied slots (psum over nodes)."""
+    sim = state.sim
+    n_local = sim.records.votes.shape[0]
+    nshard = lax.axis_index(NODES_AXIS)
+    alive_local = lax.dynamic_slice(sim.alive, (nshard * n_local,),
+                                    (n_local,))
+    occupied = state.slot_tx != NO_TX
+    fin = vr.has_finalized(sim.records.confidence, cfg)
+    pending = sim.added & alive_local[:, None] & jnp.logical_not(fin)
+    pending_any = lax.psum(pending.any(axis=0).astype(jnp.int32),
+                           NODES_AXIS) > 0
+    return occupied & (jnp.logical_not(pending_any)
+                       | jnp.logical_not(sim.valid))
+
+
+def _local_retire_and_refill(
+    state: BacklogSimState,
+    cfg: AvalancheConfig,
+) -> Tuple[BacklogSimState, jax.Array]:
+    """The scheduler pass on one shard; see `models/backlog`. Returns
+    (new_state, globally-retired count)."""
+    sim = state.sim
+    n_local, w_local = sim.records.votes.shape
+    b = state.backlog.score.shape[0]
+    settled = _local_settled(state, cfg)
+
+    # --- retire: per-slot outcomes; node-axis sums via psum so every node
+    # shard computes identical [w_local] planes.
+    conf = sim.records.confidence
+    fin = vr.has_finalized(conf, cfg)
+    acc = vr.is_accepted(conf)
+    accept_votes = lax.psum(
+        (fin & acc & sim.added).sum(axis=0).astype(jnp.int32), NODES_AXIS)
+    n_live = jnp.maximum(sim.alive.sum().astype(jnp.int32), 1)
+    accepted = accept_votes * 2 > n_live
+
+    idx = jnp.where(settled, state.slot_tx, b)
+    out = state.outputs
+    out = BacklogOutputs(
+        settled=_merge_write(out.settled, idx,
+                             jnp.ones((w_local,), jnp.bool_), b),
+        accepted=_merge_write(out.accepted, idx, accepted, b),
+        accept_votes=_merge_write(out.accept_votes, idx, accept_votes, b),
+        settle_round=_merge_write(
+            out.settle_round, idx,
+            jnp.broadcast_to(sim.round, (w_local,)).astype(jnp.int32), b),
+        admit_round=_merge_write(out.admit_round, idx,
+                                 state.slot_admit_round, b),
+    )
+
+    # --- refill: global admission rank = exclusive prefix over tx shards.
+    free = settled | (state.slot_tx == NO_TX)
+    count_local = free.sum().astype(jnp.int32)
+    counts = lax.all_gather(count_local, TXS_AXIS)        # [n_tx_shards]
+    tshard = lax.axis_index(TXS_AXIS)
+    prefix = jnp.where(jnp.arange(counts.shape[0]) < tshard,
+                       counts, 0).sum()
+    rank = prefix + jnp.cumsum(free.astype(jnp.int32)) - 1
+    cand = state.next_idx + rank
+    take = free & (cand < b)
+    new_tx = jnp.where(take, cand, jnp.where(settled, NO_TX, state.slot_tx))
+    n_taken = lax.psum(take.sum().astype(jnp.int32), TXS_AXIS)
+
+    cand_safe = jnp.clip(cand, 0, b - 1)
+    pref = state.backlog.init_pref[cand_safe]
+    fresh = vr.init_state(jnp.broadcast_to(pref[None, :],
+                                           (n_local, w_local)))
+
+    def fill(plane, fresh_plane):
+        return jnp.where(take[None, :], fresh_plane, plane)
+
+    records = vr.VoteRecordState(
+        votes=fill(sim.records.votes, fresh.votes),
+        consider=fill(sim.records.consider, fresh.consider),
+        confidence=fill(sim.records.confidence, fresh.confidence),
+    )
+    occupied_after = new_tx != NO_TX
+    added = jnp.where(take[None, :], True,
+                      sim.added & occupied_after[None, :])
+    valid = jnp.where(take, state.backlog.valid[cand_safe],
+                      sim.valid & occupied_after)
+    score = jnp.where(occupied_after,
+                      state.backlog.score[jnp.clip(new_tx, 0, b - 1)],
+                      jnp.int32(-2**31 + 1))
+    finalized_at = jnp.where(take[None, :], -1, sim.finalized_at)
+
+    new_sim = sim._replace(
+        records=records,
+        added=added,
+        valid=valid,
+        score_rank=av.score_ranks(score),   # per-shard ranks (module note)
+        finalized_at=finalized_at,
+    )
+    retired = lax.psum(settled.sum().astype(jnp.int32), TXS_AXIS)
+    return BacklogSimState(
+        sim=new_sim,
+        slot_tx=new_tx,
+        slot_admit_round=jnp.where(take, sim.round, state.slot_admit_round),
+        backlog=state.backlog,
+        outputs=out,
+        next_idx=state.next_idx + n_taken,
+    ), retired
+
+
+def _local_step(
+    state: BacklogSimState,
+    cfg: AvalancheConfig,
+    n_global: int,
+    n_tx_shards: int,
+) -> Tuple[BacklogSimState, BacklogTelemetry]:
+    state, retired = _local_retire_and_refill(state, cfg)
+    new_sim, round_tel = sharded._local_round(state.sim, cfg, n_global,
+                                              n_tx_shards)
+    occupied = lax.psum((state.slot_tx != NO_TX).sum().astype(jnp.int32),
+                        TXS_AXIS)
+    tel = BacklogTelemetry(
+        round=round_tel,
+        retired=retired,
+        occupied=occupied,
+        backlog_left=state.backlog.score.shape[0] - state.next_idx,
+    )
+    return state._replace(sim=new_sim), tel
+
+
+def _shard_mapped(mesh, fn, with_tel=True):
+    specs = backlog_state_specs()
+    if with_tel:
+        tel_specs = BacklogTelemetry(
+            round=av.SimTelemetry(
+                *([P()] * len(av.SimTelemetry._fields))),
+            retired=P(), occupied=P(), backlog_left=P())
+        out_specs = (specs, tel_specs)
+    else:
+        out_specs = specs
+    return jax.shard_map(fn, mesh=mesh, in_specs=(specs,),
+                         out_specs=out_specs, check_vma=False)
+
+
+def make_sharded_backlog_step(mesh, cfg: AvalancheConfig = DEFAULT_CONFIG):
+    """Jitted (state) -> (state, telemetry) scheduler+round step."""
+    n_tx = mesh.shape[TXS_AXIS]
+    cache = {}
+
+    def step(state: BacklogSimState):
+        n_global = state.sim.records.votes.shape[0]
+        if n_global not in cache:
+            cache[n_global] = jax.jit(_shard_mapped(
+                mesh, lambda s: _local_step(s, cfg, n_global, n_tx)))
+        return cache[n_global](state)
+
+    return step
+
+
+def run_scan_sharded_backlog(
+    mesh,
+    state: BacklogSimState,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    n_rounds: int = 100,
+) -> Tuple[BacklogSimState, BacklogTelemetry]:
+    """Fixed-round sharded stream; one jit, collectives inside the scan."""
+    n_global = state.sim.records.votes.shape[0]
+    n_tx = mesh.shape[TXS_AXIS]
+
+    def local_scan(s):
+        def body(carry, _):
+            new_s, tel = _local_step(carry, cfg, n_global, n_tx)
+            return new_s, tel
+        return lax.scan(body, s, None, length=n_rounds)
+
+    return jax.jit(_shard_mapped(mesh, local_scan))(state)
+
+
+def run_sharded_backlog(
+    mesh,
+    state: BacklogSimState,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    max_rounds: int = 100_000,
+) -> BacklogSimState:
+    """Stream the whole backlog to settlement over the mesh; one jit.
+
+    Ends with a harvest pass so the last window's outcomes are recorded.
+    """
+    n_global = state.sim.records.votes.shape[0]
+    n_tx = mesh.shape[TXS_AXIS]
+
+    def local_run(s):
+        def undrained(st: BacklogSimState) -> jax.Array:
+            b = st.backlog.score.shape[0]
+            unsettled = ((st.slot_tx != NO_TX)
+                         & jnp.logical_not(_local_settled(st, cfg)))
+            any_left = lax.psum(unsettled.any().astype(jnp.int32),
+                                TXS_AXIS) > 0
+            return (st.next_idx < b) | any_left
+
+        def cond(carry):
+            st, live = carry
+            return live & (st.sim.round < max_rounds)
+
+        def body(carry):
+            st, _ = carry
+            new_st, _ = _local_step(st, cfg, n_global, n_tx)
+            return new_st, undrained(new_st)
+
+        final, _ = lax.while_loop(cond, body, (s, undrained(s)))
+        final, _ = _local_retire_and_refill(final, cfg)
+        return final
+
+    return jax.jit(_shard_mapped(mesh, local_run, with_tel=False))(state)

@@ -13,7 +13,9 @@ harnesses.
         --trace /tmp/xprof
 
 Models: `snowball` — [nodes] single-decree; `avalanche` — [nodes, txs]
-multi-target with gossip; `dag` — conflict-set double-spend resolution.
+multi-target with gossip; `dag` — conflict-set double-spend resolution;
+`backlog` — `--txs` pending txs streamed through a `--slots` working-set
+window in bounded HBM (the north-star 1M-tx path).
 """
 
 from __future__ import annotations
@@ -113,10 +115,36 @@ def run_dag(args, cfg: AvalancheConfig) -> Dict:
     }
 
 
+def run_backlog(args, cfg: AvalancheConfig) -> Dict:
+    """Streaming working-set run: `--txs` pending txs through a `--slots`
+    working-set window (models/backlog) — the bounded-HBM north-star path."""
+    from go_avalanche_tpu.models import backlog as bl
+
+    b = bl.make_backlog(jnp.arange(args.txs, dtype=jnp.int32))
+    state = bl.init(jax.random.key(args.seed), args.nodes, args.slots, b,
+                    cfg)
+    final = jax.jit(bl.run, static_argnames=("cfg", "max_rounds"))(
+        state, cfg, args.max_rounds)
+    out = jax.device_get(final.outputs)
+    settled = np.asarray(out.settled)
+    latency = (np.asarray(out.settle_round)
+               - np.asarray(out.admit_round))[settled]
+    return {
+        "rounds": int(jax.device_get(final.sim.round)),
+        "slots": args.slots,
+        "settled_fraction": float(settled.mean()),
+        "accepted_fraction": float(np.asarray(out.accepted)[settled].mean())
+        if settled.any() else None,
+        "settle_latency_median": float(np.median(latency))
+        if settled.any() else None,
+    }
+
+
 def main(argv=None) -> Dict:
     parser = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
-    parser.add_argument("--model", choices=["snowball", "avalanche", "dag"],
+    parser.add_argument("--model",
+                        choices=["snowball", "avalanche", "dag", "backlog"],
                         default="avalanche")
     parser.add_argument("--nodes", type=int, default=256)
     parser.add_argument("--txs", type=int, default=64)
@@ -139,6 +167,8 @@ def main(argv=None) -> Dict:
                         help="snowball: initial yes-preference fraction")
     parser.add_argument("--conflict-size", type=int, default=2,
                         help="dag: txs per conflict set")
+    parser.add_argument("--slots", type=int, default=64,
+                        help="backlog: active working-set slots")
     # fault model
     parser.add_argument("--byzantine", type=float, default=0.0)
     parser.add_argument("--flip-probability", type=float, default=1.0)
@@ -153,7 +183,7 @@ def main(argv=None) -> Dict:
 
     cfg = build_config(args)
     runner = {"snowball": run_snowball, "avalanche": run_avalanche,
-              "dag": run_dag}[args.model]
+              "dag": run_dag, "backlog": run_backlog}[args.model]
 
     ctx = tracing.trace(args.trace) if args.trace else contextlib.nullcontext()
     t0 = time.perf_counter()

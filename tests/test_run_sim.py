@@ -49,3 +49,19 @@ def test_cli_trace_writes_profile(tmp_path, capsys):
           "--finalization-score", "8", "--trace", trace_dir])
     found = [f for _, _, files in os.walk(trace_dir) for f in files]
     assert found
+
+
+def test_cli_backlog_streams_all_txs(capsys):
+    result = main(["--model", "backlog", "--nodes", "24", "--txs", "20",
+                   "--slots", "4", "--finalization-score", "16", "--json"])
+    assert result["settled_fraction"] == 1.0
+    assert result["accepted_fraction"] == 1.0
+    assert result["settle_latency_median"] >= 1
+    line = capsys.readouterr().out.strip()
+    assert json.loads(line)["slots"] == 4
+
+
+def test_cli_exit_status_zero():
+    from go_avalanche_tpu.run_sim import cli
+    assert cli(["--model", "snowball", "--nodes", "32",
+                "--finalization-score", "16", "--json"]) == 0
