@@ -142,11 +142,14 @@ def _settled_slots(state: BacklogSimState,
 def _retire_and_refill(
     state: BacklogSimState,
     cfg: AvalancheConfig,
+    refill: bool = True,
 ) -> Tuple[BacklogSimState, jax.Array]:
     """Write settled slots' outcomes to [B] outputs; refill from backlog.
 
     Returns (new_state, n_retired). One scatter per output plane plus a
-    cumsum for slot→backlog assignment; static shapes.
+    cumsum for slot→backlog assignment; static shapes. With `refill=False`
+    (the end-of-run harvest) settled slots empty instead of taking new
+    txs, so `next_idx` never counts txs that were admitted but not polled.
     """
     sim = state.sim
     n, w = sim.records.votes.shape
@@ -183,6 +186,8 @@ def _retire_and_refill(
     rank = jnp.cumsum(free.astype(jnp.int32)) - 1        # rank among free
     cand = state.next_idx + rank                          # backlog position
     take = free & (cand < b)
+    if not refill:
+        take = jnp.zeros_like(take)
     new_tx = jnp.where(take, cand, jnp.where(settled, NO_TX, state.slot_tx))
     n_taken = take.sum().astype(jnp.int32)
 
@@ -281,7 +286,7 @@ def run(
         return new_s
 
     final = lax.while_loop(cond, body, state)
-    final, _ = _retire_and_refill(final, cfg)
+    final, _ = _retire_and_refill(final, cfg, refill=False)
     return final
 
 

@@ -1,0 +1,207 @@
+"""Slush and Snowflake: the rest of the Avalanche protocol family.
+
+The reference implements only the Snowball vote-record machine
+(`vote.go:24-98`, transcribed from Bitcoin ABC) but its stated purpose is
+"creation of Avalanche-based consensus systems" (`README.md:11`) and it
+links the Avalanche paper (`README.md:15`), whose protocol family is
+
+    Slush      — memoryless: adopt any alpha-majority color seen in a poll;
+                 run a fixed number of rounds.
+    Snowflake  — Slush + a conviction counter: accept a color after beta
+                 consecutive alpha-majority polls for it; any flip resets.
+    Snowball   — Snowflake + per-color confidence (the reference's windowed
+                 variant lives in `models/snowball.py` / `ops/voterecord`).
+    Avalanche  — Snowball over a DAG of conflict sets (`models/dag.py`).
+
+These two single-decree models complete the family for protocol-comparison
+sweeps (rounds-to-finality and safety-failure curves across the family are
+the paper's fig. 2-4). Both reuse the simulator's peer-sampling and fault
+model; parameters map as: k = cfg.k, alpha = cfg.alpha, beta =
+cfg.finalization_score, m (slush rounds) = caller's round budget.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
+from go_avalanche_tpu.ops.sampling import sample_peers_uniform
+
+
+class SlushState(NamedTuple):
+    """``[N]`` color plane + fault masks; no per-node memory beyond color."""
+
+    color: jax.Array      # bool [N] — current color (True = yes)
+    byzantine: jax.Array  # bool [N]
+    alive: jax.Array      # bool [N]
+    round: jax.Array      # int32 scalar
+    key: jax.Array        # PRNG key
+
+
+class SnowflakeState(NamedTuple):
+    """Slush plus a conviction counter and acceptance stamp."""
+
+    color: jax.Array        # bool [N]
+    count: jax.Array        # int32 [N] — consecutive successes for color
+    accepted_at: jax.Array  # int32 [N] — round of acceptance; -1 before
+    byzantine: jax.Array    # bool [N]
+    alive: jax.Array        # bool [N]
+    round: jax.Array        # int32 scalar
+    key: jax.Array          # PRNG key
+
+
+class FamilyTelemetry(NamedTuple):
+    yes_colors: jax.Array   # int32 — nodes currently colored yes
+    switches: jax.Array     # int32 — nodes that changed color this round
+    accepted: jax.Array     # int32 — nodes accepted so far (0 for slush)
+
+
+def _init_colors(key, n_nodes, cfg, yes_fraction):
+    k_pref, k_next = jax.random.split(key)
+    color = jax.random.bernoulli(k_pref, yes_fraction, (n_nodes,))
+    n_byz = int(round(cfg.byzantine_fraction * n_nodes))
+    byzantine = jnp.arange(n_nodes) < n_byz
+    return color, byzantine, k_next
+
+
+def _poll_majorities(state, cfg: AvalancheConfig):
+    """Shared poll: sample k peers, apply faults, return (yes_maj, no_maj,
+    churned alive mask, next key) — the alpha-majority test both protocols
+    share."""
+    n = state.color.shape[0]
+    k_sample, k_byz, k_drop, k_churn, k_next = jax.random.split(state.key, 5)
+
+    peers = sample_peers_uniform(k_sample, n, cfg.k, cfg.exclude_self)
+    votes = state.color[peers]                                # [N, k]
+    flip = (state.byzantine[peers]
+            & jax.random.bernoulli(k_byz, cfg.flip_probability, peers.shape))
+    votes = jnp.logical_xor(votes, flip)
+    responded = state.alive[peers]
+    if cfg.drop_probability > 0.0:
+        responded &= ~jax.random.bernoulli(k_drop, cfg.drop_probability,
+                                           peers.shape)
+
+    thresh = math.ceil(cfg.alpha * cfg.k)
+    yes_cnt = (votes & responded).sum(axis=1)
+    no_cnt = (jnp.logical_not(votes) & responded).sum(axis=1)
+
+    alive = state.alive
+    if cfg.churn_probability > 0.0:
+        toggle = jax.random.bernoulli(k_churn, cfg.churn_probability, (n,))
+        alive = jnp.logical_xor(alive, toggle)
+    return yes_cnt >= thresh, no_cnt >= thresh, alive, k_next
+
+
+# --------------------------------------------------------------------------
+# Slush
+
+
+def slush_init(key, n_nodes: int, cfg: AvalancheConfig = DEFAULT_CONFIG,
+               yes_fraction: float = 0.5) -> SlushState:
+    color, byzantine, k_next = _init_colors(key, n_nodes, cfg, yes_fraction)
+    return SlushState(color=color, byzantine=byzantine,
+                      alive=jnp.ones((n_nodes,), jnp.bool_),
+                      round=jnp.int32(0), key=k_next)
+
+
+def slush_round(state: SlushState,
+                cfg: AvalancheConfig = DEFAULT_CONFIG,
+                ) -> Tuple[SlushState, FamilyTelemetry]:
+    """One memoryless round: adopt whichever color won an alpha-majority."""
+    yes_maj, no_maj, alive, k_next = _poll_majorities(state, cfg)
+    new_color = jnp.where(yes_maj, True,
+                          jnp.where(no_maj, False, state.color))
+    new_color = jnp.where(state.alive, new_color, state.color)
+    tel = FamilyTelemetry(
+        yes_colors=new_color.sum().astype(jnp.int32),
+        switches=(new_color != state.color).sum().astype(jnp.int32),
+        accepted=jnp.int32(0),
+    )
+    return SlushState(color=new_color, byzantine=state.byzantine,
+                      alive=alive, round=state.round + 1, key=k_next), tel
+
+
+def slush_run(state: SlushState, cfg: AvalancheConfig = DEFAULT_CONFIG,
+              m_rounds: int = 100) -> Tuple[SlushState, FamilyTelemetry]:
+    """The paper's Slush loop: exactly m rounds, stacked telemetry."""
+
+    def body(s, _):
+        new_s, t = slush_round(s, cfg)
+        return new_s, t
+
+    return lax.scan(body, state, None, length=m_rounds)
+
+
+# --------------------------------------------------------------------------
+# Snowflake
+
+
+def snowflake_init(key, n_nodes: int,
+                   cfg: AvalancheConfig = DEFAULT_CONFIG,
+                   yes_fraction: float = 0.5) -> SnowflakeState:
+    color, byzantine, k_next = _init_colors(key, n_nodes, cfg, yes_fraction)
+    n = n_nodes
+    return SnowflakeState(color=color, count=jnp.zeros((n,), jnp.int32),
+                          accepted_at=jnp.full((n,), -1, jnp.int32),
+                          byzantine=byzantine,
+                          alive=jnp.ones((n,), jnp.bool_),
+                          round=jnp.int32(0), key=k_next)
+
+
+def snowflake_round(state: SnowflakeState,
+                    cfg: AvalancheConfig = DEFAULT_CONFIG,
+                    ) -> Tuple[SnowflakeState, FamilyTelemetry]:
+    """One round: alpha-majority for my color -> count += 1; for the other
+    -> switch and count = 1; inconclusive -> count = 0 (the paper resets on
+    any unsuccessful query). Accepted nodes are frozen but keep answering
+    polls with their accepted color."""
+    beta = cfg.finalization_score
+    accepted = state.accepted_at >= 0
+    yes_maj, no_maj, alive, k_next = _poll_majorities(state, cfg)
+
+    maj_for_mine = jnp.where(state.color, yes_maj, no_maj)
+    maj_for_other = jnp.where(state.color, no_maj, yes_maj)
+    new_color = jnp.where(maj_for_other, jnp.logical_not(state.color),
+                          state.color)
+    new_count = jnp.where(maj_for_mine, state.count + 1,
+                          jnp.where(maj_for_other, jnp.int32(1),
+                                    jnp.int32(0)))
+
+    frozen = accepted | jnp.logical_not(state.alive)
+    new_color = jnp.where(frozen, state.color, new_color)
+    new_count = jnp.where(frozen, state.count, new_count)
+
+    newly_accepted = (new_count >= beta) & jnp.logical_not(accepted)
+    accepted_at = jnp.where(newly_accepted, state.round, state.accepted_at)
+
+    tel = FamilyTelemetry(
+        yes_colors=new_color.sum().astype(jnp.int32),
+        switches=((new_color != state.color)
+                  & jnp.logical_not(frozen)).sum().astype(jnp.int32),
+        accepted=(accepted_at >= 0).sum().astype(jnp.int32),
+    )
+    return SnowflakeState(color=new_color, count=new_count,
+                          accepted_at=accepted_at,
+                          byzantine=state.byzantine, alive=alive,
+                          round=state.round + 1, key=k_next), tel
+
+
+def snowflake_run(state: SnowflakeState,
+                  cfg: AvalancheConfig = DEFAULT_CONFIG,
+                  max_rounds: int = 10_000) -> SnowflakeState:
+    """Run until every live node accepted (or `max_rounds`); one compile."""
+
+    def cond(s: SnowflakeState) -> jax.Array:
+        live_undone = ((s.accepted_at < 0) & s.alive).any()
+        return live_undone & (s.round < max_rounds)
+
+    def body(s: SnowflakeState) -> SnowflakeState:
+        new_s, _ = snowflake_round(s, cfg)
+        return new_s
+
+    return lax.while_loop(cond, body, state)

@@ -105,6 +105,7 @@ def _local_settled(state: BacklogSimState, cfg: AvalancheConfig) -> jax.Array:
 def _local_retire_and_refill(
     state: BacklogSimState,
     cfg: AvalancheConfig,
+    refill: bool = True,
 ) -> Tuple[BacklogSimState, jax.Array]:
     """The scheduler pass on one shard; see `models/backlog`. Returns
     (new_state, globally-retired count)."""
@@ -147,6 +148,8 @@ def _local_retire_and_refill(
     rank = prefix + jnp.cumsum(free.astype(jnp.int32)) - 1
     cand = state.next_idx + rank
     take = free & (cand < b)
+    if not refill:   # end-of-run harvest: record outcomes, admit nothing
+        take = jnp.zeros_like(take)
     new_tx = jnp.where(take, cand, jnp.where(settled, NO_TX, state.slot_tx))
     n_taken = lax.psum(take.sum().astype(jnp.int32), TXS_AXIS)
 
@@ -291,7 +294,7 @@ def run_sharded_backlog(
             return new_st, undrained(new_st)
 
         final, _ = lax.while_loop(cond, body, (s, undrained(s)))
-        final, _ = _local_retire_and_refill(final, cfg)
+        final, _ = _local_retire_and_refill(final, cfg, refill=False)
         return final
 
     return jax.jit(_shard_mapped(mesh, local_run, with_tel=False))(state)

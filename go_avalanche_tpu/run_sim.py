@@ -12,10 +12,12 @@ harnesses.
     python -m go_avalanche_tpu.run_sim --model snowball --nodes 4096 \
         --trace /tmp/xprof
 
-Models: `snowball` — [nodes] single-decree; `avalanche` — [nodes, txs]
-multi-target with gossip; `dag` — conflict-set double-spend resolution;
-`backlog` — `--txs` pending txs streamed through a `--slots` working-set
-window in bounded HBM (the north-star 1M-tx path).
+Models: `slush` / `snowflake` — the paper's simpler family members
+(models/family); `snowball` — [nodes] single-decree with the reference's
+windowed record; `avalanche` — [nodes, txs] multi-target with gossip;
+`dag` — conflict-set double-spend resolution; `backlog` — `--txs` pending
+txs streamed through a `--slots` working-set window in bounded HBM (the
+north-star 1M-tx path).
 """
 
 from __future__ import annotations
@@ -115,6 +117,43 @@ def run_dag(args, cfg: AvalancheConfig) -> Dict:
     }
 
 
+def run_slush(args, cfg: AvalancheConfig) -> Dict:
+    from go_avalanche_tpu.models import family as fam
+
+    state = fam.slush_init(jax.random.key(args.seed), args.nodes, cfg,
+                           yes_fraction=args.yes_fraction)
+    final, tel = jax.jit(fam.slush_run,
+                         static_argnames=("cfg", "m_rounds"))(
+        state, cfg, args.max_rounds)
+    colors = np.asarray(jax.device_get(final.color))
+    return {
+        "rounds": int(jax.device_get(final.round)),
+        "yes_fraction_final": float(colors.mean()),
+        "converged": bool(colors.mean() > 0.95 or colors.mean() < 0.05),
+    }
+
+
+def run_snowflake(args, cfg: AvalancheConfig) -> Dict:
+    from go_avalanche_tpu.models import family as fam
+
+    state = fam.snowflake_init(jax.random.key(args.seed), args.nodes, cfg,
+                               yes_fraction=args.yes_fraction)
+    final = jax.jit(fam.snowflake_run,
+                    static_argnames=("cfg", "max_rounds"))(
+        state, cfg, args.max_rounds)
+    acc = np.asarray(jax.device_get(final.accepted_at))
+    colors = np.asarray(jax.device_get(final.color))
+    done = acc >= 0
+    return {
+        "rounds": int(jax.device_get(final.round)),
+        "accepted_fraction": float(done.mean()),
+        "yes_fraction_final": float(colors[done].mean())
+        if done.any() else None,
+        "accept_round_median": float(np.median(acc[done]))
+        if done.any() else None,
+    }
+
+
 def run_backlog(args, cfg: AvalancheConfig) -> Dict:
     """Streaming working-set run: `--txs` pending txs through a `--slots`
     working-set window (models/backlog) — the bounded-HBM north-star path."""
@@ -144,7 +183,8 @@ def main(argv=None) -> Dict:
     parser = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
     parser.add_argument("--model",
-                        choices=["snowball", "avalanche", "dag", "backlog"],
+                        choices=["slush", "snowflake", "snowball",
+                                 "avalanche", "dag", "backlog"],
                         default="avalanche")
     parser.add_argument("--nodes", type=int, default=256)
     parser.add_argument("--txs", type=int, default=64)
@@ -164,7 +204,8 @@ def main(argv=None) -> Dict:
     parser.add_argument("--weighted", action="store_true",
                         help="latency-weighted peer sampling")
     parser.add_argument("--yes-fraction", type=float, default=1.0,
-                        help="snowball: initial yes-preference fraction")
+                        help="slush/snowflake/snowball: initial "
+                             "yes-preference fraction")
     parser.add_argument("--conflict-size", type=int, default=2,
                         help="dag: txs per conflict set")
     parser.add_argument("--slots", type=int, default=64,
@@ -182,7 +223,8 @@ def main(argv=None) -> Dict:
     args = parser.parse_args(argv)
 
     cfg = build_config(args)
-    runner = {"snowball": run_snowball, "avalanche": run_avalanche,
+    runner = {"slush": run_slush, "snowflake": run_snowflake,
+              "snowball": run_snowball, "avalanche": run_avalanche,
               "dag": run_dag, "backlog": run_backlog}[args.model]
 
     ctx = tracing.trace(args.trace) if args.trace else contextlib.nullcontext()
@@ -192,7 +234,8 @@ def main(argv=None) -> Dict:
     result = {
         "model": args.model,
         "nodes": args.nodes,
-        "txs": args.txs if args.model != "snowball" else 1,
+        "txs": args.txs
+        if args.model not in ("snowball", "slush", "snowflake") else 1,
         "backend": jax.devices()[0].platform,
         **result,
         "elapsed_s": round(time.perf_counter() - t0, 3),

@@ -139,3 +139,19 @@ def test_byzantine_stream_still_drains(byz):
     cfg = AvalancheConfig(byzantine_fraction=byz)
     final = run_stream(n_nodes=32, n_txs=8, window=4, cfg=cfg)
     assert np.asarray(final.outputs.settled).all()
+
+
+def test_capped_run_harvest_does_not_admit():
+    """A max_rounds-capped run must not admit txs it will never poll."""
+    cfg = AvalancheConfig()
+    b = bl.make_backlog(jnp.arange(40, dtype=jnp.int32))
+    state = bl.init(jax.random.key(0), 8, 4, b, cfg)
+    # 17 rounds: the first window settles exactly at the cap, so the loop
+    # exits with settled-but-unretired slots for the harvest to record
+    capped = jax.jit(bl.run, static_argnames=("cfg", "max_rounds"))(
+        state, cfg, 17)
+    settled = int(np.asarray(capped.outputs.settled).sum())
+    # harvest recorded the settled window without admitting replacements
+    assert settled == 4
+    assert int(capped.next_idx) == 4
+    assert not bool(bl.drained(capped, cfg))

@@ -1,0 +1,109 @@
+"""Slush / Snowflake protocol-family models (`models/family.py`).
+
+Paper properties under test: Slush drives a split network to a
+supermajority color in O(log n) rounds; Snowflake reaches unanimous
+acceptance (agreement + termination) in honest networks, its counter
+resets on inconclusive polls, and acceptance survives a Byzantine
+minority below the alpha threshold.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_avalanche_tpu.config import AvalancheConfig
+from go_avalanche_tpu.models import family as fam
+
+
+def test_slush_converges_from_even_split():
+    cfg = AvalancheConfig()
+    state = fam.slush_init(jax.random.key(0), 512, cfg, yes_fraction=0.5)
+    final, tel = jax.jit(fam.slush_run,
+                         static_argnames=("cfg", "m_rounds"))(state, cfg, 80)
+    colors = np.asarray(final.color)
+    frac = colors.mean()
+    # metastable split must break: supermajority one way or the other
+    assert frac > 0.95 or frac < 0.05
+    assert int(final.round) == 80
+    # switches should die out once converged
+    assert int(np.asarray(tel.switches)[-1]) <= 5
+
+
+def test_slush_biased_split_goes_to_majority():
+    cfg = AvalancheConfig()
+    state = fam.slush_init(jax.random.key(1), 512, cfg, yes_fraction=0.9)
+    final, _ = jax.jit(fam.slush_run,
+                       static_argnames=("cfg", "m_rounds"))(state, cfg, 80)
+    assert np.asarray(final.color).mean() > 0.95
+
+
+def test_snowflake_unanimous_acceptance_honest():
+    cfg = AvalancheConfig(finalization_score=16)
+    state = fam.snowflake_init(jax.random.key(2), 256, cfg,
+                               yes_fraction=1.0)
+    final = jax.jit(fam.snowflake_run,
+                    static_argnames=("cfg", "max_rounds"))(state, cfg, 2000)
+    acc = np.asarray(final.accepted_at)
+    assert (acc >= 0).all()
+    assert np.asarray(final.color).all()            # agreement on yes
+    # beta consecutive successes needed before acceptance
+    assert (acc >= cfg.finalization_score - 1).all()
+
+
+def test_snowflake_agreement_from_split():
+    """Safety: whatever the network decides, it decides unanimously."""
+    cfg = AvalancheConfig(finalization_score=8)
+    state = fam.snowflake_init(jax.random.key(3), 256, cfg,
+                               yes_fraction=0.5)
+    final = jax.jit(fam.snowflake_run,
+                    static_argnames=("cfg", "max_rounds"))(state, cfg, 4000)
+    acc = np.asarray(final.accepted_at) >= 0
+    colors = np.asarray(final.color)
+    assert acc.all()
+    assert colors.all() or not colors.any()
+
+
+def test_snowflake_counter_resets_on_inconclusive():
+    """With k=8 alpha=0.8, dropped responses make ~1/3 of polls
+    inconclusive; the resulting counter resets push acceptance far past the
+    beta-round lower bound."""
+    cfg = AvalancheConfig(finalization_score=8, drop_probability=0.15)
+    state = fam.snowflake_init(jax.random.key(4), 128, cfg,
+                               yes_fraction=1.0)
+    final = jax.jit(fam.snowflake_run,
+                    static_argnames=("cfg", "max_rounds"))(state, cfg, 4000)
+    acc = np.asarray(final.accepted_at)
+    done = acc >= 0
+    assert done.mean() > 0.9
+    # resets push median acceptance well past the no-fault lower bound
+    assert np.median(acc[done]) > cfg.finalization_score
+
+
+@pytest.mark.parametrize("byz", [0.1])
+def test_snowflake_survives_byzantine_minority(byz):
+    cfg = AvalancheConfig(finalization_score=8, byzantine_fraction=byz)
+    state = fam.snowflake_init(jax.random.key(5), 256, cfg,
+                               yes_fraction=1.0)
+    final = jax.jit(fam.snowflake_run,
+                    static_argnames=("cfg", "max_rounds"))(state, cfg, 4000)
+    honest = ~np.asarray(final.byzantine)
+    acc = np.asarray(final.accepted_at) >= 0
+    colors = np.asarray(final.color)
+    assert acc[honest].mean() > 0.95
+    assert colors[honest & acc].all()
+
+
+def test_family_deterministic():
+    cfg = AvalancheConfig(finalization_score=8)
+    runs = []
+    for _ in range(2):
+        state = fam.snowflake_init(jax.random.key(9), 64, cfg)
+        final = jax.jit(fam.snowflake_run,
+                        static_argnames=("cfg", "max_rounds"))(state, cfg,
+                                                               2000)
+        runs.append(jax.device_get(final))
+    np.testing.assert_array_equal(np.asarray(runs[0].color),
+                                  np.asarray(runs[1].color))
+    np.testing.assert_array_equal(np.asarray(runs[0].accepted_at),
+                                  np.asarray(runs[1].accepted_at))

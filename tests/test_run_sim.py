@@ -65,3 +65,15 @@ def test_cli_exit_status_zero():
     from go_avalanche_tpu.run_sim import cli
     assert cli(["--model", "snowball", "--nodes", "32",
                 "--finalization-score", "16", "--json"]) == 0
+
+
+def test_cli_slush_and_snowflake(capsys):
+    r1 = main(["--model", "slush", "--nodes", "128", "--max-rounds", "60",
+               "--json"])
+    assert r1["converged"]
+    r2 = main(["--model", "snowflake", "--nodes", "128",
+               "--finalization-score", "8", "--yes-fraction", "1.0",
+               "--json"])
+    assert r2["accepted_fraction"] == 1.0
+    assert r2["yes_fraction_final"] == 1.0
+    capsys.readouterr()
