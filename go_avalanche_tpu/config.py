@@ -34,6 +34,22 @@ class VoteMode(enum.Enum):
     MAJORITY = "majority"
 
 
+class AdversaryStrategy(enum.Enum):
+    """What a byzantine peer answers when it lies (see `ops/adversary.py`).
+
+    FLIP — the opposite of its true preference: the reference's
+    commented-out hook (`examples/basic-preconcensus/main.go:184-187`).
+    EQUIVOCATE — a fresh coin per (querier, draw[, target]); the same peer
+    tells different queriers different things within one round.
+    OPPOSE_MAJORITY — the current global minority color; the Avalanche
+    paper's liveness adversary, pulling the network back toward a split.
+    """
+
+    FLIP = "flip"
+    EQUIVOCATE = "equivocate"
+    OPPOSE_MAJORITY = "oppose_majority"
+
+
 @dataclasses.dataclass(frozen=True)
 class AvalancheConfig:
     """All protocol constants of the reference plus simulator knobs.
@@ -85,7 +101,9 @@ class AvalancheConfig:
 
     # --- fault / adversary model (SURVEY.md section 2.4 item 5) ---
     byzantine_fraction: float = 0.0   # nodes that vote adversarially
-    flip_probability: float = 1.0     # P(byzantine node flips its vote)
+    flip_probability: float = 1.0     # P(byzantine node lies, per draw)
+    adversary_strategy: AdversaryStrategy = AdversaryStrategy.FLIP
+                                      # what the lie says (ops/adversary.py)
     drop_probability: float = 0.0     # P(a sampled peer fails to respond
                                       #   => neutral vote, vote.go:56 semantics)
     churn_probability: float = 0.0    # P(a node toggles dead<->alive, per

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG, VoteMode
-from go_avalanche_tpu.ops import voterecord as vr
+from go_avalanche_tpu.ops import adversary, voterecord as vr
 from go_avalanche_tpu.ops.bitops import (
     pack_bool_plane,
     popcount8,
@@ -189,10 +189,10 @@ def round_step(
             peers = sample_peers_uniform(k_sample, n, cfg.k, cfg.exclude_self)
             self_draw = None
 
-    # --- response model: byzantine flips and dropped responses, decided
-    # per (poller, draw) — a byzantine peer flips its whole response.
-    flip = (state.byzantine[peers]
-            & jax.random.bernoulli(k_byz, cfg.flip_probability, peers.shape))
+    # --- response model: byzantine lies and dropped responses, decided
+    # per (poller, draw) — a lying peer's whole response is transformed per
+    # `cfg.adversary_strategy` (ops/adversary.py).
+    lie = adversary.lie_mask(k_byz, peers, state.byzantine, cfg)
     responded = state.alive[peers]
     if self_draw is not None:
         responded &= jnp.logical_not(self_draw)
@@ -223,11 +223,13 @@ def round_step(
     with annotate("gather_prefs"):
         prefs = vr.is_accepted(state.records.confidence)   # [N, T]
         packed_prefs = pack_bool_plane(prefs)              # [N, ceil(T/8)]
+        minority_t = adversary.minority_plane(prefs)       # [T]
         yes_pack = jnp.zeros((n, t), jnp.uint8)
         consider_pack = jnp.zeros((n, t), jnp.uint8)
         for j in range(cfg.k):
             vote_j = unpack_bool_plane(packed_prefs[peers[:, j]], t)
-            vote_j = jnp.logical_xor(vote_j, flip[:, j][:, None])
+            vote_j = adversary.apply_plane(k_byz, j, vote_j, lie[:, j], cfg,
+                                           minority_t)
             yes_pack |= vote_j.astype(jnp.uint8) << jnp.uint8(j)
             consider_pack |= (responded[:, j].astype(jnp.uint8)
                               << jnp.uint8(j))[:, None]

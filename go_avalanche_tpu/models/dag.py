@@ -34,7 +34,7 @@ from jax import lax
 
 from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
 from go_avalanche_tpu.models import avalanche as av
-from go_avalanche_tpu.ops import voterecord as vr
+from go_avalanche_tpu.ops import adversary, voterecord as vr
 from go_avalanche_tpu.ops.sampling import sample_peers_uniform
 
 
@@ -142,8 +142,7 @@ def round_step(
                                  cfg.max_element_poll)
 
     peers = sample_peers_uniform(k_sample, n, cfg.k, cfg.exclude_self)
-    flip = (base.byzantine[peers]
-            & jax.random.bernoulli(k_byz, cfg.flip_probability, peers.shape))
+    lie = adversary.lie_mask(k_byz, peers, base.byzantine, cfg)
     responded = base.alive[peers]
     if cfg.drop_probability > 0.0:
         responded &= ~jax.random.bernoulli(k_drop, cfg.drop_probability,
@@ -152,11 +151,13 @@ def round_step(
     # Responses: yes iff the tx is the peer's preferred member of its set.
     prefs = preferred_in_set(base.records.confidence, state.conflict_set,
                              state.n_sets)
+    minority_t = adversary.minority_plane(prefs)
     yes_pack = jnp.zeros((n, t), jnp.uint8)
     consider_pack = jnp.zeros((n, t), jnp.uint8)
     for j in range(cfg.k):
         vote_j = prefs[peers[:, j]]
-        vote_j = jnp.logical_xor(vote_j, flip[:, j][:, None])
+        vote_j = adversary.apply_plane(k_byz, j, vote_j, lie[:, j], cfg,
+                                       minority_t)
         yes_pack |= vote_j.astype(jnp.uint8) << jnp.uint8(j)
         consider_pack |= (responded[:, j].astype(jnp.uint8)
                           << jnp.uint8(j))[:, None]

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
+from go_avalanche_tpu.ops import adversary
 from go_avalanche_tpu.ops.sampling import sample_peers_uniform
 
 
@@ -78,9 +79,8 @@ def _poll_majorities(state, cfg: AvalancheConfig):
 
     peers = sample_peers_uniform(k_sample, n, cfg.k, cfg.exclude_self)
     votes = state.color[peers]                                # [N, k]
-    flip = (state.byzantine[peers]
-            & jax.random.bernoulli(k_byz, cfg.flip_probability, peers.shape))
-    votes = jnp.logical_xor(votes, flip)
+    lie = adversary.lie_mask(k_byz, peers, state.byzantine, cfg)
+    votes = adversary.apply_1d(k_byz, votes, lie, cfg, state.color)
     responded = state.alive[peers]
     if cfg.drop_probability > 0.0:
         responded &= ~jax.random.bernoulli(k_drop, cfg.drop_probability,

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG, VoteMode
-from go_avalanche_tpu.ops import voterecord as vr
+from go_avalanche_tpu.ops import adversary, voterecord as vr
 from go_avalanche_tpu.ops.sampling import sample_peers_uniform
 
 
@@ -88,12 +88,11 @@ def round_step(
     prefs = vr.is_accepted(state.records.confidence)
     peer_votes = prefs[peers]                               # [N, k] bool
 
-    # --- adversary: byzantine peers vote against their true preference with
-    # `flip_probability` (the commented-out vote flip, `main.go:184-187`).
-    flip = (state.byzantine[peers]
-            & jax.random.bernoulli(k_byz, cfg.flip_probability,
-                                   peers.shape))
-    peer_votes = jnp.logical_xor(peer_votes, flip)
+    # --- adversary: byzantine peers lie with `flip_probability` per draw;
+    # what the lie says is `cfg.adversary_strategy` (ops/adversary.py — the
+    # reference hook at `main.go:184-187` is strategy FLIP).
+    lie = adversary.lie_mask(k_byz, peers, state.byzantine, cfg)
+    peer_votes = adversary.apply_1d(k_byz, peer_votes, lie, cfg, prefs)
 
     # --- failure model: dropped responses and dead peers are abstentions
     # (neutral votes model non-responsive peers, `vote.go:56`).

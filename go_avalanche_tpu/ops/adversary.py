@@ -1,0 +1,113 @@
+"""Adversary vote transforms (SURVEY.md §2.4 item 5).
+
+The reference's only adversarial hook is the commented-out random vote flip
+in the example (`examples/basic-preconcensus/main.go:184-187`).  The
+Avalanche paper that the reference links (`README.md:15`) analyses stronger
+adversaries; this module implements the three standard strategies as pure
+transforms applied to gathered peer votes, shared by every model in the
+family (`models/snowball`, `models/family`, `models/avalanche`,
+`models/dag`, `parallel/sharded`):
+
+  FLIP            — lie with the opposite of the peer's true preference
+                    (the reference hook, verbatim).
+  EQUIVOCATE      — lie with a fresh coin per (querier, draw[, target]):
+                    the same byzantine peer tells different queriers
+                    different things within one round.
+  OPPOSE_MAJORITY — lie with the current global *minority* color, the
+                    paper's liveness adversary: it fights convergence by
+                    pulling the network back toward an even split.
+
+Every strategy triggers per (querier, draw) with `cfg.flip_probability`,
+and only for byzantine peers, so `FLIP` with `flip_probability=0.35`
+reproduces the reference hook exactly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from go_avalanche_tpu.config import AdversaryStrategy, AvalancheConfig
+
+
+def lie_mask(
+    key: jax.Array,
+    peers: jax.Array,
+    byzantine: jax.Array,
+    cfg: AvalancheConfig,
+) -> jax.Array:
+    """Bool ``[N, k]`` — draws on which the sampled peer lies.
+
+    A draw lies iff the sampled peer is byzantine AND an independent
+    Bernoulli(`cfg.flip_probability`) fires for this (querier, draw).
+    """
+    return byzantine[peers] & jax.random.bernoulli(
+        key, cfg.flip_probability, peers.shape)
+
+
+def minority_color(prefs: jax.Array) -> jax.Array:
+    """Scalar bool — the color currently held by *fewer* nodes.
+
+    `prefs` is the bool ``[N]`` true-preference plane.  Ties count "no" as
+    the minority, so a perfectly split network keeps being pulled down.
+    """
+    n = prefs.shape[0]
+    return prefs.sum() * 2 < n
+
+
+def minority_plane(prefs: jax.Array) -> jax.Array:
+    """Bool ``[T]`` — per-target minority color over a ``[N, T]`` plane."""
+    n = prefs.shape[0]
+    return prefs.sum(axis=0) * 2 < n
+
+
+def apply_1d(
+    key: jax.Array,
+    votes: jax.Array,
+    lie: jax.Array,
+    cfg: AvalancheConfig,
+    prefs: jax.Array,
+) -> jax.Array:
+    """Adversary transform for single-decree models.
+
+    `votes`/`lie` are bool ``[N, k]``; `prefs` is the bool ``[N]`` true
+    preference plane (used only by OPPOSE_MAJORITY).  Returns the
+    post-adversary ``[N, k]`` votes.  `key` may be the same key used for
+    `lie_mask` — the coin folds in a constant to decorrelate.
+    """
+    s = cfg.adversary_strategy
+    if s is AdversaryStrategy.FLIP:
+        return jnp.logical_xor(votes, lie)
+    if s is AdversaryStrategy.EQUIVOCATE:
+        coin = jax.random.bernoulli(jax.random.fold_in(key, 0x5A), 0.5,
+                                    votes.shape)
+        return jnp.where(lie, coin, votes)
+    return jnp.where(lie, minority_color(prefs), votes)
+
+
+def apply_plane(
+    key: jax.Array,
+    draw: int,
+    vote_j: jax.Array,
+    lie_j: jax.Array,
+    cfg: AvalancheConfig,
+    minority_t: jax.Array,
+) -> jax.Array:
+    """Adversary transform for one draw of a multi-target model.
+
+    Called inside the unrolled k-loop: `vote_j` is the bool ``[N, T]``
+    gathered response plane for draw `draw`, `lie_j` the bool ``[N]`` lie
+    mask column, `minority_t` the precomputed bool ``[T]`` minority plane
+    (pass anything, e.g. `vote_j`, for non-OPPOSE strategies).  The
+    equivocation coin folds `draw` plus a constant into `key` so each draw
+    lies independently and `key` may be shared with `lie_mask`.
+    """
+    s = cfg.adversary_strategy
+    if s is AdversaryStrategy.FLIP:
+        return jnp.logical_xor(vote_j, lie_j[:, None])
+    if s is AdversaryStrategy.EQUIVOCATE:
+        coin = jax.random.bernoulli(
+            jax.random.fold_in(jax.random.fold_in(key, 0x5A), draw), 0.5,
+            vote_j.shape)
+        return jnp.where(lie_j[:, None], coin, vote_j)
+    return jnp.where(lie_j[:, None], minority_t[None, :], vote_j)

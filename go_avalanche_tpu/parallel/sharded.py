@@ -35,14 +35,19 @@ from jax import lax
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
-from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG, VoteMode
+from go_avalanche_tpu.config import (
+    AdversaryStrategy,
+    AvalancheConfig,
+    DEFAULT_CONFIG,
+    VoteMode,
+)
 from go_avalanche_tpu.models.avalanche import (
     AvalancheSimState,
     SimTelemetry,
     capped_poll_mask,
     popcnt_plane,
 )
-from go_avalanche_tpu.ops import voterecord as vr
+from go_avalanche_tpu.ops import adversary, voterecord as vr
 from go_avalanche_tpu.ops.bitops import pack_bool_plane, unpack_bool_plane
 from go_avalanche_tpu.ops.sampling import (
     sample_peers_uniform,
@@ -77,6 +82,19 @@ def shard_state(state: AvalancheSimState, mesh) -> AvalancheSimState:
     return jax.tree.map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
         state, state_specs())
+
+
+def _global_minority_plane(prefs_local: jax.Array,
+                           n_global: int) -> jax.Array:
+    """Bool ``[t_local]`` — per-target minority color over ALL node rows.
+
+    The sharded form of `ops/adversary.minority_plane`: local column sums,
+    psum'd over the nodes axis, compared against the global row count (same
+    tie semantics: an even split counts "no" as the minority).
+    """
+    yes_counts = lax.psum(prefs_local.sum(axis=0).astype(jnp.int32),
+                          NODES_AXIS)
+    return yes_counts * 2 < n_global
 
 
 def _local_round(
@@ -121,8 +139,7 @@ def _local_round(
                                      n_local=n_local, id_offset=offset)
         self_draw = None
 
-    flip = (state.byzantine[peers]
-            & jax.random.bernoulli(k_byz, cfg.flip_probability, peers.shape))
+    lie = adversary.lie_mask(k_byz, peers, state.byzantine, cfg)
     responded = state.alive[peers]
     if self_draw is not None:
         responded &= jnp.logical_not(self_draw)
@@ -151,12 +168,23 @@ def _local_round(
     packed_local = pack_bool_plane(prefs_local)        # [n_local, ceil(t/8)]
     packed_global = lax.all_gather(packed_local, NODES_AXIS, axis=0,
                                    tiled=True)         # [n_global, ceil(t/8)]
+    if cfg.adversary_strategy is AdversaryStrategy.OPPOSE_MAJORITY:
+        # One extra [t_local] psum per round, paid only under this strategy.
+        minority_t = _global_minority_plane(prefs_local, n_global)
+    else:
+        minority_t = jnp.zeros((t_local,), jnp.bool_)  # unused
+    # The equivocation coin is per-target, so unlike every other fault draw
+    # it must NOT be identical across txs shards: fold the txs-axis index in.
+    k_vote = k_byz
+    if cfg.adversary_strategy is AdversaryStrategy.EQUIVOCATE:
+        k_vote = jax.random.fold_in(k_byz, lax.axis_index(TXS_AXIS))
 
     yes_pack = jnp.zeros((n_local, t_local), jnp.uint8)
     consider_pack = jnp.zeros((n_local, t_local), jnp.uint8)
     for j in range(cfg.k):
         vote_j = unpack_bool_plane(packed_global[peers[:, j]], t_local)
-        vote_j = jnp.logical_xor(vote_j, flip[:, j][:, None])
+        vote_j = adversary.apply_plane(k_vote, j, vote_j, lie[:, j], cfg,
+                                       minority_t)
         yes_pack |= vote_j.astype(jnp.uint8) << jnp.uint8(j)
         consider_pack |= (responded[:, j].astype(jnp.uint8)
                           << jnp.uint8(j))[:, None]

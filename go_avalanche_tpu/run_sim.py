@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from go_avalanche_tpu.config import AvalancheConfig, VoteMode
+from go_avalanche_tpu.config import AdversaryStrategy, AvalancheConfig, VoteMode
 from go_avalanche_tpu.utils import metrics, tracing
 
 
@@ -49,6 +49,7 @@ def build_config(args: argparse.Namespace) -> AvalancheConfig:
         weighted_sampling=args.weighted,
         byzantine_fraction=args.byzantine,
         flip_probability=args.flip_probability,
+        adversary_strategy=AdversaryStrategy(args.adversary),
         drop_probability=args.drop,
         churn_probability=args.churn,
     )
@@ -213,6 +214,10 @@ def main(argv=None) -> Dict:
     # fault model
     parser.add_argument("--byzantine", type=float, default=0.0)
     parser.add_argument("--flip-probability", type=float, default=1.0)
+    parser.add_argument("--adversary",
+                        choices=[s.value for s in AdversaryStrategy],
+                        default=AdversaryStrategy.FLIP.value,
+                        help="what a lying byzantine peer answers")
     parser.add_argument("--drop", type=float, default=0.0)
     parser.add_argument("--churn", type=float, default=0.0)
     # output / tooling

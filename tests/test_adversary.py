@@ -1,0 +1,203 @@
+"""Adversary strategy suite (SURVEY.md section 2.4 item 5).
+
+The reference's only adversarial hook is the commented-out vote flip
+(`examples/basic-preconcensus/main.go:184-187`) = strategy FLIP.  These
+tests pin down the two stronger strategies (EQUIVOCATE, OPPOSE_MAJORITY)
+across the single-decree and multi-target models, plus parity between the
+sharded and unsharded minority computation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_avalanche_tpu.config import AdversaryStrategy, AvalancheConfig
+from go_avalanche_tpu.models import avalanche as av
+from go_avalanche_tpu.models import family, snowball
+from go_avalanche_tpu.ops import adversary
+from go_avalanche_tpu.ops import voterecord as vr
+
+
+# ---------------------------------------------------------------------------
+# Transform-level semantics
+
+
+def test_lie_mask_only_byzantine_peers_lie():
+    key = jax.random.key(0)
+    byz = jnp.array([True, False, False, False])
+    peers = jnp.array([[0, 1], [2, 3], [0, 0], [1, 2]])
+    cfg = AvalancheConfig(byzantine_fraction=0.25, flip_probability=1.0)
+    lie = adversary.lie_mask(key, peers, byz, cfg)
+    assert np.array_equal(np.asarray(lie), np.asarray(byz[peers]))
+
+
+def test_flip_strategy_inverts_exactly_on_lies():
+    key = jax.random.key(1)
+    cfg = AvalancheConfig(adversary_strategy=AdversaryStrategy.FLIP)
+    votes = jnp.array([[True, False], [False, True]])
+    lie = jnp.array([[True, False], [False, False]])
+    out = adversary.apply_1d(key, votes, lie, cfg, jnp.array([True, False]))
+    assert np.asarray(out).tolist() == [[False, False], [False, True]]
+
+
+def test_equivocate_tells_different_queriers_different_things():
+    # One byzantine peer (id 0) polled by many queriers in the same round:
+    # with a fair coin per draw, answers must be split, not constant.
+    key = jax.random.key(2)
+    cfg = AvalancheConfig(adversary_strategy=AdversaryStrategy.EQUIVOCATE,
+                          flip_probability=1.0)
+    n = 512
+    peers = jnp.zeros((n, 1), jnp.int32)         # everyone polls peer 0
+    votes = jnp.ones((n, 1), jnp.bool_)          # its true answer is yes
+    lie = jnp.ones((n, 1), jnp.bool_)
+    out = np.asarray(adversary.apply_1d(key, votes, lie, cfg,
+                                        jnp.ones((n,), jnp.bool_)))
+    frac_yes = out.mean()
+    assert 0.35 < frac_yes < 0.65, frac_yes
+
+
+def test_oppose_majority_votes_minority_color():
+    key = jax.random.key(3)
+    cfg = AvalancheConfig(
+        adversary_strategy=AdversaryStrategy.OPPOSE_MAJORITY,
+        flip_probability=1.0)
+    prefs = jnp.array([True, True, True, False])     # majority yes
+    votes = jnp.ones((4, 2), jnp.bool_)
+    lie = jnp.ones((4, 2), jnp.bool_)
+    out = adversary.apply_1d(key, votes, lie, cfg, prefs)
+    assert not np.asarray(out).any()                 # lies all say no
+
+    # Plane form: per-target minority.
+    plane_prefs = jnp.array([[True, False], [True, False], [True, True]])
+    minority_t = adversary.minority_plane(plane_prefs)
+    assert np.asarray(minority_t).tolist() == [False, True]
+    vote_j = jnp.ones((3, 2), jnp.bool_)
+    out_j = adversary.apply_plane(key, 0, vote_j, jnp.ones((3,), jnp.bool_),
+                                  cfg, minority_t)
+    assert np.asarray(out_j).tolist() == [[False, True]] * 3
+
+
+# ---------------------------------------------------------------------------
+# Model-level behavior
+
+
+def _final_snowball(cfg, n=128, yes_fraction=0.8, max_rounds=300, seed=0):
+    state = snowball.init(jax.random.key(seed), n, cfg, yes_fraction)
+    return snowball.run(state, cfg, max_rounds)
+
+
+def test_oppose_majority_stalls_convergence_hardest():
+    """With the same byzantine share, the minority-pushing adversary must
+    finalize strictly fewer honest nodes than the FLIP adversary (which,
+    from a near-consensus start, mostly wastes its lies agreeing with no
+    one in particular)."""
+    base = dict(byzantine_fraction=0.3, flip_probability=1.0)
+    rounds = 120
+    outcomes = {}
+    for strat in (AdversaryStrategy.FLIP, AdversaryStrategy.OPPOSE_MAJORITY):
+        cfg = AvalancheConfig(adversary_strategy=strat, **base)
+        final = _final_snowball(cfg, n=256, yes_fraction=0.9,
+                                max_rounds=rounds)
+        fin = np.asarray(vr.has_finalized(final.records.confidence, cfg))
+        byz = np.asarray(final.byzantine)
+        outcomes[strat] = fin[~byz].mean()
+    assert outcomes[AdversaryStrategy.OPPOSE_MAJORITY] \
+        < outcomes[AdversaryStrategy.FLIP], outcomes
+
+
+def test_honest_network_unaffected_by_strategy_choice():
+    # byzantine_fraction = 0: the strategy knob must be inert (bit-identical
+    # final state across strategies for the same seed).
+    finals = []
+    for strat in AdversaryStrategy:
+        cfg = AvalancheConfig(adversary_strategy=strat)
+        final = _final_snowball(cfg, n=64, yes_fraction=1.0)
+        finals.append(np.asarray(final.records.confidence))
+    assert np.array_equal(finals[0], finals[1])
+    assert np.array_equal(finals[0], finals[2])
+
+
+@pytest.mark.parametrize("strat", list(AdversaryStrategy))
+def test_multitarget_runs_under_every_strategy(strat):
+    cfg = AvalancheConfig(byzantine_fraction=0.2, flip_probability=0.5,
+                          adversary_strategy=strat)
+    state = av.init(jax.random.key(0), 32, 16, cfg)
+    new_state, tel = jax.jit(av.round_step, static_argnames="cfg")(state, cfg)
+    assert int(new_state.round) == 1
+    assert int(tel.polls) == 32 * 16
+
+
+@pytest.mark.parametrize("strat", list(AdversaryStrategy))
+def test_family_models_run_under_every_strategy(strat):
+    cfg = AvalancheConfig(byzantine_fraction=0.2, adversary_strategy=strat)
+    s0 = family.slush_init(jax.random.key(0), 64, cfg)
+    s1, _ = family.slush_run(s0, cfg, m_rounds=5)
+    assert int(s1.round) == 5
+    f0 = family.snowflake_init(jax.random.key(0), 64, cfg)
+    f1, _ = family.snowflake_round(f0, cfg)
+    assert int(f1.round) == 1
+
+
+def test_equivocation_slows_split_network():
+    """A 50/50 split with equivocating byzantine peers must take longer to
+    fully finalize than the same split with honest-only nodes."""
+    rounds = 400
+    honest = AvalancheConfig()
+    eq = AvalancheConfig(byzantine_fraction=0.2, flip_probability=1.0,
+                         adversary_strategy=AdversaryStrategy.EQUIVOCATE)
+    f_honest = _final_snowball(honest, n=128, yes_fraction=0.5,
+                               max_rounds=rounds, seed=3)
+    f_eq = _final_snowball(eq, n=128, yes_fraction=0.5,
+                           max_rounds=rounds, seed=3)
+    assert int(f_honest.round) < int(f_eq.round), (
+        int(f_honest.round), int(f_eq.round))
+
+
+# ---------------------------------------------------------------------------
+# Sharded parity
+
+
+def test_sharded_minority_matches_unsharded():
+    """The psum-based `_global_minority_plane` used by the sharded round
+    must agree with `adversary.minority_plane` on the global plane."""
+    from jax.sharding import PartitionSpec as P
+
+    from go_avalanche_tpu.parallel import sharded
+    from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS, make_mesh
+
+    mesh = make_mesh(n_node_shards=4, n_tx_shards=2,
+                     devices=jax.devices()[:8])
+    n, t = 16, 16
+    prefs = jax.random.bernoulli(jax.random.key(7), 0.5, (n, t))
+    # Include an exact 50/50 column to pin the tie semantics.
+    prefs = prefs.at[:, 0].set(jnp.arange(n) < n // 2)
+
+    fn = jax.shard_map(
+        lambda p: sharded._global_minority_plane(p, n),
+        mesh=mesh, in_specs=P(NODES_AXIS, TXS_AXIS),
+        out_specs=P(TXS_AXIS), check_vma=False)
+    got = np.asarray(jax.jit(fn)(prefs))
+    want = np.asarray(adversary.minority_plane(prefs))
+    assert np.array_equal(got, want)
+
+
+def test_sharded_equivocation_coin_differs_across_tx_shards():
+    """The equivocation coin must be independent per target — in particular
+    not tiled identically across txs shards (every other fault draw IS
+    replicated across txs shards by design)."""
+    from go_avalanche_tpu.parallel import sharded
+    from go_avalanche_tpu.parallel.mesh import make_mesh
+
+    cfg = AvalancheConfig(
+        byzantine_fraction=1.0, flip_probability=1.0, gossip=False,
+        adversary_strategy=AdversaryStrategy.EQUIVOCATE)
+    mesh = make_mesh(n_node_shards=4, n_tx_shards=2,
+                     devices=jax.devices()[:8])
+    n, t = 16, 64
+    state = av.init(jax.random.key(0), n, t, cfg)
+    sstate = sharded.shard_state(state, mesh)
+    new_state, _ = sharded.make_sharded_round_step(mesh, cfg)(sstate)
+    votes = np.asarray(new_state.records.votes)   # last window bit per draw
+    left, right = votes[:, :t // 2], votes[:, t // 2:]
+    assert not np.array_equal(left, right)
