@@ -29,14 +29,15 @@ sys.path.insert(0, ".")  # allow running from the repo root
 import jax
 import numpy as np
 
-from go_avalanche_tpu.config import AvalancheConfig
+from go_avalanche_tpu.config import AdversaryStrategy, AvalancheConfig
 from go_avalanche_tpu.models import avalanche as av
 from go_avalanche_tpu.utils import metrics
 
 
 def run_point(n_nodes: int, n_txs: int, byzantine: float, seed: int,
-              max_rounds: int) -> dict:
-    cfg = AvalancheConfig(byzantine_fraction=byzantine)
+              max_rounds: int, adversary: str = "flip") -> dict:
+    cfg = AvalancheConfig(byzantine_fraction=byzantine,
+                          adversary_strategy=AdversaryStrategy(adversary))
     state = av.init(jax.random.key(seed), n_nodes, n_txs, cfg)
     t0 = time.perf_counter()
     state = jax.jit(av.run, static_argnames=("cfg", "max_rounds"))(
@@ -64,6 +65,8 @@ def main() -> None:
     parser.add_argument("--sizes", type=str, default="128,512,2048")
     parser.add_argument("--txs", type=int, default=32)
     parser.add_argument("--byzantine", type=str, default="0.0,0.1,0.2")
+    parser.add_argument("--adversary", type=str, default="flip",
+                        choices=[s.value for s in AdversaryStrategy])
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--max-rounds", type=int, default=4000)
     parser.add_argument("--json", action="store_true")
@@ -72,7 +75,8 @@ def main() -> None:
     sizes = [int(s) for s in args.sizes.split(",")]
     byz_fracs = [float(b) for b in args.byzantine.split(",")]
 
-    results = [run_point(n, args.txs, b, args.seed, args.max_rounds)
+    results = [run_point(n, args.txs, b, args.seed, args.max_rounds,
+                         args.adversary)
                for n in sizes for b in byz_fracs]
 
     if args.json:

@@ -97,9 +97,12 @@ def preferred_in_set(
     Preference order within a set: highest confidence counter, then the
     accepted bit, then lowest tx index.  Two segment passes, no [T,T] blow-up.
     """
-    conf = vr.get_confidence(confidence).astype(jnp.int32)
-    acc = vr.is_accepted(confidence).astype(jnp.int32)
-    strength = (conf << 1) | acc                       # int32 [N, T]
+    # Preference order is (counter, accepted-bit) lexicographic, i.e.
+    # (counter << 1) | accepted — which is exactly the packed `confidence`
+    # word itself (bit 0 = accepted, bits 1..15 = counter, vote.go:24-50).
+    # Keeping it uint16 halves the [T, N]/[S, N] segment-op intermediates,
+    # the DAG model's HBM high-water mark at 100k-node scale.
+    strength = confidence                              # uint16 [N, T]
 
     best = jax.ops.segment_max(strength.T, conflict_set,
                                num_segments=n_sets)    # [S, N]

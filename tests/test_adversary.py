@@ -154,6 +154,30 @@ def test_equivocation_slows_split_network():
         int(f_honest.round), int(f_eq.round))
 
 
+def test_equivocation_stalls_dag_liveness():
+    """The canonical Avalanche liveness attack: per-target equivocation on
+    double-spends feeds confidence to BOTH sides of each conflict set until
+    nodes' in-set preferences diverge and nothing finalizes — while the same
+    byzantine share lying with coherent FLIP anti-preferences is out-voted
+    by the honest 80% and every set resolves."""
+    from go_avalanche_tpu.models import dag
+
+    cs = jnp.arange(32, dtype=jnp.int32) // 2
+    rounds = 300
+    fin_frac = {}
+    for strat in (AdversaryStrategy.FLIP, AdversaryStrategy.EQUIVOCATE):
+        cfg = AvalancheConfig(byzantine_fraction=0.2, flip_probability=1.0,
+                              adversary_strategy=strat)
+        state = dag.init(jax.random.key(0), 256, cs, cfg)
+        final = jax.jit(dag.run, static_argnames=("cfg", "max_rounds"))(
+            state, cfg, max_rounds=rounds)
+        fin = np.asarray(
+            vr.has_finalized(final.base.records.confidence, cfg))
+        fin_frac[strat] = fin.mean()
+    assert fin_frac[AdversaryStrategy.FLIP] > 0.9, fin_frac
+    assert fin_frac[AdversaryStrategy.EQUIVOCATE] < 0.1, fin_frac
+
+
 # ---------------------------------------------------------------------------
 # Sharded parity
 
