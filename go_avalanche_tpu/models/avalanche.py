@@ -224,15 +224,9 @@ def round_step(
         prefs = vr.is_accepted(state.records.confidence)   # [N, T]
         packed_prefs = pack_bool_plane(prefs)              # [N, ceil(T/8)]
         minority_t = adversary.minority_plane(prefs)       # [T]
-        yes_pack = jnp.zeros((n, t), jnp.uint8)
-        consider_pack = jnp.zeros((n, t), jnp.uint8)
-        for j in range(cfg.k):
-            vote_j = unpack_bool_plane(packed_prefs[peers[:, j]], t)
-            vote_j = adversary.apply_plane(k_byz, j, vote_j, lie[:, j], cfg,
-                                           minority_t)
-            yes_pack |= vote_j.astype(jnp.uint8) << jnp.uint8(j)
-            consider_pack |= (responded[:, j].astype(jnp.uint8)
-                              << jnp.uint8(j))[:, None]
+        yes_pack, consider_pack = adversary.pack_adversarial_votes(
+            lambda j: unpack_bool_plane(packed_prefs[peers[:, j]], t),
+            responded, lie, k_byz, cfg, minority_t)
 
     # --- ingest: k fused window updates on polled records only
     # (RegisterVotes, `processor.go:92-117`); finalized records freeze.

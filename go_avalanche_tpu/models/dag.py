@@ -155,15 +155,8 @@ def round_step(
     prefs = preferred_in_set(base.records.confidence, state.conflict_set,
                              state.n_sets)
     minority_t = adversary.minority_plane(prefs)
-    yes_pack = jnp.zeros((n, t), jnp.uint8)
-    consider_pack = jnp.zeros((n, t), jnp.uint8)
-    for j in range(cfg.k):
-        vote_j = prefs[peers[:, j]]
-        vote_j = adversary.apply_plane(k_byz, j, vote_j, lie[:, j], cfg,
-                                       minority_t)
-        yes_pack |= vote_j.astype(jnp.uint8) << jnp.uint8(j)
-        consider_pack |= (responded[:, j].astype(jnp.uint8)
-                          << jnp.uint8(j))[:, None]
+    yes_pack, consider_pack = adversary.pack_adversarial_votes(
+        lambda j: prefs[peers[:, j]], responded, lie, k_byz, cfg, minority_t)
 
     records, changed = vr.register_packed_votes(
         base.records, yes_pack, consider_pack, cfg.k, cfg, update_mask=polled)

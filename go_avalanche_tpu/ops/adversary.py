@@ -85,6 +85,35 @@ def apply_1d(
     return jnp.where(lie, minority_color(prefs), votes)
 
 
+def pack_adversarial_votes(
+    get_vote_plane,
+    responded: jax.Array,
+    lie: jax.Array,
+    key: jax.Array,
+    cfg: AvalancheConfig,
+    minority_t: jax.Array,
+) -> tuple:
+    """The k-draw vote-pack loop shared by every multi-target round.
+
+    `get_vote_plane(j)` returns the bool ``[N, T]`` response plane gathered
+    for draw j; `responded`/`lie` are bool ``[N, k]``.  Applies the
+    adversary transform per draw and packs the k votes into the
+    ``(yes_pack, consider_pack)`` uint8 bit planes consumed by
+    `voterecord.register_packed_votes`.
+    """
+    n, k = responded.shape
+    t = minority_t.shape[0]
+    yes_pack = jnp.zeros((n, t), jnp.uint8)
+    consider_pack = jnp.zeros((n, t), jnp.uint8)
+    for j in range(cfg.k):
+        vote_j = apply_plane(key, j, get_vote_plane(j), lie[:, j], cfg,
+                             minority_t)
+        yes_pack |= vote_j.astype(jnp.uint8) << jnp.uint8(j)
+        consider_pack |= (responded[:, j].astype(jnp.uint8)
+                          << jnp.uint8(j))[:, None]
+    return yes_pack, consider_pack
+
+
 def apply_plane(
     key: jax.Array,
     draw: int,

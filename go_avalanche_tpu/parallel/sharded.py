@@ -179,15 +179,9 @@ def _local_round(
     if cfg.adversary_strategy is AdversaryStrategy.EQUIVOCATE:
         k_vote = jax.random.fold_in(k_byz, lax.axis_index(TXS_AXIS))
 
-    yes_pack = jnp.zeros((n_local, t_local), jnp.uint8)
-    consider_pack = jnp.zeros((n_local, t_local), jnp.uint8)
-    for j in range(cfg.k):
-        vote_j = unpack_bool_plane(packed_global[peers[:, j]], t_local)
-        vote_j = adversary.apply_plane(k_vote, j, vote_j, lie[:, j], cfg,
-                                       minority_t)
-        yes_pack |= vote_j.astype(jnp.uint8) << jnp.uint8(j)
-        consider_pack |= (responded[:, j].astype(jnp.uint8)
-                          << jnp.uint8(j))[:, None]
+    yes_pack, consider_pack = adversary.pack_adversarial_votes(
+        lambda j: unpack_bool_plane(packed_global[peers[:, j]], t_local),
+        responded, lie, k_vote, cfg, minority_t)
 
     # --- ingest.
     if cfg.vote_mode is VoteMode.SEQUENTIAL:

@@ -1,0 +1,254 @@
+"""Mesh-sharded conflict-set DAG: BASELINE config "byzantine mix, sharded DAG".
+
+`models/dag.round_step` re-expressed under `jax.shard_map` over the
+``(nodes, txs)`` mesh.  The DAG adds two things to the plain sharded round
+(`parallel/sharded.py`) and both stay collective-free on the txs axis:
+
+  * the **response plane** is preferred-in-set rather than is-accepted —
+    computed per shard with local segment ops (legal because conflict sets
+    must not straddle tx shards; validated at `shard_dag_state` time), then
+    bit-packed and all-gathered over the nodes axis exactly like the plain
+    preference plane;
+  * the **rival-settled freeze** (a set settles for a node once any member
+    finalizes accepted, `models/dag.py`) is likewise a per-shard segment
+    pass over local columns.
+
+Randomness follows `parallel/sharded.py`: fault draws fold in only the
+nodes-shard index so one peer response covers all of a node's polled
+targets; the equivocation coin additionally folds the txs-shard index
+(it is per-target by definition).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from go_avalanche_tpu.config import (
+    AdversaryStrategy,
+    AvalancheConfig,
+    DEFAULT_CONFIG,
+)
+from go_avalanche_tpu.models import avalanche as av
+from go_avalanche_tpu.models import dag as dag_model
+from go_avalanche_tpu.models.dag import DagSimState
+from go_avalanche_tpu.ops import adversary, voterecord as vr
+from go_avalanche_tpu.ops.bitops import pack_bool_plane, unpack_bool_plane
+from go_avalanche_tpu.ops.sampling import sample_peers_uniform
+from go_avalanche_tpu.parallel import sharded
+from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS
+
+
+def dag_state_specs(n_sets: int) -> DagSimState:
+    """PartitionSpecs for every leaf of `DagSimState`.
+
+    `n_sets` rides along as the pytree's static aux data so the spec tree
+    and the value tree unflatten identically.
+    """
+    return DagSimState(base=sharded.state_specs(),
+                       conflict_set=P(TXS_AXIS), n_sets=n_sets)
+
+
+def shard_dag_state(state: DagSimState, mesh) -> DagSimState:
+    """Place a host-built DAG state onto the mesh.
+
+    Validates the sharding-compatibility contract from the model docstring
+    (`models/dag.py`): no conflict set may straddle a txs-shard boundary,
+    and set ids must be sorted so each shard's ids form one contiguous
+    range (the standard ``idx // set_size`` partition satisfies both).
+    """
+    n_tx_shards = mesh.shape[TXS_AXIS]
+    cs = np.asarray(jax.device_get(state.conflict_set))
+    t = cs.shape[0]
+    if t % n_tx_shards:
+        raise ValueError(f"txs ({t}) must divide by tx shards "
+                         f"({n_tx_shards})")
+    if (np.diff(cs) < 0).any():
+        raise ValueError("conflict_set ids must be sorted non-decreasing "
+                         "for tx sharding")
+    blocks = cs.reshape(n_tx_shards, t // n_tx_shards)
+    for i in range(n_tx_shards - 1):
+        if blocks[i, -1] == blocks[i + 1, 0]:
+            raise ValueError(
+                f"conflict set {int(blocks[i, -1])} straddles the boundary "
+                f"between tx shards {i} and {i + 1}")
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        state, dag_state_specs(state.n_sets))
+
+
+def _local_sets(conflict_set_local: jax.Array) -> jax.Array:
+    """Re-base this shard's set ids to 0..(local sets - 1).
+
+    With sorted, non-straddling sets the local ids are one contiguous
+    range; subtracting the first id localizes them.  Callers use the
+    global `n_sets` as a safe static bound for the local segment count.
+    """
+    return conflict_set_local - conflict_set_local[0]
+
+
+def _local_round(
+    state: DagSimState,
+    cfg: AvalancheConfig,
+    n_global: int,
+    n_tx_shards: int,
+) -> Tuple[DagSimState, av.SimTelemetry]:
+    """One DAG round on this shard's block; collectives on nodes axis only."""
+    base = state.base
+    n_local, t_local = base.records.votes.shape
+    nshard = lax.axis_index(NODES_AXIS)
+    offset = nshard * n_local
+    cs_local = _local_sets(state.conflict_set)
+
+    k_sample, k_byz, k_drop, k_next = jax.random.split(base.key, 4)
+    k_sample = jax.random.fold_in(k_sample, nshard)
+    k_byz = jax.random.fold_in(k_byz, nshard)
+    k_drop = jax.random.fold_in(k_drop, nshard)
+
+    fin = vr.has_finalized(base.records.confidence, cfg)
+    fin_acc = fin & vr.is_accepted(base.records.confidence)
+    alive_local = lax.dynamic_slice(base.alive, (offset,), (n_local,))
+
+    # --- rival-settled freeze: local segment pass over local columns.
+    set_done = jax.ops.segment_max(fin_acc.astype(jnp.int32).T, cs_local,
+                                   num_segments=state.n_sets)
+    rival_settled = (set_done.T[:, cs_local] > 0) & jnp.logical_not(fin_acc)
+
+    pollable = (base.added & alive_local[:, None] & base.valid[None, :]
+                & jnp.logical_not(fin) & jnp.logical_not(rival_settled))
+    # Per-shard poll cap, as in `parallel/sharded.py`: exact when T fits
+    # the cap, approximate otherwise.
+    local_cap = max(1, cfg.max_element_poll // n_tx_shards)
+    polled = av.capped_poll_mask(pollable, base.score_rank, local_cap)
+
+    peers = sample_peers_uniform(k_sample, n_global, cfg.k, cfg.exclude_self,
+                                 n_local=n_local, id_offset=offset)
+    lie = adversary.lie_mask(k_byz, peers, base.byzantine, cfg)
+    responded = base.alive[peers]
+    if cfg.drop_probability > 0.0:
+        responded &= ~jax.random.bernoulli(k_drop, cfg.drop_probability,
+                                           peers.shape)
+
+    # --- response plane: preferred-in-set, packed + all-gathered.
+    prefs_local = dag_model.preferred_in_set(base.records.confidence,
+                                             cs_local, state.n_sets)
+    packed_global = lax.all_gather(pack_bool_plane(prefs_local), NODES_AXIS,
+                                   axis=0, tiled=True)
+    if cfg.adversary_strategy is AdversaryStrategy.OPPOSE_MAJORITY:
+        minority_t = sharded._global_minority_plane(prefs_local, n_global)
+    else:
+        minority_t = jnp.zeros((t_local,), jnp.bool_)  # unused
+    k_vote = k_byz
+    if cfg.adversary_strategy is AdversaryStrategy.EQUIVOCATE:
+        k_vote = jax.random.fold_in(k_byz, lax.axis_index(TXS_AXIS))
+
+    yes_pack, consider_pack = adversary.pack_adversarial_votes(
+        lambda j: unpack_bool_plane(packed_global[peers[:, j]], t_local),
+        responded, lie, k_vote, cfg, minority_t)
+
+    records, changed = vr.register_packed_votes(
+        base.records, yes_pack, consider_pack, cfg.k, cfg, update_mask=polled)
+
+    fin_after = vr.has_finalized(records.confidence, cfg)
+    newly_final = fin_after & jnp.logical_not(fin)
+    finalized_at = jnp.where(newly_final & (base.finalized_at < 0),
+                             base.round, base.finalized_at)
+
+    def _global_sum(x):
+        return lax.psum(x.astype(jnp.int32), (NODES_AXIS, TXS_AXIS))
+
+    telemetry = av.SimTelemetry(
+        polls=_global_sum(polled.sum()),
+        votes_applied=_global_sum((av.popcnt_plane(consider_pack)
+                                   * polled).sum()),
+        flips=_global_sum((changed & jnp.logical_not(newly_final)).sum()),
+        finalizations=_global_sum(newly_final.sum()),
+        admissions=jnp.int32(0),
+    )
+    new_base = av.AvalancheSimState(
+        records=records, added=base.added, valid=base.valid,
+        score_rank=base.score_rank, byzantine=base.byzantine,
+        alive=base.alive, latency_weight=base.latency_weight,
+        finalized_at=finalized_at, round=base.round + 1, key=k_next)
+    return DagSimState(new_base, state.conflict_set, state.n_sets), telemetry
+
+
+def _shard_mapped(mesh, n_sets: int, fn, tel: bool = True):
+    specs = dag_state_specs(n_sets)
+    if tel:
+        tel_specs = av.SimTelemetry(*([P()] * len(av.SimTelemetry._fields)))
+        out_specs = (specs, tel_specs)
+    else:
+        out_specs = specs
+    return jax.shard_map(fn, mesh=mesh, in_specs=(specs,),
+                         out_specs=out_specs, check_vma=False)
+
+
+def make_sharded_dag_round_step(mesh, cfg: AvalancheConfig = DEFAULT_CONFIG):
+    """Build a jitted one-round DAG step over the mesh; call it with a
+    (global) `DagSimState` placed by `shard_dag_state`."""
+    cache = {}
+
+    n_tx = mesh.shape[TXS_AXIS]
+
+    def step(state: DagSimState):
+        key = (state.base.records.votes.shape[0], state.n_sets)
+        if key not in cache:
+            n_global = key[0]
+            cache[key] = jax.jit(_shard_mapped(
+                mesh, state.n_sets,
+                lambda s: _local_round(s, cfg, n_global, n_tx)))
+        return cache[key](state)
+
+    return step
+
+
+def run_sharded_dag(
+    mesh,
+    state: DagSimState,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    max_rounds: int = 2000,
+) -> DagSimState:
+    """Run until every (live node, set) resolved globally, or `max_rounds`;
+    one jit, early exit via a psum'd settled flag."""
+    n_global = state.base.records.votes.shape[0]
+    n_tx = mesh.shape[TXS_AXIS]
+
+    def local_run(s):
+        def unresolved(st):
+            base = st.base
+            n_local = base.records.votes.shape[0]
+            nshard = lax.axis_index(NODES_AXIS)
+            alive_local = lax.dynamic_slice(
+                base.alive, (nshard * n_local,), (n_local,))
+            cs_local = _local_sets(st.conflict_set)
+            fin_acc = (vr.has_finalized(base.records.confidence, cfg)
+                       & vr.is_accepted(base.records.confidence))
+            set_done = jax.ops.segment_max(
+                fin_acc.astype(jnp.int32).T, cs_local,
+                num_segments=st.n_sets)
+            open_sets = ((set_done.T[:, cs_local] == 0)
+                         & alive_local[:, None] & base.valid[None, :])
+            return lax.psum(open_sets.any().astype(jnp.int32),
+                            (NODES_AXIS, TXS_AXIS)) > 0
+
+        def cond(carry):
+            st, live = carry
+            return live & (st.base.round < max_rounds)
+
+        def body(carry):
+            st, _ = carry
+            new_st, _ = _local_round(st, cfg, n_global, n_tx)
+            return new_st, unresolved(new_st)
+
+        final, _ = lax.while_loop(cond, body, (s, unresolved(s)))
+        return final
+
+    fn = _shard_mapped(mesh, state.n_sets, local_run, tel=False)
+    return jax.jit(fn)(state)
