@@ -74,13 +74,29 @@ def run_snowball(args, cfg: AvalancheConfig) -> Dict:
     }
 
 
+def _parse_mesh(mesh_arg: str):
+    """`--mesh N,T` -> a (nodes, txs) device mesh over available devices."""
+    from go_avalanche_tpu.parallel.mesh import make_mesh
+
+    n_shards, t_shards = (int(x) for x in mesh_arg.split(","))
+    return make_mesh(n_node_shards=n_shards, n_tx_shards=t_shards)
+
+
 def run_avalanche(args, cfg: AvalancheConfig) -> Dict:
     from go_avalanche_tpu.models import avalanche as av
     from go_avalanche_tpu.ops import voterecord as vr
 
     state = av.init(jax.random.key(args.seed), args.nodes, args.txs, cfg)
-    state = jax.jit(av.run, static_argnames=("cfg", "max_rounds"))(
-        state, cfg, args.max_rounds)
+    if args.mesh:
+        from go_avalanche_tpu.parallel import sharded
+
+        mesh = _parse_mesh(args.mesh)
+        state = sharded.shard_state(state, mesh)
+        state = sharded.run_sharded(mesh, state, cfg,
+                                    max_rounds=args.max_rounds)
+    else:
+        state = jax.jit(av.run, static_argnames=("cfg", "max_rounds"))(
+            state, cfg, args.max_rounds)
     fin = np.asarray(jax.device_get(
         vr.has_finalized(state.records.confidence, cfg)))
     out = {
@@ -98,8 +114,16 @@ def run_dag(args, cfg: AvalancheConfig) -> Dict:
 
     conflict_set = jnp.arange(args.txs, dtype=jnp.int32) // args.conflict_size
     state = dag.init(jax.random.key(args.seed), args.nodes, conflict_set, cfg)
-    state = jax.jit(dag.run, static_argnames=("cfg", "max_rounds"))(
-        state, cfg, args.max_rounds)
+    if args.mesh:
+        from go_avalanche_tpu.parallel import sharded_dag
+
+        mesh = _parse_mesh(args.mesh)
+        state = sharded_dag.shard_dag_state(state, mesh)
+        state = sharded_dag.run_sharded_dag(mesh, state, cfg,
+                                            max_rounds=args.max_rounds)
+    else:
+        state = jax.jit(dag.run, static_argnames=("cfg", "max_rounds"))(
+            state, cfg, args.max_rounds)
     from go_avalanche_tpu.ops import voterecord as vr
 
     conf = state.base.records.confidence
@@ -220,6 +244,10 @@ def main(argv=None) -> Dict:
                         help="what a lying byzantine peer answers")
     parser.add_argument("--drop", type=float, default=0.0)
     parser.add_argument("--churn", type=float, default=0.0)
+    parser.add_argument("--mesh", type=str, default=None, metavar="N,T",
+                        help="run the sharded backend over an "
+                             "(n node shards, t tx shards) device mesh "
+                             "(models: avalanche, dag)")
     # output / tooling
     parser.add_argument("--json", action="store_true",
                         help="emit one JSON line instead of key=value text")
@@ -227,6 +255,9 @@ def main(argv=None) -> Dict:
                         help="write a JAX profiler trace to this directory")
     args = parser.parse_args(argv)
 
+    if args.mesh and args.model not in ("avalanche", "dag"):
+        parser.error(f"--mesh supports models avalanche/dag, "
+                     f"not {args.model}")
     cfg = build_config(args)
     runner = {"slush": run_slush, "snowflake": run_snowflake,
               "snowball": run_snowball, "avalanche": run_avalanche,
