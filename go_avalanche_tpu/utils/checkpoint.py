@@ -6,11 +6,18 @@ batched states here are pytrees of dense arrays + a PRNG key + the round
 counter, so a checkpoint is an exact, bit-for-bit resumable snapshot: restore
 and the simulation continues on the identical deterministic trajectory.
 
-Format: a single .npz holding the flattened leaves (typed PRNG keys are
-serialized via `jax.random.key_data`) plus the pytree structure is supplied
-by the caller as a template state — the same pattern orbax's
-`PyTreeCheckpointer.restore(..., item=template)` uses, without pulling a
-directory-format dependency into the hot loop.
+Two interchangeable backends, same pytree/template contract:
+
+  * `save_checkpoint` / `restore_checkpoint` — a single .npz of the
+    flattened leaves (typed PRNG keys serialized via
+    `jax.random.key_data`).  Zero extra dependencies, one file, ideal for
+    single-host simulation sweeps.
+  * `save_checkpoint_orbax` / `restore_checkpoint_orbax` — orbax
+    `StandardCheckpointer` directory format: sharding-aware and
+    multi-host-safe, the right backend when the state lives on a
+    `jax.sharding.Mesh` across processes (`parallel/runtime.py`).
+    Gated on `import orbax` so the core package keeps its jax+numpy-only
+    dependency footprint (`pyproject.toml` extra: `checkpoint`).
 """
 
 from __future__ import annotations
@@ -74,4 +81,47 @@ def restore_checkpoint(path: str, template: Any) -> Any:
                     f"checkpoint leaf {i}: got {arr.dtype}{list(arr.shape)}, "
                     f"template has {want.dtype}{list(want.shape)}")
             restored.append(jax.numpy.asarray(arr))
+    return jax.tree_util.tree_unflatten(treedef, restored)
+
+
+# ---------------------------------------------------------------------------
+# Orbax backend (optional dependency; sharding-aware, multi-host-safe)
+
+
+def _split_keys(state: Any):
+    """(state with PRNG keys replaced by raw key data, key-position mask)."""
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    mask = [_is_key(x) for x in leaves]
+    plain = [jax.random.key_data(x) if m else x
+             for x, m in zip(leaves, mask)]
+    return jax.tree_util.tree_unflatten(treedef, plain), mask
+
+
+def save_checkpoint_orbax(path: str, state: Any) -> None:
+    """Save a state pytree as an orbax checkpoint directory at `path`.
+
+    Unlike the .npz backend this preserves shardings and coordinates
+    multi-host saves; use it when the state was placed on a mesh.
+    """
+    import orbax.checkpoint as ocp
+
+    plain, _ = _split_keys(state)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.abspath(path), plain, force=True)
+
+
+def restore_checkpoint_orbax(path: str, template: Any) -> Any:
+    """Restore an orbax checkpoint saved by `save_checkpoint_orbax`.
+
+    `template` supplies structure, dtypes, and (if placed on a mesh) the
+    target shardings; PRNG keys are re-wrapped from raw key data.
+    """
+    import orbax.checkpoint as ocp
+
+    plain_tmpl, mask = _split_keys(template)
+    with ocp.StandardCheckpointer() as ckptr:
+        plain = ckptr.restore(os.path.abspath(path), plain_tmpl)
+    leaves, treedef = jax.tree_util.tree_flatten(plain)
+    restored = [jax.random.wrap_key_data(x) if m else x
+                for x, m in zip(leaves, mask)]
     return jax.tree_util.tree_unflatten(treedef, restored)
