@@ -47,6 +47,24 @@ def finality_curve(finalizations, population: int) -> np.ndarray:
     return np.cumsum(f) / float(population)
 
 
+def safety_failure(decided, value, honest=None) -> bool:
+    """Did two honest nodes irreversibly decide OPPOSITE values?
+
+    The Avalanche paper's safety event (single-decree): `decided` is a bool
+    [N] plane of irreversible decisions (finalized / accepted-at>=0),
+    `value` the bool [N] decided color, `honest` an optional bool [N] mask
+    (byzantine nodes cannot violate safety by construction — they have no
+    honest decision to contradict).
+    """
+    decided = np.asarray(jax.device_get(decided)).astype(bool).ravel()
+    value = np.asarray(jax.device_get(value)).astype(bool).ravel()
+    if honest is not None:
+        h = np.asarray(jax.device_get(honest)).astype(bool).ravel()
+        decided = decided & h
+    dv = value[decided]
+    return bool(dv.size and dv.any() and not dv.all())
+
+
 def status_plane(confidence, cfg: AvalancheConfig = DEFAULT_CONFIG):
     """Per-record Status codes (int8 plane), device-side."""
     return vr.status(confidence, cfg)

@@ -42,3 +42,33 @@ def test_telemetry_summary():
     summary = metrics.telemetry_summary(tel)
     assert summary["finalizations"] == 32
     assert set(summary) == set(tel._fields)
+
+
+def test_safety_failure_detection():
+    from go_avalanche_tpu.utils.metrics import safety_failure
+    import numpy as np
+
+    decided = np.array([True, True, False, True])
+    value = np.array([True, False, True, True])
+    # Nodes 0 and 1 decided opposite values -> failure.
+    assert safety_failure(decided, value)
+    # Masking node 1 as byzantine removes the contradiction.
+    honest = np.array([True, False, True, True])
+    assert not safety_failure(decided, value, honest)
+    # Unanimous decisions are safe; no decisions are safe.
+    assert not safety_failure(np.array([True, True]), np.array([True, True]))
+    assert not safety_failure(np.array([False, False]),
+                              np.array([True, False]))
+
+
+def test_family_curves_runners_smoke():
+    import jax
+
+    import examples.family_curves as fc
+    from go_avalanche_tpu.config import AvalancheConfig
+
+    cfg = AvalancheConfig(finalization_score=8)
+    for runner in fc.PROTOCOLS.values():
+        out = runner(jax.random.key(0), 64, cfg, 200)
+        assert 0.0 <= out["decided_fraction"] <= 1.0
+        assert out["safety_failure"] is False
