@@ -30,6 +30,66 @@ from go_avalanche_tpu.ops import voterecord as vr
 from go_avalanche_tpu.utils import metrics
 
 
+def run_host_api(args) -> None:
+    """The reference example verbatim through the host `Processor` API.
+
+    One Python `Processor` per node (`main.go:73-87`), synchronous peer
+    `query` with gossip-on-poll admission and honest own-acceptance votes
+    (`main.go:168-193`), round-robin peer selection (`main.go:111-116`),
+    counting nodes whose every tx finalized (`main.go:159-161`).  Object-
+    per-record and O(nodes^2 * txs) in Python — the workload the batched
+    path above does in one fused step; keep sizes modest here.
+    """
+    import random
+
+    from go_avalanche_tpu import Connman, Processor
+    from go_avalanche_tpu.types import Response, Status, Tx, Vote
+
+    rng = random.Random(args.seed)
+    n, t = args.nodes, args.txs
+    connman = Connman()
+    for i in range(n):
+        connman.add_node(i)
+    processors = [Processor(connman) for _ in range(n)]
+    txs = {h: Tx(h) for h in range(t)}
+
+    t0 = time.time()
+    for h in rng.sample(range(t), t):        # shuffled feed (`main.go:49-53`)
+        for p in processors:
+            p.add_target_to_reconcile(txs[h])
+
+    finalized = [0] * n
+    fully = 0
+    for rnd in range(args.max_rounds):
+        for i, p in enumerate(processors):
+            if finalized[i] >= t:
+                continue
+            peer = (i + 1 + rnd) % n          # round-robin, skip self
+            invs = p.get_invs_for_next_poll()
+            if not invs:
+                continue
+            votes = []
+            for inv in invs:                  # the peer's `query`
+                target = txs[inv.target_hash]
+                processors[peer].add_target_to_reconcile(target)  # gossip
+                err = 0 if processors[peer].is_accepted(target) else 1
+                votes.append(Vote(err, inv.target_hash))
+            updates: list = []
+            p.register_votes(peer, Response(p.get_round(), 0, votes),
+                             updates)
+            for u in updates:
+                if u.status is Status.FINALIZED:
+                    finalized[i] += 1
+                    if finalized[i] == t:
+                        fully += 1
+        if fully == n:
+            break
+    dt = time.time() - t0
+    print(f"Finished in {dt:f}s")
+    print(f"Nodes fully finalized: {fully}/{n} "
+          f"in {rnd + 1} rounds (host API, pure Python)")
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--nodes", type=int, default=100)
@@ -42,7 +102,14 @@ def main() -> None:
     parser.add_argument("--max-rounds", type=int, default=2000)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--logging", action="store_true")
+    parser.add_argument("--host-api", action="store_true",
+                        help="run through the per-node host Processor API "
+                             "instead of the batched simulator")
     args = parser.parse_args()
+
+    if args.host_api:
+        run_host_api(args)
+        return
 
     cfg = AvalancheConfig(k=args.k, byzantine_fraction=args.byzantine,
                           drop_probability=args.drop)

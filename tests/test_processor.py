@@ -296,3 +296,20 @@ def test_reference_spelling_aliases():
     updates = []
     assert p.RegisterVotes(0, votes_for(block.hash(), 0), updates)
     assert p.GetConfidence(block) == 0
+
+
+def test_host_api_example_converges():
+    """The reference example workload through the host API at small scale
+    (`examples/basic_preconsensus.py --host-api`): all nodes fully finalize
+    in the analytic ~134 rounds (6 warm-up + 128 confidence)."""
+    import argparse
+    import contextlib
+    import io
+
+    import examples.basic_preconsensus as ex
+
+    args = argparse.Namespace(nodes=8, txs=4, seed=0, max_rounds=400)
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        ex.run_host_api(args)
+    assert "fully finalized: 8/8 in 134 rounds" in out.getvalue()
