@@ -10,7 +10,10 @@ Measured verdict (v5e, jax 0.9.0, 8192x8192, k=8): the XLA-fused jnp path
 sustains ~59B votes/s vs ~37B for this kernel.  Mosaic only vectorizes
 i16/i32 arithmetic, so the kernel must widen every uint8 plane to int32 —
 4x the register/VMEM traffic — while XLA's own fusion keeps the chain in
-packed uint8.  The kernel is therefore NOT the default
+packed uint8.  A 16-bit variant was also tried (would halve the widening
+cost): Mosaic fails to legalize 16-bit vector shifts on this toolchain
+(`arith.shrsi`/`arith.shrui` on vector<...xi16> both fail to compile), so
+i32 is the narrowest workable width.  The kernel is therefore NOT the default
 (`register_packed_votes_fused` prefers the jnp path); it is kept, tested,
 and benchmarked as (a) the explicit-kernel reference for the semantics,
 (b) insurance against XLA fusion-boundary regressions, and (c) the starting
