@@ -35,7 +35,11 @@ from jax import lax
 from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
 from go_avalanche_tpu.models import avalanche as av
 from go_avalanche_tpu.ops import adversary, voterecord as vr
-from go_avalanche_tpu.ops.sampling import sample_peers_uniform
+from go_avalanche_tpu.ops.sampling import (
+    sample_peers_uniform,
+    sample_peers_weighted,
+    self_sample_mask,
+)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -127,7 +131,7 @@ def round_step(
     """
     base = state.base
     n, t = base.records.votes.shape
-    k_sample, k_byz, k_drop, k_next = jax.random.split(base.key, 4)
+    k_sample, k_byz, k_drop, k_churn, k_next = jax.random.split(base.key, 5)
 
     fin = vr.has_finalized(base.records.confidence, cfg)
     fin_acc = fin & vr.is_accepted(base.records.confidence)
@@ -144,9 +148,20 @@ def round_step(
     polled = av.capped_poll_mask(pollable, base.score_rank,
                                  cfg.max_element_poll)
 
-    peers = sample_peers_uniform(k_sample, n, cfg.k, cfg.exclude_self)
+    # Peer sampling + failure model: identical axes to the flat simulator
+    # (`models/avalanche.py`) — uniform or latency-weighted draws, byzantine
+    # lies, dropped responses, churn.
+    if cfg.weighted_sampling:
+        w = base.latency_weight * base.alive.astype(jnp.float32)
+        peers = sample_peers_weighted(k_sample, w, n, cfg.k)
+        self_draw = self_sample_mask(peers)
+    else:
+        peers = sample_peers_uniform(k_sample, n, cfg.k, cfg.exclude_self)
+        self_draw = None
     lie = adversary.lie_mask(k_byz, peers, base.byzantine, cfg)
     responded = base.alive[peers]
+    if self_draw is not None:
+        responded &= jnp.logical_not(self_draw)
     if cfg.drop_probability > 0.0:
         responded &= ~jax.random.bernoulli(k_drop, cfg.drop_probability,
                                            peers.shape)
@@ -166,6 +181,11 @@ def round_step(
     finalized_at = jnp.where(newly_final & (base.finalized_at < 0),
                              base.round, base.finalized_at)
 
+    alive = base.alive
+    if cfg.churn_probability > 0.0:
+        toggle = jax.random.bernoulli(k_churn, cfg.churn_probability, (n,))
+        alive = jnp.logical_xor(alive, toggle)
+
     telemetry = av.SimTelemetry(
         polls=polled.sum().astype(jnp.int32),
         votes_applied=(av.popcnt_plane(consider_pack)
@@ -180,7 +200,7 @@ def round_step(
         valid=base.valid,
         score_rank=base.score_rank,
         byzantine=base.byzantine,
-        alive=base.alive,
+        alive=alive,
         latency_weight=base.latency_weight,
         finalized_at=finalized_at,
         round=base.round + 1,

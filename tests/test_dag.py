@@ -109,3 +109,28 @@ def test_dag_telemetry_and_determinism():
     np.testing.assert_array_equal(np.asarray(a.base.records.confidence),
                                   np.asarray(b.base.records.confidence))
     assert int(a.base.round) == int(b.base.round)
+
+
+def test_dag_weighted_sampling_and_churn_converge():
+    """Fault-axis parity with the flat simulator: the conflict DAG resolves
+    under latency-weighted sampling and mild churn."""
+    cfg = AvalancheConfig(weighted_sampling=True, churn_probability=1e-3)
+    cs = jnp.arange(8, dtype=jnp.int32) // 2
+    state = dag.init(jax.random.key(0), 64, cs, cfg)
+    final = jax.jit(dag.run, static_argnames=("cfg", "max_rounds"))(
+        state, cfg, max_rounds=600)
+    conf = final.base.records.confidence
+    fin_acc = (np.asarray(vr.has_finalized(conf, cfg))
+               & np.asarray(vr.is_accepted(conf)))
+    alive = np.asarray(final.base.alive)
+    winners = fin_acc[alive].reshape(int(alive.sum()), 4, 2).sum(axis=2)
+    assert (winners == 1).mean() > 0.95
+
+
+def test_dag_churn_toggles_membership():
+    cfg = AvalancheConfig(churn_probability=0.5)
+    cs = jnp.arange(4, dtype=jnp.int32) // 2
+    state = dag.init(jax.random.key(0), 64, cs, cfg)
+    new_state, _ = jax.jit(dag.round_step, static_argnames="cfg")(state, cfg)
+    alive = np.asarray(new_state.base.alive)
+    assert 0 < alive.sum() < 64  # ~half toggled dead in one round
