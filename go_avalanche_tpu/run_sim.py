@@ -187,8 +187,16 @@ def run_backlog(args, cfg: AvalancheConfig) -> Dict:
     b = bl.make_backlog(jnp.arange(args.txs, dtype=jnp.int32))
     state = bl.init(jax.random.key(args.seed), args.nodes, args.slots, b,
                     cfg)
-    final = jax.jit(bl.run, static_argnames=("cfg", "max_rounds"))(
-        state, cfg, args.max_rounds)
+    if args.mesh:
+        from go_avalanche_tpu.parallel import sharded_backlog
+
+        mesh = _parse_mesh(args.mesh)
+        state = sharded_backlog.shard_backlog_state(state, mesh)
+        final = sharded_backlog.run_sharded_backlog(
+            mesh, state, cfg, max_rounds=args.max_rounds)
+    else:
+        final = jax.jit(bl.run, static_argnames=("cfg", "max_rounds"))(
+            state, cfg, args.max_rounds)
     out = jax.device_get(final.outputs)
     settled = np.asarray(out.settled)
     latency = (np.asarray(out.settle_round)
@@ -247,7 +255,7 @@ def main(argv=None) -> Dict:
     parser.add_argument("--mesh", type=str, default=None, metavar="N,T",
                         help="run the sharded backend over an "
                              "(n node shards, t tx shards) device mesh "
-                             "(models: avalanche, dag)")
+                             "(models: avalanche, dag, backlog)")
     # output / tooling
     parser.add_argument("--json", action="store_true",
                         help="emit one JSON line instead of key=value text")
@@ -255,8 +263,8 @@ def main(argv=None) -> Dict:
                         help="write a JAX profiler trace to this directory")
     args = parser.parse_args(argv)
 
-    if args.mesh and args.model not in ("avalanche", "dag"):
-        parser.error(f"--mesh supports models avalanche/dag, "
+    if args.mesh and args.model not in ("avalanche", "dag", "backlog"):
+        parser.error(f"--mesh supports models avalanche/dag/backlog, "
                      f"not {args.model}")
     cfg = build_config(args)
     runner = {"slush": run_slush, "snowflake": run_snowflake,
