@@ -9,15 +9,25 @@ NOTE: this environment's axon plugin force-sets
 sitecustomize), overriding the ``JAX_PLATFORMS`` env var — so the config must
 be re-overridden *after* importing jax, and ``XLA_FLAGS`` must be set before
 the CPU backend initializes.
+
+Set ``GO_AVALANCHE_TPU_TESTS=1`` to keep the real accelerator visible
+alongside CPU — used to run `tests/test_cross_backend_parity.py` on
+hardware (the 8-virtual-device sharding tests are NOT compatible with this
+mode; run that one file alone).
 """
 
 import os
 
+_tpu_mode = bool(os.environ.get("GO_AVALANCHE_TPU_TESTS"))
 _flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
+# NOTE: the axon plugin deadlocks at backend init when
+# xla_force_host_platform_device_count is set, so the virtual 8-device CPU
+# mesh and the real accelerator are mutually exclusive test modes.
+if not _tpu_mode and "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _tpu_mode:
+    jax.config.update("jax_platforms", "cpu")
