@@ -77,3 +77,31 @@ def test_cli_slush_and_snowflake(capsys):
     assert r2["accepted_fraction"] == 1.0
     assert r2["yes_fraction_final"] == 1.0
     capsys.readouterr()
+
+
+def test_cli_mesh_avalanche(capsys):
+    result = main(["--model", "avalanche", "--nodes", "32", "--txs", "16",
+                   "--finalization-score", "16", "--mesh", "4,2", "--json"])
+    assert result["finalized_fraction"] == 1.0
+
+
+def test_cli_mesh_dag(capsys):
+    result = main(["--model", "dag", "--nodes", "32", "--txs", "16",
+                   "--conflict-size", "2", "--finalization-score", "16",
+                   "--mesh", "4,2", "--json"])
+    assert result["sets_resolved_fraction"] == 1.0
+
+
+def test_cli_mesh_backlog(capsys):
+    result = main(["--model", "backlog", "--nodes", "16", "--txs", "64",
+                   "--slots", "16", "--finalization-score", "16",
+                   "--no-gossip", "--max-element-poll", "16",
+                   "--mesh", "4,2", "--json"])
+    assert result["settled_fraction"] == 1.0
+
+
+def test_cli_mesh_rejects_unsupported_model(capsys):
+    import pytest
+
+    with pytest.raises(SystemExit):
+        main(["--model", "snowball", "--mesh", "4,2"])
