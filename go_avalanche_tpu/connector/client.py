@@ -12,6 +12,7 @@ import struct
 from typing import List, NamedTuple, Optional, Sequence, Tuple
 
 from go_avalanche_tpu.connector import protocol as proto
+from go_avalanche_tpu.config import AdversaryStrategy
 from go_avalanche_tpu.types import Status, StatusUpdate
 
 
@@ -124,12 +125,18 @@ class ConnectorClient:
     def sim_init(self, n_nodes: int, n_txs: int, seed: int = 0, k: int = 8,
                  finalization_score: int = 128, gossip: bool = True,
                  byzantine_fraction: float = 0.0,
-                 drop_probability: float = 0.0) -> bool:
+                 drop_probability: float = 0.0,
+                 adversary_strategy: str = "flip",
+                 flip_probability: float = 1.0,
+                 churn_probability: float = 0.0) -> bool:
+        strategies = [s.value for s in AdversaryStrategy]
         _, r = self._call(
             proto.MsgType.SIM_INIT,
             struct.pack("<IIIIIBdd", n_nodes, n_txs, seed, k,
                         finalization_score, 1 if gossip else 0,
-                        byzantine_fraction, drop_probability),
+                        byzantine_fraction, drop_probability)
+            + struct.pack("<Bdd", strategies.index(adversary_strategy),
+                          flip_probability, churn_probability),
             [proto.MsgType.OK])
         return bool(r[0])
 

@@ -45,6 +45,9 @@ class MsgType(enum.IntEnum):
     GET_ROUND = 10         # {node q}
     SIM_INIT = 11          # {nodes I, txs I, seed I, k I, fin I, gossip B,
                            #  byz d, drop d}
+                           #  + optional v2 tail {strategy B, flip d, churn d}
+                           #  (strategy: 0=flip 1=equivocate 2=oppose_majority;
+                           #   older clients omit the tail)
     SIM_RUN = 12           # {rounds I}
     SHUTDOWN = 16
     # replies

@@ -27,7 +27,7 @@ import struct
 import threading
 from typing import Dict, List, Optional, Tuple
 
-from go_avalanche_tpu.config import AvalancheConfig
+from go_avalanche_tpu.config import AdversaryStrategy, AvalancheConfig
 from go_avalanche_tpu.connector import protocol as proto
 from go_avalanche_tpu.types import Response, Vote
 
@@ -315,11 +315,22 @@ class ConnectorServer:
             return M.I64, struct.pack("<q", self._engine(node_id).get_round())
 
         if msg_type == M.SIM_INIT:
+            base_len = struct.calcsize("<IIIIIBdd")
             n_nodes, n_txs, seed, k, fin, gossip, byz, drop = \
                 struct.unpack_from("<IIIIIBdd", payload, 0)
+            extra = {}
+            # v2 optional extension (older clients omit it): adversary
+            # strategy byte + flip/churn probabilities.
+            if len(payload) >= base_len + struct.calcsize("<Bdd"):
+                strat, flip_p, churn = struct.unpack_from("<Bdd", payload,
+                                                          base_len)
+                extra = dict(
+                    adversary_strategy=list(AdversaryStrategy)[strat],
+                    flip_probability=flip_p,
+                    churn_probability=churn)
             cfg = AvalancheConfig(
                 k=k, finalization_score=fin, gossip=bool(gossip),
-                byzantine_fraction=byz, drop_probability=drop)
+                byzantine_fraction=byz, drop_probability=drop, **extra)
             self._sim.init(n_nodes, n_txs, seed, cfg)
             return M.OK, struct.pack("<B", 1)
 

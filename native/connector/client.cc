@@ -212,7 +212,9 @@ int64_t ConnectorClient::GetRound(int64_t node_id) {
 
 bool ConnectorClient::SimInit(uint32_t n_nodes, uint32_t n_txs, uint32_t seed,
                               uint32_t k, uint32_t finalization_score,
-                              bool gossip, double byzantine, double drop) {
+                              bool gossip, double byzantine, double drop,
+                              uint8_t adversary_strategy,
+                              double flip_probability, double churn) {
   std::vector<uint8_t> p;
   PutLE(&p, n_nodes);
   PutLE(&p, n_txs);
@@ -222,6 +224,9 @@ bool ConnectorClient::SimInit(uint32_t n_nodes, uint32_t n_txs, uint32_t seed,
   PutU8(&p, gossip ? 1 : 0);
   PutLE(&p, byzantine);
   PutLE(&p, drop);
+  PutU8(&p, adversary_strategy);  // v2 tail
+  PutLE(&p, flip_probability);
+  PutLE(&p, churn);
   auto [t, r] = Call(MsgType::kSimInit, p, MsgType::kOk);
   return !r.empty() && r[0] != 0;
 }

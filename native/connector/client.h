@@ -45,9 +45,12 @@ class ConnectorClient {
   bool IsAccepted(int64_t node_id, int64_t hash);
   int64_t GetConfidence(int64_t node_id, int64_t hash);  // -1 if unknown
   int64_t GetRound(int64_t node_id);
+  // adversary_strategy: 0=flip 1=equivocate 2=oppose_majority (the v2
+  // optional SIM_INIT tail; servers older than v2 ignore unknown tails).
   bool SimInit(uint32_t n_nodes, uint32_t n_txs, uint32_t seed, uint32_t k,
                uint32_t finalization_score, bool gossip, double byzantine,
-               double drop);
+               double drop, uint8_t adversary_strategy = 0,
+               double flip_probability = 1.0, double churn = 0.0);
   SimStats SimRun(uint32_t rounds);
   void ShutdownServer();
 

@@ -127,3 +127,32 @@ def test_shutdown_request():
         with _client(srv) as c:
             c.shutdown_server()
         assert srv.wait_for_shutdown_request(timeout=5.0)
+
+
+def test_sim_init_v2_adversary_tail(server):
+    """The v2 SIM_INIT tail configures the adversary over the wire: a fully
+    byzantine oppose-majority network must register flips and not finalize
+    within a short budget, unlike the honest default."""
+    with _client(server) as c:
+        assert c.sim_init(32, 8, seed=0, k=8, finalization_score=64,
+                          byzantine_fraction=0.5,
+                          adversary_strategy="oppose_majority",
+                          flip_probability=1.0)
+        stats = c.sim_run(30)
+        assert stats.round == 30
+        assert stats.finalized_fraction < 1.0
+
+
+def test_sim_init_v1_frame_still_accepted(server):
+    """A v1 client frame (no tail) keeps working — wire compatibility."""
+    import struct
+
+    from go_avalanche_tpu.connector import protocol as proto_mod
+
+    with _client(server) as c:
+        payload = struct.pack("<IIIIIBdd", 16, 4, 0, 8, 16, 1, 0.0, 0.0)
+        t, r = c._call(proto_mod.MsgType.SIM_INIT, payload,
+                       [proto_mod.MsgType.OK])
+        assert r[0] == 1
+        stats = c.sim_run(40)
+        assert stats.finalized_fraction == 1.0
