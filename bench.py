@@ -110,16 +110,25 @@ def _worker_main(args: argparse.Namespace) -> None:
     if args.force_cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")
-    print(json.dumps(bench(args.nodes, args.txs, args.rounds, args.k)),
-          flush=True)
+    result = bench(args.nodes, args.txs, args.rounds, args.k)
+    if args.nonce:
+        # Echoed back so the parent can verify this line belongs to THIS
+        # run (the salvage path must never credit a stale line).
+        result["nonce"] = args.nonce
+    print(json.dumps(result), flush=True)
 
 
 # --------------------------------------------------------------------------
 # Parent: attempt schedule + always-emit-JSON contract.
 # --------------------------------------------------------------------------
 
-def _parse_result(stdout: str | None) -> dict | None:
-    """The JSON contract: last non-empty stdout line parses as a dict."""
+def _parse_result(stdout: str | None, nonce: str = "") -> dict | None:
+    """The JSON contract: last non-empty stdout line parses as a dict.
+
+    With a `nonce`, the line must also echo it (dropped from the result) —
+    a worker that ever printed intermediate/stale JSON can't be credited by
+    the timeout-salvage path below.
+    """
     for line in reversed((stdout or "").strip().splitlines()):
         line = line.strip()
         if not line:
@@ -127,6 +136,8 @@ def _parse_result(stdout: str | None) -> dict | None:
         try:
             parsed = json.loads(line)
             if isinstance(parsed, dict) and "value" in parsed:
+                if nonce and parsed.pop("nonce", None) != nonce:
+                    return None
                 return parsed
         except json.JSONDecodeError:
             pass
@@ -136,9 +147,11 @@ def _parse_result(stdout: str | None) -> dict | None:
 
 def _run_attempt(argv: list[str], timeout_s: float) -> tuple[dict | None, str]:
     """Run one worker subprocess; return (parsed-json-or-None, diagnostics)."""
+    nonce = os.urandom(8).hex()
     try:
         proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--worker", *argv],
+            [sys.executable, os.path.abspath(__file__), "--worker",
+             f"--nonce={nonce}", *argv],
             capture_output=True, text=True, timeout=timeout_s,
         )
     except subprocess.TimeoutExpired as exc:
@@ -147,11 +160,11 @@ def _run_attempt(argv: list[str], timeout_s: float) -> tuple[dict | None, str]:
         stdout = exc.stdout
         if isinstance(stdout, bytes):
             stdout = stdout.decode(errors="replace")
-        parsed = _parse_result(stdout)
+        parsed = _parse_result(stdout, nonce)
         if parsed is not None:
             return parsed, ""
         return None, f"timeout after {timeout_s:.0f}s"
-    parsed = _parse_result(proc.stdout)
+    parsed = _parse_result(proc.stdout, nonce)
     if parsed is not None:
         return parsed, ""
     tail = (proc.stderr or proc.stdout or "").strip().splitlines()
@@ -171,6 +184,9 @@ def main() -> None:
                         help="internal: run the measurement in-process")
     parser.add_argument("--force-cpu", action="store_true",
                         help="internal: pin the CPU backend (fallback mode)")
+    parser.add_argument("--nonce", type=str, default="",
+                        help="internal: per-run token echoed in the worker's "
+                             "JSON so the parent never credits a stale line")
     # Worst-case wall: attempts*(timeout+backoff) + fallback timeout
     # = 2*185 + 10 + 180 ~ 9.3 min — under the driver's capture window.
     parser.add_argument("--attempt-timeout", type=float, default=180.0,

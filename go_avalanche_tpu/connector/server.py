@@ -324,8 +324,15 @@ class ConnectorServer:
             if len(payload) >= base_len + struct.calcsize("<Bdd"):
                 strat, flip_p, churn = struct.unpack_from("<Bdd", payload,
                                                           base_len)
+                strategies = list(AdversaryStrategy)
+                if strat >= len(strategies):
+                    raise proto.ProtocolError(
+                        f"SIM_INIT adversary strategy byte {strat} out of "
+                        f"range (valid: 0..{len(strategies) - 1}: "
+                        + ", ".join(f"{i}={s.value}"
+                                    for i, s in enumerate(strategies)) + ")")
                 extra = dict(
-                    adversary_strategy=list(AdversaryStrategy)[strat],
+                    adversary_strategy=strategies[strat],
                     flip_probability=flip_p,
                     churn_probability=churn)
             cfg = AvalancheConfig(

@@ -40,7 +40,11 @@ from go_avalanche_tpu.models import dag as dag_model
 from go_avalanche_tpu.models.dag import DagSimState
 from go_avalanche_tpu.ops import adversary, voterecord as vr
 from go_avalanche_tpu.ops.bitops import pack_bool_plane, unpack_bool_plane
-from go_avalanche_tpu.ops.sampling import sample_peers_uniform
+from go_avalanche_tpu.ops.sampling import (
+    sample_peers_uniform,
+    sample_peers_weighted,
+    self_sample_mask,
+)
 from go_avalanche_tpu.parallel import sharded
 from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS
 
@@ -106,10 +110,11 @@ def _local_round(
     offset = nshard * n_local
     cs_local = _local_sets(state.conflict_set)
 
-    k_sample, k_byz, k_drop, k_next = jax.random.split(base.key, 4)
+    k_sample, k_byz, k_drop, k_churn, k_next = jax.random.split(base.key, 5)
     k_sample = jax.random.fold_in(k_sample, nshard)
     k_byz = jax.random.fold_in(k_byz, nshard)
     k_drop = jax.random.fold_in(k_drop, nshard)
+    k_churn = jax.random.fold_in(k_churn, nshard)
 
     fin = vr.has_finalized(base.records.confidence, cfg)
     fin_acc = fin & vr.is_accepted(base.records.confidence)
@@ -127,10 +132,23 @@ def _local_round(
     local_cap = max(1, cfg.max_element_poll // n_tx_shards)
     polled = av.capped_poll_mask(pollable, base.score_rank, local_cap)
 
-    peers = sample_peers_uniform(k_sample, n_global, cfg.k, cfg.exclude_self,
-                                 n_local=n_local, id_offset=offset)
+    # Uniform or latency-weighted peer draws, exactly as in
+    # `parallel/sharded._local_round`: the weighted CDF is global/replicated
+    # and self-draws become abstentions (per-row exclusion is O(N^2) there).
+    if cfg.weighted_sampling:
+        w = base.latency_weight * base.alive.astype(jnp.float32)
+        peers = sample_peers_weighted(k_sample, w, n_local, cfg.k)
+        self_draw = self_sample_mask(peers, id_offset=offset)
+    else:
+        peers = sample_peers_uniform(
+            k_sample, n_global, cfg.k, cfg.exclude_self,
+            n_local=n_local, id_offset=offset,
+            with_replacement=cfg.sample_with_replacement)
+        self_draw = None
     lie = adversary.lie_mask(k_byz, peers, base.byzantine, cfg)
     responded = base.alive[peers]
+    if self_draw is not None:
+        responded &= jnp.logical_not(self_draw)
     if cfg.drop_probability > 0.0:
         responded &= ~jax.random.bernoulli(k_drop, cfg.drop_probability,
                                            peers.shape)
@@ -160,6 +178,17 @@ def _local_round(
     finalized_at = jnp.where(newly_final & (base.finalized_at < 0),
                              base.round, base.finalized_at)
 
+    # Dynamic membership: each node-shard toggles its own rows, then the
+    # replicated [N] plane is rebuilt with one all-gather (the
+    # `parallel/sharded.py` recipe).
+    alive = base.alive
+    if cfg.churn_probability > 0.0:
+        toggle = jax.random.bernoulli(k_churn, cfg.churn_probability,
+                                      (n_local,))
+        alive_local_new = jnp.logical_xor(alive_local, toggle)
+        alive = lax.all_gather(alive_local_new, NODES_AXIS, axis=0,
+                               tiled=True)
+
     def _global_sum(x):
         return lax.psum(x.astype(jnp.int32), (NODES_AXIS, TXS_AXIS))
 
@@ -174,7 +203,7 @@ def _local_round(
     new_base = av.AvalancheSimState(
         records=records, added=base.added, valid=base.valid,
         score_rank=base.score_rank, byzantine=base.byzantine,
-        alive=base.alive, latency_weight=base.latency_weight,
+        alive=alive, latency_weight=base.latency_weight,
         finalized_at=finalized_at, round=base.round + 1, key=k_next)
     return DagSimState(new_base, state.conflict_set, state.n_sets), telemetry
 

@@ -156,3 +156,21 @@ def test_sim_init_v1_frame_still_accepted(server):
         assert r[0] == 1
         stats = c.sim_run(40)
         assert stats.finalized_fraction == 1.0
+
+
+def test_sim_init_invalid_strategy_byte_is_protocol_error(server):
+    """A v2 tail with an out-of-range adversary-strategy byte must come
+    back as a descriptive protocol error, not a bare IndexError."""
+    import struct
+
+    from go_avalanche_tpu.connector import protocol as proto_mod
+
+    with _client(server) as c:
+        payload = (struct.pack("<IIIIIBdd", 16, 4, 0, 8, 16, 1, 0.0, 0.0)
+                   + struct.pack("<Bdd", 9, 1.0, 0.0))
+        with pytest.raises(proto.ProtocolError,
+                           match=r"strategy byte 9 out of range"):
+            c._call(proto_mod.MsgType.SIM_INIT, payload,
+                    [proto_mod.MsgType.OK])
+        # The connection survives the error and valid inits still work.
+        assert c.ping()

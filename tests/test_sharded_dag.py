@@ -122,3 +122,42 @@ def test_sharded_dag_nodes_only_mesh():
     final = sharded_dag.run_sharded_dag(mesh, state, cfg, max_rounds=400)
     fin = np.asarray(vr.has_finalized(final.base.records.confidence, cfg))
     assert fin.all()
+
+
+def test_sharded_dag_churn_toggles_membership_matches_flat():
+    """churn_probability must act in the sharded DAG exactly as in the flat
+    model (round-1 advisor: the knob was silently dropped).  At churn=1.0
+    every node toggles regardless of the PRNG stream, so flat and sharded
+    agree bit-for-bit."""
+    cfg = AvalancheConfig(churn_probability=1.0)
+    mesh = _mesh()
+    flat = _init(cfg=cfg)
+    state = sharded_dag.shard_dag_state(flat, mesh)
+    new_state, _ = sharded_dag.make_sharded_dag_round_step(mesh, cfg)(state)
+    flat_new, _ = dag.round_step(flat, cfg)
+    assert not np.asarray(new_state.base.alive).any()
+    assert np.array_equal(np.asarray(new_state.base.alive),
+                          np.asarray(flat_new.base.alive))
+
+
+def test_sharded_dag_weighted_sampling_matches_flat_deterministic_limit():
+    """weighted_sampling must act in the sharded DAG (round-1 advisor: the
+    knob was silently dropped).  With ALL latency weight on node 0 every
+    draw is node 0 on both paths, the round becomes PRNG-independent, and
+    flat vs sharded confidence planes must match bit-for-bit."""
+    import dataclasses
+
+    cfg = AvalancheConfig(weighted_sampling=True)
+    mesh = _mesh()
+    n = 32
+    flat = _init(n=n, cfg=cfg)
+    w = jnp.zeros((n,), jnp.float32).at[0].set(1.0)
+    flat = dataclasses.replace(flat, base=flat.base._replace(latency_weight=w))
+    state = sharded_dag.shard_dag_state(flat, mesh)
+
+    step = sharded_dag.make_sharded_dag_round_step(mesh, cfg)
+    for _ in range(5):
+        state, _ = step(state)
+        flat, _ = dag.round_step(flat, cfg)
+    assert np.array_equal(np.asarray(state.base.records.confidence),
+                          np.asarray(flat.base.records.confidence))
