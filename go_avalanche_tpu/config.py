@@ -69,7 +69,13 @@ class AvalancheConfig:
                          example's round-robin, `examples/.../main.go:111`).
       alpha            — majority threshold for VoteMode.MAJORITY.
       vote_mode        — see VoteMode.
-      sample_with_replacement — peer sampling distribution.
+      sample_with_replacement — True: k independent draws per node (cheapest);
+                         False: k *distinct* peers per node, the protocol's
+                         real query semantics (`ops/sampling.py:
+                         sample_peers_distinct`).  Distinct draws are not
+                         supported together with weighted_sampling (exact
+                         weighted sampling without replacement needs per-row
+                         O(N) Gumbel top-k state — O(N^2) at fleet scale).
       exclude_self     — never sample yourself (`main.go:114-116`).
       gossip           — gossip-on-poll admission: a polled peer admits targets
                          it has not seen (`main.go:177`).
@@ -119,6 +125,11 @@ class AvalancheConfig:
                              "(confidence counter is uint16 >> 1)")
         if self.k <= 0:
             raise ValueError("k must be positive")
+        if self.weighted_sampling and not self.sample_with_replacement:
+            raise ValueError(
+                "weighted_sampling requires sample_with_replacement: exact "
+                "weighted draws without replacement need per-row Gumbel "
+                "top-k over all N peers (O(N^2) state)")
         if not (0.5 < self.alpha <= 1.0):
             raise ValueError("alpha must be in (0.5, 1.0]")
 

@@ -156,7 +156,9 @@ def round_step(
         peers = sample_peers_weighted(k_sample, w, n, cfg.k)
         self_draw = self_sample_mask(peers)
     else:
-        peers = sample_peers_uniform(k_sample, n, cfg.k, cfg.exclude_self)
+        peers = sample_peers_uniform(
+            k_sample, n, cfg.k, cfg.exclude_self,
+            with_replacement=cfg.sample_with_replacement)
         self_draw = None
     lie = adversary.lie_mask(k_byz, peers, base.byzantine, cfg)
     responded = base.alive[peers]

@@ -77,7 +77,8 @@ def _poll_majorities(state, cfg: AvalancheConfig):
     n = state.color.shape[0]
     k_sample, k_byz, k_drop, k_churn, k_next = jax.random.split(state.key, 5)
 
-    peers = sample_peers_uniform(k_sample, n, cfg.k, cfg.exclude_self)
+    peers = sample_peers_uniform(k_sample, n, cfg.k, cfg.exclude_self,
+                                 with_replacement=cfg.sample_with_replacement)
     votes = state.color[peers]                                # [N, k]
     lie = adversary.lie_mask(k_byz, peers, state.byzantine, cfg)
     votes = adversary.apply_1d(k_byz, votes, lie, cfg, state.color)

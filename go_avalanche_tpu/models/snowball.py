@@ -84,7 +84,8 @@ def round_step(
     # --- poll: every node samples k peers (`getSuitableNodeToQuery`
     # replacement) and reads their current preference (the example's
     # synchronous `query`, `main.go:168-193`, as a gather).
-    peers = sample_peers_uniform(k_sample, n, cfg.k, cfg.exclude_self)
+    peers = sample_peers_uniform(k_sample, n, cfg.k, cfg.exclude_self,
+                                 with_replacement=cfg.sample_with_replacement)
     prefs = vr.is_accepted(state.records.confidence)
     peer_votes = prefs[peers]                               # [N, k] bool
 

@@ -1,0 +1,374 @@
+"""Streaming conflict-set DAG: the north-star workload in bounded HBM.
+
+BASELINE.json's north star is 100k nodes x 1M *pending* txs where "the UTXO
+conflict-set DAG ... [is] sharded over the mesh" — conflicting spends must
+be resolved, not just independent txs settled.  `models/backlog` streams 1M
+independent txs through a bounded window; `models/dag` resolves conflicts
+densely.  This module composes them: the admission unit becomes the whole
+**conflict set**, so double-spend resolution happens inside the bounded
+``[nodes, window]`` working set while the 1M-tx conflict graph waits as
+cheap ``[sets, c]`` metadata.
+
+The design hinges on one invariant that keeps every shape static: conflict
+sets are stored at a fixed capacity ``c`` (short sets pad with invalid
+lanes, which never poll — invalid targets stop polling,
+`processor.go:155-157`), and the window is ``S_w`` set-slots of ``c``
+contiguous tx slots.  The window's conflict partition is therefore the
+*constant* ``arange(W) // c`` — independent of which backlog sets currently
+occupy the slots — so:
+
+  * the inner consensus round is **exactly `models/dag.round_step`** on a
+    `DagSimState` whose `conflict_set` never changes: preferred-in-set
+    responses, rival-settled freezes, every adversary/fault knob, and the
+    tx-shard-compatible segment layout all compose unchanged;
+  * retire/refill is the `models/backlog` scheduler lifted from tx
+    granularity to set granularity: one cumsum ranks free set-slots, one
+    row-scatter per output plane writes retiring sets' member outcomes.
+
+A set-slot retires when no (live node, member) pair is pollable any more —
+winners finalized, rivals frozen by the winner (the per-node settle freeze,
+`models/dag.py`), stragglers finalized rejected, or the set invalid.  That
+is the set-granular form of the reference's all-nodes-finalized condition
+(`examples/basic-preconcensus/main.go:159-161`) and subsumes the
+degenerate no-winner outcome, so a pathological set cannot wedge its slot.
+
+Reference seams, for parity review: admission order restores the intended
+score-descending sort (`avalanche.go:162-174`, disabled at
+`processor.go:163`) at set granularity (a set's score is its best
+member's); retirement mirrors delete-on-finalize (`processor.go:114-116`);
+outcomes record the network-majority winner per set.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
+from go_avalanche_tpu.models import avalanche as av
+from go_avalanche_tpu.models import dag as dag_model
+from go_avalanche_tpu.models.backlog import NO_TX
+from go_avalanche_tpu.ops import voterecord as vr
+
+NO_SET = NO_TX  # empty set-slot sentinel (-1), NoNode spirit (`avalanche.go:28`)
+
+
+class SetBacklog(NamedTuple):
+    """The pending conflict graph: ``[S_b, c]`` member planes.
+
+    Row s holds conflict set s's members at fixed capacity ``c``; short
+    sets pad with ``valid=False`` lanes.  Row order is admission order —
+    build with `make_set_backlog` for the intended score-descending order.
+    """
+
+    score: jax.Array      # int32 [S_b, c]
+    init_pref: jax.Array  # bool  [S_b, c] — Target.IsAccepted() prior
+    valid: jax.Array      # bool  [S_b, c]
+
+
+class SetOutputs(NamedTuple):
+    """Per-member settlement results, written as sets retire; [S_b, c]."""
+
+    settled: jax.Array       # bool  [S_b, c]
+    accepted: jax.Array      # bool  [S_b, c] — network-majority winner lane
+    accept_votes: jax.Array  # int32 [S_b, c] — nodes finalized-accepted
+    settle_round: jax.Array  # int32 [S_b, c]
+    admit_round: jax.Array   # int32 [S_b, c]
+
+
+class StreamingDagState(NamedTuple):
+    """Active conflict window + set backlog + outputs."""
+
+    dag: dag_model.DagSimState  # window: [N, W] records, static arange(W)//c
+    slot_set: jax.Array         # int32 [S_w] — backlog set per set-slot
+    slot_admit_round: jax.Array  # int32 [S_w]
+    backlog: SetBacklog         # [S_b, c]
+    outputs: SetOutputs         # [S_b, c]
+    next_idx: jax.Array         # int32 — next unadmitted backlog set
+
+
+def set_capacity(state: StreamingDagState) -> int:
+    return state.backlog.score.shape[1]
+
+
+def make_set_backlog(
+    scores: jax.Array,
+    init_pref: Optional[jax.Array] = None,
+    valid: Optional[jax.Array] = None,
+) -> SetBacklog:
+    """Sort sets into score-descending admission order (stable on ties).
+
+    All inputs are ``[S_b, c]``; a set's admission score is its best valid
+    member's (the set-granular `sortBlockInvsByWork`, `avalanche.go:185`).
+    `init_pref` defaults to "first valid member preferred" — the
+    deterministic first-seen stand-in used by `models/dag.init`.
+    """
+    scores = jnp.asarray(scores, jnp.int32)
+    s_b, c = scores.shape
+    if valid is None:
+        valid = jnp.ones((s_b, c), jnp.bool_)
+    valid = jnp.asarray(valid, jnp.bool_)
+    if init_pref is None:
+        first_valid = jnp.argmax(valid, axis=1)
+        init_pref = (jnp.arange(c)[None, :] == first_valid[:, None]) & valid
+    init_pref = jnp.asarray(init_pref, jnp.bool_)
+    set_score = jnp.where(valid, scores, jnp.int32(-2**31 + 1)).max(axis=1)
+    order = jnp.argsort(-set_score, stable=True)
+    return SetBacklog(score=scores[order], init_pref=init_pref[order],
+                      valid=valid[order])
+
+
+def init(
+    key: jax.Array,
+    n_nodes: int,
+    window_sets: int,
+    backlog: SetBacklog,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+) -> StreamingDagState:
+    """Empty window over a fresh set backlog; first refill is in step 0."""
+    s_b, c = backlog.score.shape
+    w = window_sets * c
+    base = av.init(key, n_nodes, w, cfg,
+                   added=jnp.zeros((n_nodes, w), jnp.bool_),
+                   valid=jnp.zeros((w,), jnp.bool_))
+    window_dag = dag_model.DagSimState(
+        base=base,
+        conflict_set=jnp.arange(w, dtype=jnp.int32) // c,
+        n_sets=window_sets,
+    )
+    zeros = jnp.zeros((s_b, c), jnp.int32)
+    return StreamingDagState(
+        dag=window_dag,
+        slot_set=jnp.full((window_sets,), NO_SET, jnp.int32),
+        slot_admit_round=jnp.zeros((window_sets,), jnp.int32),
+        backlog=backlog,
+        outputs=SetOutputs(
+            settled=jnp.zeros((s_b, c), jnp.bool_),
+            accepted=jnp.zeros((s_b, c), jnp.bool_),
+            accept_votes=zeros,
+            settle_round=zeros - 1,
+            admit_round=zeros - 1,
+        ),
+        next_idx=jnp.int32(0),
+    )
+
+
+def _settled_set_slots(state: StreamingDagState,
+                       cfg: AvalancheConfig) -> jax.Array:
+    """bool [S_w]: occupied set-slots the network is done with.
+
+    Done = no (live node, member) pair is still pollable: each node either
+    saw a member finalize accepted (freezing its rivals), or every member
+    it reconciles is finalized/invalid.  Mirrors the pollable mask of
+    `dag.round_step` so retirement and polling can never disagree.
+    """
+    base = state.dag.base
+    n, w = base.records.votes.shape
+    c = set_capacity(state)
+    s_w = w // c
+    occupied = state.slot_set != NO_SET
+
+    fin = vr.has_finalized(base.records.confidence, cfg)
+    fin_acc = fin & vr.is_accepted(base.records.confidence)
+    # Static window partition => segment ops are reshapes.
+    node_set_done = fin_acc.reshape(n, s_w, c).any(axis=2)      # [N, S_w]
+    rival_settled = (jnp.repeat(node_set_done, c, axis=1)
+                     & jnp.logical_not(fin_acc))
+    pending = (base.added & base.alive[:, None] & base.valid[None, :]
+               & jnp.logical_not(fin) & jnp.logical_not(rival_settled))
+    pending_set = pending.reshape(n, s_w, c).any(axis=(0, 2))   # [S_w]
+    return occupied & jnp.logical_not(pending_set)
+
+
+def _retire_and_refill(
+    state: StreamingDagState,
+    cfg: AvalancheConfig,
+    refill: bool = True,
+) -> Tuple[StreamingDagState, jax.Array]:
+    """Write retiring sets' member outcomes; refill free set-slots.
+
+    The `models/backlog` scheduler at set granularity: one cumsum for the
+    slot->backlog-set assignment, one row-scatter per output plane.
+    Returns (new_state, sets retired).
+    """
+    base = state.dag.base
+    n, w = base.records.votes.shape
+    c = set_capacity(state)
+    s_w = w // c
+    s_b = state.backlog.score.shape[0]
+    settled = _settled_set_slots(state, cfg)
+
+    # --- retire: member outcomes at the retiring sets' backlog rows.
+    conf = base.records.confidence
+    fin_acc = vr.has_finalized(conf, cfg) & vr.is_accepted(conf)
+    accept_votes = (fin_acc & base.added).sum(axis=0).astype(jnp.int32)  # [W]
+    n_live = jnp.maximum(base.alive.sum().astype(jnp.int32), 1)
+    accepted = accept_votes * 2 > n_live                                 # [W]
+
+    row_idx = jnp.where(settled, state.slot_set, s_b)   # s_b = dropped write
+    out = state.outputs
+
+    def scatter(plane, value_w, fill=None):
+        vals = value_w.reshape(s_w, c)
+        return plane.at[row_idx].set(vals if fill is None else fill,
+                                     mode="drop")
+
+    out = SetOutputs(
+        settled=scatter(out.settled, jnp.ones((w,), jnp.bool_)),
+        accepted=scatter(out.accepted, accepted),
+        accept_votes=scatter(out.accept_votes, accept_votes),
+        settle_round=out.settle_round.at[row_idx].set(
+            jnp.broadcast_to(base.round, (s_w, c)).astype(jnp.int32),
+            mode="drop"),
+        admit_round=out.admit_round.at[row_idx].set(
+            jnp.broadcast_to(state.slot_admit_round[:, None], (s_w, c)),
+            mode="drop"),
+    )
+
+    # --- refill: free set-slots take the next backlog sets in order.
+    free = settled | (state.slot_set == NO_SET)
+    rank = jnp.cumsum(free.astype(jnp.int32)) - 1
+    cand = state.next_idx + rank
+    take = free & (cand < s_b)
+    if not refill:   # end-of-run harvest: record outcomes, admit nothing
+        take = jnp.zeros_like(take)
+    new_set = jnp.where(take, cand, jnp.where(settled, NO_SET,
+                                              state.slot_set))
+    n_taken = take.sum().astype(jnp.int32)
+
+    cand_safe = jnp.clip(cand, 0, s_b - 1)
+    pref_w = state.backlog.init_pref[cand_safe].reshape(w)      # [W]
+    take_w = jnp.repeat(take, c)                                # [W]
+    fresh = vr.init_state(jnp.broadcast_to(pref_w[None, :], (n, w)))
+
+    def fill(plane, fresh_plane):
+        return jnp.where(take_w[None, :], fresh_plane, plane)
+
+    records = vr.VoteRecordState(
+        votes=fill(base.records.votes, fresh.votes),
+        consider=fill(base.records.consider, fresh.consider),
+        confidence=fill(base.records.confidence, fresh.confidence),
+    )
+    occupied_after_w = jnp.repeat(new_set != NO_SET, c)
+    # Admission seeds every node (the reference example feeds every tx to
+    # every node up front, `main.go:49-53`); retired slots clear.
+    added = jnp.where(take_w[None, :], True,
+                      base.added & occupied_after_w[None, :])
+    safe_rows = jnp.clip(new_set, 0, s_b - 1)
+    valid = jnp.where(take_w, state.backlog.valid[cand_safe].reshape(w),
+                      base.valid & occupied_after_w)
+    score = jnp.where(occupied_after_w,
+                      state.backlog.score[safe_rows].reshape(w),
+                      jnp.int32(-2**31 + 1))
+    finalized_at = jnp.where(take_w[None, :], -1, base.finalized_at)
+
+    new_base = base._replace(
+        records=records,
+        added=added,
+        valid=valid,
+        score_rank=av.score_ranks(score),
+        finalized_at=finalized_at,
+    )
+    return StreamingDagState(
+        dag=dag_model.DagSimState(new_base, state.dag.conflict_set,
+                                  state.dag.n_sets),
+        slot_set=new_set,
+        slot_admit_round=jnp.where(take, base.round,
+                                   state.slot_admit_round),
+        backlog=state.backlog,
+        outputs=out,
+        next_idx=state.next_idx + n_taken,
+    ), settled.sum().astype(jnp.int32)
+
+
+class StreamingDagTelemetry(NamedTuple):
+    """Per-step scalars: inner DAG round telemetry plus scheduler stats."""
+
+    round: av.SimTelemetry
+    retired_sets: jax.Array   # int32 — set-slots retired this step
+    occupied_sets: jax.Array  # int32 — occupied set-slots after refill
+    backlog_left: jax.Array   # int32 — sets not yet admitted
+
+
+def step(
+    state: StreamingDagState,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+) -> Tuple[StreamingDagState, StreamingDagTelemetry]:
+    """Retire/refill at set granularity, then one conflict round."""
+    state, retired = _retire_and_refill(state, cfg)
+    new_dag, round_tel = dag_model.round_step(state.dag, cfg)
+    tel = StreamingDagTelemetry(
+        round=round_tel,
+        retired_sets=retired,
+        occupied_sets=(state.slot_set != NO_SET).sum().astype(jnp.int32),
+        backlog_left=state.backlog.score.shape[0] - state.next_idx,
+    )
+    return state._replace(dag=new_dag), tel
+
+
+def drained(state: StreamingDagState,
+            cfg: AvalancheConfig = DEFAULT_CONFIG) -> jax.Array:
+    """True when the backlog is exhausted and every occupied slot settled."""
+    s_b = state.backlog.score.shape[0]
+    exhausted = state.next_idx >= s_b
+    occupied = state.slot_set != NO_SET
+    return exhausted & jnp.logical_not(
+        (occupied & jnp.logical_not(_settled_set_slots(state, cfg))).any())
+
+
+def run(
+    state: StreamingDagState,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    max_rounds: int = 100_000,
+) -> StreamingDagState:
+    """Stream the whole conflict graph through the window; single compile."""
+
+    def cond(s: StreamingDagState) -> jax.Array:
+        return jnp.logical_not(drained(s, cfg)) & (s.dag.base.round
+                                                   < max_rounds)
+
+    def body(s: StreamingDagState) -> StreamingDagState:
+        new_s, _ = step(s, cfg)
+        return new_s
+
+    final = lax.while_loop(cond, body, state)
+    final, _ = _retire_and_refill(final, cfg, refill=False)
+    return final
+
+
+def run_scan(
+    state: StreamingDagState,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    n_rounds: int = 1000,
+) -> Tuple[StreamingDagState, StreamingDagTelemetry]:
+    """Fixed-round run with stacked telemetry (bench/throughput curves)."""
+
+    def body(s, _):
+        new_s, tel = step(s, cfg)
+        return new_s, tel
+
+    return lax.scan(body, state, None, length=n_rounds)
+
+
+def resolution_summary(state: StreamingDagState) -> dict:
+    """Host-side outcome digest: how many sets got exactly one winner."""
+    import numpy as np
+
+    out = jax.device_get(state.outputs)
+    valid = np.asarray(jax.device_get(state.backlog.valid))
+    settled_sets = np.asarray(out.settled).any(axis=1)
+    winners = (np.asarray(out.accepted) & valid).sum(axis=1)
+    latency = (np.asarray(out.settle_round)
+               - np.asarray(out.admit_round))[np.asarray(out.settled)]
+    return {
+        "sets_settled_fraction": float(settled_sets.mean()),
+        "sets_one_winner_fraction": float(
+            (winners[settled_sets] == 1).mean()) if settled_sets.any()
+        else 0.0,
+        "txs_settled": int(np.asarray(out.settled)[valid].sum()),
+        "settle_latency_median": float(np.median(latency))
+        if latency.size else None,
+    }

@@ -24,6 +24,7 @@ def sample_peers_uniform(
     exclude_self: bool = True,
     n_local: int | None = None,
     id_offset: int | jax.Array = 0,
+    with_replacement: bool = True,
 ) -> jax.Array:
     """Uniform k-peer sample per node; int32 ``[n_local or n_nodes, k]`` of
     *global* peer ids in [0, n_nodes).
@@ -32,10 +33,19 @@ def sample_peers_uniform(
     [0, n_nodes-1) and values >= i are shifted up by one — an exact uniform
     distribution over the other n-1 nodes, with replacement.
 
+    With ``with_replacement=False`` the k draws per row are *distinct* —
+    the protocol's real k-peer sample (the placeholder this module replaces,
+    `processor.go:173-182`, stands in for "sample k random peers", and the
+    Avalanche paper's query is k distinct peers).  See
+    `sample_peers_distinct`.
+
     `n_local`/`id_offset` support sharded use: a shard owning global rows
     [id_offset, id_offset + n_local) samples peers for just its own nodes
     (ids remain global, so gathers cross shards).
     """
+    if not with_replacement:
+        return sample_peers_distinct(key, n_nodes, k, exclude_self,
+                                     n_local, id_offset)
     if exclude_self and n_nodes < 2:
         raise ValueError("exclude_self requires at least 2 nodes")
     rows = n_nodes if n_local is None else n_local
@@ -46,6 +56,52 @@ def sample_peers_uniform(
                                    dtype=jnp.int32)
         return draws + (draws >= self_ids).astype(jnp.int32)
     return jax.random.randint(key, (rows, k), 0, n_nodes, dtype=jnp.int32)
+
+
+def sample_peers_distinct(
+    key: jax.Array,
+    n_nodes: int,
+    k: int,
+    exclude_self: bool = True,
+    n_local: int | None = None,
+    id_offset: int | jax.Array = 0,
+) -> jax.Array:
+    """Uniform k-DISTINCT-peer sample per node; int32 ``[rows, k]``.
+
+    Iterated draw-and-shift, the without-replacement extension of the
+    `exclude_self` trick: draw j takes a uniform rank in the remaining pool
+    ``n - excluded - j`` and shifts it past every already-taken id in
+    ascending order, which maps the rank to the rank-th smallest untaken id
+    exactly.  k is small (protocol default 8), so the O(k^2) shift chain and
+    the per-draw sort of the k+1 taken-id buffer are noise next to the vote
+    planes; everything is [rows, k]-shaped — no O(N^2) anywhere, no host
+    round-trips, exact uniformity over k-subsets (each draw is uniform over
+    the remaining pool, so any ordered k-tuple has probability
+    1/(p * (p-1) * ... * (p-k+1)) with p the pool size).
+    """
+    excl = 1 if exclude_self else 0
+    if n_nodes - excl < k:
+        raise ValueError(
+            f"cannot draw {k} distinct peers from {n_nodes} nodes"
+            + (" excluding self" if exclude_self else ""))
+    rows = n_nodes if n_local is None else n_local
+    self_ids = (jnp.arange(rows, dtype=jnp.int32)
+                + jnp.asarray(id_offset, jnp.int32))
+    sentinel = jnp.int32(n_nodes)  # never reached by a shifted candidate
+    taken = jnp.full((rows, k + 1), sentinel, jnp.int32)
+    if exclude_self:
+        taken = taken.at[:, 0].set(self_ids)
+    keys = jax.random.split(key, k)
+    out = []
+    for j in range(k):
+        pool = n_nodes - excl - j
+        cand = jax.random.randint(keys[j], (rows,), 0, pool, dtype=jnp.int32)
+        srt = jnp.sort(taken, axis=1)
+        for i in range(j + excl):  # only the first j+excl entries are real
+            cand = cand + (cand >= srt[:, i]).astype(jnp.int32)
+        out.append(cand)
+        taken = taken.at[:, j + excl].set(cand)
+    return jnp.stack(out, axis=1)
 
 
 def sample_peers_weighted(

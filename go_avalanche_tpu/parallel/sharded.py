@@ -134,9 +134,10 @@ def _local_round(
         peers = sample_peers_weighted(k_sample, w, n_local, cfg.k)
         self_draw = self_sample_mask(peers, id_offset=offset)
     else:
-        peers = sample_peers_uniform(k_sample, n_global, cfg.k,
-                                     cfg.exclude_self,
-                                     n_local=n_local, id_offset=offset)
+        peers = sample_peers_uniform(
+            k_sample, n_global, cfg.k, cfg.exclude_self,
+            n_local=n_local, id_offset=offset,
+            with_replacement=cfg.sample_with_replacement)
         self_draw = None
 
     lie = adversary.lie_mask(k_byz, peers, state.byzantine, cfg)

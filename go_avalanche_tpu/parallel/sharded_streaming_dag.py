@@ -1,0 +1,352 @@
+"""Mesh-sharded streaming conflict-DAG: the north-star workload, sharded.
+
+`models/streaming_dag` re-expressed under `jax.shard_map` over the
+``(nodes, txs)`` mesh — the composition of `parallel/sharded_dag` (the
+conflicted inner round; reused verbatim as `sharded_dag._local_round`) and
+`parallel/sharded_backlog` (the streaming scheduler's collectives, lifted
+from tx granularity to set granularity):
+
+  * **settle test**    — `psum` over the nodes axis of the per-set
+    "some (node, member) still pollable" bit;
+  * **admission rank** — exclusive prefix over tx shards (all-gather of one
+    scalar per shard) so free set-slots across shards take backlog sets in
+    global score order;
+  * **output merge**   — retiring shards row-scatter member outcomes into
+    zero-init ``[S_b, c]`` planes, merged by a `psum` over the txs axis
+    (each set occupies exactly one set-slot, so rows never collide).
+
+Sharding layout: the ``[N, W]`` window shards on both axes; ``W`` must
+split into tx shards at whole-set granularity (``W / n_tx_shards``
+divisible by the set capacity ``c``), which makes the static window
+partition ``arange(W) // c`` locally contiguous — the same non-straddling
+contract `sharded_dag.shard_dag_state` enforces for arbitrary DAGs, here
+guaranteed by construction and validated at placement time.  Per-set-slot
+metadata shards with the txs axis; the ``[S_b, c]`` backlog/output planes
+replicate (1M txs of metadata is MBs — noise next to the window state).
+
+Divergence from the unsharded scheduler (documented, tested): poll-order
+score ranks are computed per tx shard; with ``W <= max_element_poll`` —
+the recommended configuration — ranks never matter because nothing is
+truncated.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
+from go_avalanche_tpu.models import avalanche as av
+from go_avalanche_tpu.models import dag as dag_model
+from go_avalanche_tpu.models.streaming_dag import (
+    NO_SET,
+    SetBacklog,
+    SetOutputs,
+    StreamingDagState,
+    StreamingDagTelemetry,
+)
+from go_avalanche_tpu.ops import voterecord as vr
+from go_avalanche_tpu.parallel import sharded, sharded_dag
+from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS
+
+
+def streaming_dag_state_specs(n_sets: int) -> StreamingDagState:
+    """PartitionSpecs for every leaf of `StreamingDagState`."""
+    return StreamingDagState(
+        dag=sharded_dag.dag_state_specs(n_sets),
+        slot_set=P(TXS_AXIS),
+        slot_admit_round=P(TXS_AXIS),
+        backlog=SetBacklog(score=P(), init_pref=P(), valid=P()),
+        outputs=SetOutputs(settled=P(), accepted=P(), accept_votes=P(),
+                           settle_round=P(), admit_round=P()),
+        next_idx=P(),
+    )
+
+
+def shard_streaming_dag_state(state: StreamingDagState,
+                              mesh) -> StreamingDagState:
+    """Place a host-built streaming-DAG state onto the mesh.
+
+    Validates whole-set tx sharding: the per-shard window width must be a
+    multiple of the set capacity (then no window set straddles a shard).
+    """
+    n_tx_shards = mesh.shape[TXS_AXIS]
+    c = state.backlog.score.shape[1]
+    w = state.dag.base.records.votes.shape[1]
+    if w % n_tx_shards:
+        raise ValueError(f"window ({w}) must divide by tx shards "
+                         f"({n_tx_shards})")
+    if (w // n_tx_shards) % c:
+        raise ValueError(
+            f"per-shard window ({w // n_tx_shards}) must be a multiple of "
+            f"the set capacity ({c}) so sets do not straddle tx shards")
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        state, streaming_dag_state_specs(state.dag.n_sets))
+
+
+def _merge_rows(old, row_idx, rows, s_b):
+    """Replicated [S_b, c] plane update from per-shard row scatters.
+
+    `row_idx` entries == s_b are dropped.  Rows are written by exactly one
+    shard (a backlog set occupies one set-slot), so a psum of one-hot
+    planes reconstructs them exactly.
+    """
+    dtype = old.dtype
+    vdt = jnp.int32 if dtype == jnp.bool_ else dtype
+    c = old.shape[1]
+    written = jnp.zeros((s_b,), jnp.int32).at[row_idx].set(1, mode="drop")
+    vals = (jnp.zeros((s_b, c), vdt)
+            .at[row_idx].set(rows.astype(vdt), mode="drop"))
+    written = lax.psum(written, TXS_AXIS)
+    vals = lax.psum(vals, TXS_AXIS)
+    return jnp.where((written > 0)[:, None], vals.astype(dtype), old)
+
+
+def _local_settled_sets(state: StreamingDagState, cfg: AvalancheConfig,
+                        c: int) -> jax.Array:
+    """bool [s_w_local]: globally-settled occupied set-slots.
+
+    The `models/streaming_dag._settled_set_slots` predicate with the
+    node-axis `any` turned into one psum."""
+    base = state.dag.base
+    n_local, w_local = base.records.votes.shape
+    s_w_local = w_local // c
+    nshard = lax.axis_index(NODES_AXIS)
+    alive_local = lax.dynamic_slice(base.alive, (nshard * n_local,),
+                                    (n_local,))
+    occupied = state.slot_set != NO_SET
+
+    fin = vr.has_finalized(base.records.confidence, cfg)
+    fin_acc = fin & vr.is_accepted(base.records.confidence)
+    node_set_done = fin_acc.reshape(n_local, s_w_local, c).any(axis=2)
+    rival_settled = (jnp.repeat(node_set_done, c, axis=1)
+                     & jnp.logical_not(fin_acc))
+    pending = (base.added & alive_local[:, None] & base.valid[None, :]
+               & jnp.logical_not(fin) & jnp.logical_not(rival_settled))
+    pending_local = pending.reshape(n_local, s_w_local, c).any(
+        axis=(0, 2)).astype(jnp.int32)
+    pending_any = lax.psum(pending_local, NODES_AXIS) > 0
+    return occupied & jnp.logical_not(pending_any)
+
+
+def _local_retire_and_refill(
+    state: StreamingDagState,
+    cfg: AvalancheConfig,
+    c: int,
+    refill: bool = True,
+) -> Tuple[StreamingDagState, jax.Array]:
+    """The set-granular scheduler pass on one shard; see
+    `models/streaming_dag`.  Returns (new_state, globally-retired sets)."""
+    base = state.dag.base
+    n_local, w_local = base.records.votes.shape
+    s_w_local = w_local // c
+    s_b = state.backlog.score.shape[0]
+    settled = _local_settled_sets(state, cfg, c)
+
+    # --- retire: member outcomes; node-axis sums via psum so every node
+    # shard computes identical [w_local] planes.
+    conf = base.records.confidence
+    fin_acc = vr.has_finalized(conf, cfg) & vr.is_accepted(conf)
+    accept_votes = lax.psum(
+        (fin_acc & base.added).sum(axis=0).astype(jnp.int32), NODES_AXIS)
+    n_live = jnp.maximum(base.alive.sum().astype(jnp.int32), 1)
+    accepted = accept_votes * 2 > n_live
+
+    row_idx = jnp.where(settled, state.slot_set, s_b)
+    out = state.outputs
+    out = SetOutputs(
+        settled=_merge_rows(out.settled, row_idx,
+                            jnp.ones((s_w_local, c), jnp.bool_), s_b),
+        accepted=_merge_rows(out.accepted, row_idx,
+                             accepted.reshape(s_w_local, c), s_b),
+        accept_votes=_merge_rows(out.accept_votes, row_idx,
+                                 accept_votes.reshape(s_w_local, c), s_b),
+        settle_round=_merge_rows(
+            out.settle_round, row_idx,
+            jnp.broadcast_to(base.round, (s_w_local, c)).astype(jnp.int32),
+            s_b),
+        admit_round=_merge_rows(
+            out.admit_round, row_idx,
+            jnp.broadcast_to(state.slot_admit_round[:, None],
+                             (s_w_local, c)), s_b),
+    )
+
+    # --- refill: global admission rank = exclusive prefix over tx shards.
+    free = settled | (state.slot_set == NO_SET)
+    count_local = free.sum().astype(jnp.int32)
+    counts = lax.all_gather(count_local, TXS_AXIS)
+    tshard = lax.axis_index(TXS_AXIS)
+    prefix = jnp.where(jnp.arange(counts.shape[0]) < tshard,
+                       counts, 0).sum()
+    rank = prefix + jnp.cumsum(free.astype(jnp.int32)) - 1
+    cand = state.next_idx + rank
+    take = free & (cand < s_b)
+    if not refill:   # end-of-run harvest
+        take = jnp.zeros_like(take)
+    new_set = jnp.where(take, cand, jnp.where(settled, NO_SET,
+                                              state.slot_set))
+    n_taken = lax.psum(take.sum().astype(jnp.int32), TXS_AXIS)
+
+    cand_safe = jnp.clip(cand, 0, s_b - 1)
+    pref_w = state.backlog.init_pref[cand_safe].reshape(w_local)
+    take_w = jnp.repeat(take, c)
+    fresh = vr.init_state(jnp.broadcast_to(pref_w[None, :],
+                                           (n_local, w_local)))
+
+    def fill(plane, fresh_plane):
+        return jnp.where(take_w[None, :], fresh_plane, plane)
+
+    records = vr.VoteRecordState(
+        votes=fill(base.records.votes, fresh.votes),
+        consider=fill(base.records.consider, fresh.consider),
+        confidence=fill(base.records.confidence, fresh.confidence),
+    )
+    occupied_after_w = jnp.repeat(new_set != NO_SET, c)
+    added = jnp.where(take_w[None, :], True,
+                      base.added & occupied_after_w[None, :])
+    safe_rows = jnp.clip(new_set, 0, s_b - 1)
+    valid = jnp.where(take_w,
+                      state.backlog.valid[cand_safe].reshape(w_local),
+                      base.valid & occupied_after_w)
+    score = jnp.where(occupied_after_w,
+                      state.backlog.score[safe_rows].reshape(w_local),
+                      jnp.int32(-2**31 + 1))
+    finalized_at = jnp.where(take_w[None, :], -1, base.finalized_at)
+
+    new_base = base._replace(
+        records=records,
+        added=added,
+        valid=valid,
+        score_rank=av.score_ranks(score),   # per-shard ranks (module note)
+        finalized_at=finalized_at,
+    )
+    retired = lax.psum(settled.sum().astype(jnp.int32), TXS_AXIS)
+    return StreamingDagState(
+        dag=dag_model.DagSimState(new_base, state.dag.conflict_set,
+                                  state.dag.n_sets),
+        slot_set=new_set,
+        slot_admit_round=jnp.where(take, base.round,
+                                   state.slot_admit_round),
+        backlog=state.backlog,
+        outputs=out,
+        next_idx=state.next_idx + n_taken,
+    ), retired
+
+
+def _local_step(
+    state: StreamingDagState,
+    cfg: AvalancheConfig,
+    c: int,
+    n_global: int,
+    n_tx_shards: int,
+) -> Tuple[StreamingDagState, StreamingDagTelemetry]:
+    state, retired = _local_retire_and_refill(state, cfg, c)
+    new_dag, round_tel = sharded_dag._local_round(state.dag, cfg, n_global,
+                                                  n_tx_shards)
+    occupied = lax.psum((state.slot_set != NO_SET).sum().astype(jnp.int32),
+                        TXS_AXIS)
+    tel = StreamingDagTelemetry(
+        round=round_tel,
+        retired_sets=retired,
+        occupied_sets=occupied,
+        backlog_left=state.backlog.score.shape[0] - state.next_idx,
+    )
+    return state._replace(dag=new_dag), tel
+
+
+def _shard_mapped(mesh, n_sets: int, fn, with_tel=True):
+    specs = streaming_dag_state_specs(n_sets)
+    if with_tel:
+        tel_specs = StreamingDagTelemetry(
+            round=av.SimTelemetry(*([P()] * len(av.SimTelemetry._fields))),
+            retired_sets=P(), occupied_sets=P(), backlog_left=P())
+        out_specs = (specs, tel_specs)
+    else:
+        out_specs = specs
+    return jax.shard_map(fn, mesh=mesh, in_specs=(specs,),
+                         out_specs=out_specs, check_vma=False)
+
+
+def make_sharded_streaming_dag_step(mesh,
+                                    cfg: AvalancheConfig = DEFAULT_CONFIG):
+    """Jitted (state) -> (state, telemetry) scheduler+conflict-round step."""
+    n_tx = mesh.shape[TXS_AXIS]
+    cache = {}
+
+    def step(state: StreamingDagState):
+        c = state.backlog.score.shape[1]
+        key = (state.dag.base.records.votes.shape[0], state.dag.n_sets, c)
+        if key not in cache:
+            n_global = key[0]
+            cache[key] = jax.jit(_shard_mapped(
+                mesh, state.dag.n_sets,
+                lambda s: _local_step(s, cfg, c, n_global, n_tx)))
+        return cache[key](state)
+
+    return step
+
+
+def run_sharded_streaming_dag(
+    mesh,
+    state: StreamingDagState,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    max_rounds: int = 100_000,
+) -> StreamingDagState:
+    """Stream the whole conflict graph to settlement over the mesh; one jit.
+
+    Ends with a harvest pass so the last window's outcomes are recorded.
+    """
+    n_global = state.dag.base.records.votes.shape[0]
+    n_tx = mesh.shape[TXS_AXIS]
+    c = state.backlog.score.shape[1]
+
+    def local_run(s):
+        def undrained(st: StreamingDagState) -> jax.Array:
+            s_b = st.backlog.score.shape[0]
+            unsettled = ((st.slot_set != NO_SET)
+                         & jnp.logical_not(_local_settled_sets(st, cfg, c)))
+            any_left = lax.psum(unsettled.any().astype(jnp.int32),
+                                TXS_AXIS) > 0
+            return (st.next_idx < s_b) | any_left
+
+        def cond(carry):
+            st, live = carry
+            return live & (st.dag.base.round < max_rounds)
+
+        def body(carry):
+            st, _ = carry
+            new_st, _ = _local_step(st, cfg, c, n_global, n_tx)
+            return new_st, undrained(new_st)
+
+        final, _ = lax.while_loop(cond, body, (s, undrained(s)))
+        final, _ = _local_retire_and_refill(final, cfg, c, refill=False)
+        return final
+
+    fn = _shard_mapped(mesh, state.dag.n_sets, local_run, with_tel=False)
+    return jax.jit(fn)(state)
+
+
+def run_scan_sharded_streaming_dag(
+    mesh,
+    state: StreamingDagState,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    n_rounds: int = 100,
+) -> Tuple[StreamingDagState, StreamingDagTelemetry]:
+    """Fixed-round sharded stream; one jit, collectives inside the scan."""
+    n_global = state.dag.base.records.votes.shape[0]
+    n_tx = mesh.shape[TXS_AXIS]
+    c = state.backlog.score.shape[1]
+
+    def local_scan(s):
+        def body(carry, _):
+            new_s, tel = _local_step(carry, cfg, c, n_global, n_tx)
+            return new_s, tel
+        return lax.scan(body, s, None, length=n_rounds)
+
+    return jax.jit(_shard_mapped(mesh, state.dag.n_sets, local_scan))(state)

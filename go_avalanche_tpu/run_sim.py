@@ -17,7 +17,9 @@ Models: `slush` / `snowflake` — the paper's simpler family members
 windowed record; `avalanche` — [nodes, txs] multi-target with gossip;
 `dag` — conflict-set double-spend resolution; `backlog` — `--txs` pending
 txs streamed through a `--slots` working-set window in bounded HBM (the
-north-star 1M-tx path).
+north-star 1M-tx path); `streaming_dag` — the composition: `--txs` pending
+txs in `--conflict-size` conflict sets streamed through a `--slots`-set
+window (the north-star 1M-tx UTXO-conflict path).
 """
 
 from __future__ import annotations
@@ -47,6 +49,7 @@ def build_config(args: argparse.Namespace) -> AvalancheConfig:
         vote_mode=VoteMode(args.vote_mode),
         gossip=not args.no_gossip,
         weighted_sampling=args.weighted,
+        sample_with_replacement=not args.distinct_peers,
         byzantine_fraction=args.byzantine,
         flip_probability=args.flip_probability,
         adversary_strategy=AdversaryStrategy(args.adversary),
@@ -179,6 +182,40 @@ def run_snowflake(args, cfg: AvalancheConfig) -> Dict:
     }
 
 
+def run_streaming_dag(args, cfg: AvalancheConfig) -> Dict:
+    """Streaming conflict-set run: `--txs` pending txs in conflict sets of
+    `--conflict-size`, streamed through a `--slots`-set window
+    (models/streaming_dag) — the north-star 1M-tx UTXO-conflict path."""
+    from go_avalanche_tpu.models import streaming_dag as sdg
+
+    c = args.conflict_size
+    if args.txs % c:
+        raise SystemExit(f"--txs ({args.txs}) must divide by "
+                         f"--conflict-size ({c})")
+    n_sets = args.txs // c
+    backlog = sdg.make_set_backlog(
+        jnp.arange(args.txs, dtype=jnp.int32).reshape(n_sets, c))
+    state = sdg.init(jax.random.key(args.seed), args.nodes, args.slots,
+                     backlog, cfg)
+    if args.mesh:
+        from go_avalanche_tpu.parallel import sharded_streaming_dag as ssd
+
+        mesh = _parse_mesh(args.mesh)
+        state = ssd.shard_streaming_dag_state(state, mesh)
+        final = ssd.run_sharded_streaming_dag(mesh, state, cfg,
+                                              max_rounds=args.max_rounds)
+    else:
+        final = jax.jit(sdg.run, static_argnames=("cfg", "max_rounds"))(
+            state, cfg, args.max_rounds)
+    out = {
+        "rounds": int(jax.device_get(final.dag.base.round)),
+        "window_sets": args.slots,
+        "conflict_sets": n_sets,
+        **sdg.resolution_summary(final),
+    }
+    return out
+
+
 def run_backlog(args, cfg: AvalancheConfig) -> Dict:
     """Streaming working-set run: `--txs` pending txs through a `--slots`
     working-set window (models/backlog) — the bounded-HBM north-star path."""
@@ -217,7 +254,8 @@ def main(argv=None) -> Dict:
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
     parser.add_argument("--model",
                         choices=["slush", "snowflake", "snowball",
-                                 "avalanche", "dag", "backlog"],
+                                 "avalanche", "dag", "backlog",
+                                 "streaming_dag"],
                         default="avalanche")
     parser.add_argument("--nodes", type=int, default=256)
     parser.add_argument("--txs", type=int, default=64)
@@ -236,13 +274,18 @@ def main(argv=None) -> Dict:
     parser.add_argument("--no-gossip", action="store_true")
     parser.add_argument("--weighted", action="store_true",
                         help="latency-weighted peer sampling")
+    parser.add_argument("--distinct-peers", action="store_true",
+                        help="sample k DISTINCT peers per node per round "
+                             "(without replacement; the protocol's real "
+                             "query semantics)")
     parser.add_argument("--yes-fraction", type=float, default=1.0,
                         help="slush/snowflake/snowball: initial "
                              "yes-preference fraction")
     parser.add_argument("--conflict-size", type=int, default=2,
                         help="dag: txs per conflict set")
     parser.add_argument("--slots", type=int, default=64,
-                        help="backlog: active working-set slots")
+                        help="backlog: active working-set slots; "
+                             "streaming_dag: active working-set SETS")
     # fault model
     parser.add_argument("--byzantine", type=float, default=0.0)
     parser.add_argument("--flip-probability", type=float, default=1.0)
@@ -263,13 +306,15 @@ def main(argv=None) -> Dict:
                         help="write a JAX profiler trace to this directory")
     args = parser.parse_args(argv)
 
-    if args.mesh and args.model not in ("avalanche", "dag", "backlog"):
-        parser.error(f"--mesh supports models avalanche/dag/backlog, "
-                     f"not {args.model}")
+    if args.mesh and args.model not in ("avalanche", "dag", "backlog",
+                                        "streaming_dag"):
+        parser.error(f"--mesh supports models avalanche/dag/backlog/"
+                     f"streaming_dag, not {args.model}")
     cfg = build_config(args)
     runner = {"slush": run_slush, "snowflake": run_snowflake,
               "snowball": run_snowball, "avalanche": run_avalanche,
-              "dag": run_dag, "backlog": run_backlog}[args.model]
+              "dag": run_dag, "backlog": run_backlog,
+              "streaming_dag": run_streaming_dag}[args.model]
 
     ctx = tracing.trace(args.trace) if args.trace else contextlib.nullcontext()
     t0 = time.perf_counter()

@@ -105,3 +105,35 @@ def test_cli_mesh_rejects_unsupported_model(capsys):
 
     with pytest.raises(SystemExit):
         main(["--model", "snowball", "--mesh", "4,2"])
+
+
+def test_cli_streaming_dag(capsys):
+    result = main(["--model", "streaming_dag", "--nodes", "24", "--txs",
+                   "32", "--conflict-size", "2", "--slots", "4",
+                   "--finalization-score", "16", "--json"])
+    assert result["conflict_sets"] == 16
+    assert result["sets_settled_fraction"] == 1.0
+    assert result["sets_one_winner_fraction"] == 1.0
+
+
+def test_cli_mesh_streaming_dag(capsys):
+    result = main(["--model", "streaming_dag", "--nodes", "16", "--txs",
+                   "24", "--conflict-size", "2", "--slots", "4",
+                   "--finalization-score", "16", "--mesh", "4,2", "--json"])
+    assert result["sets_settled_fraction"] == 1.0
+    assert result["sets_one_winner_fraction"] == 1.0
+
+
+def test_cli_streaming_dag_rejects_indivisible_txs():
+    import pytest
+
+    with pytest.raises(SystemExit):
+        main(["--model", "streaming_dag", "--txs", "7",
+              "--conflict-size", "2"])
+
+
+def test_cli_distinct_peers(capsys):
+    result = main(["--model", "avalanche", "--nodes", "32", "--txs", "8",
+                   "--finalization-score", "16", "--distinct-peers",
+                   "--json"])
+    assert result["finalized_fraction"] == 1.0

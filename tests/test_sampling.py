@@ -9,6 +9,7 @@ from go_avalanche_tpu.config import AvalancheConfig
 from go_avalanche_tpu.models import avalanche as av
 from go_avalanche_tpu.ops import voterecord as vr
 from go_avalanche_tpu.ops.sampling import (
+    sample_peers_distinct,
     sample_peers_uniform,
     sample_peers_weighted,
     self_sample_mask,
@@ -90,4 +91,90 @@ def test_weighted_network_sharded_converges():
     state = sharded.shard_state(
         av.init(jax.random.key(0), n, t, cfg, latency_weights=weights), mesh)
     final = sharded.run_sharded(mesh, state, cfg, max_rounds=100)
+    assert bool(vr.has_finalized(final.records.confidence).all())
+
+
+def test_distinct_no_duplicates_per_row_and_no_self():
+    n, k = 64, 8
+    for seed in range(8):
+        p = np.asarray(sample_peers_distinct(jax.random.key(seed), n, k))
+        assert p.shape == (n, k)
+        assert (p >= 0).all() and (p < n).all()
+        assert not (p == np.arange(n)[:, None]).any()  # never self
+        for row in p:
+            assert len(set(row.tolist())) == k, row  # k DISTINCT peers
+
+
+def test_distinct_tight_pool_is_exhaustive():
+    # n-1 == k: every row must draw every other node exactly once.
+    n, k = 9, 8
+    p = np.asarray(sample_peers_distinct(jax.random.key(0), n, k))
+    for i, row in enumerate(p):
+        assert sorted(row.tolist()) == [j for j in range(n) if j != i]
+
+
+def test_distinct_uniform_marginals():
+    # Any (row, draw) marginal is uniform over the other n-1 nodes.
+    n, k = 16, 8
+    counts = np.zeros(n)
+    for seed in range(128):
+        p = np.asarray(sample_peers_distinct(jax.random.key(seed), n, k))
+        counts += np.bincount(p.ravel(), minlength=n)
+    freq = counts / counts.sum()
+    assert abs(freq.max() - freq.min()) < 0.02
+
+
+def test_distinct_without_exclude_self():
+    n, k = 12, 8
+    p = np.asarray(sample_peers_distinct(jax.random.key(3), n, k,
+                                         exclude_self=False))
+    assert (p == np.arange(n)[:, None]).any()  # self IS drawable
+    for row in p:
+        assert len(set(row.tolist())) == k
+
+
+def test_distinct_sharded_offset():
+    p = np.asarray(sample_peers_distinct(jax.random.key(1), 64, 8,
+                                         n_local=16, id_offset=32))
+    assert p.shape == (16, 8)
+    assert not (p == (np.arange(16) + 32)[:, None]).any()
+    for row in p:
+        assert len(set(row.tolist())) == 8
+
+
+def test_distinct_infeasible_pool_raises():
+    with pytest.raises(ValueError, match="distinct"):
+        sample_peers_distinct(jax.random.key(0), 8, 8)  # pool is 7 < k
+
+
+def test_weighted_without_replacement_config_rejected():
+    with pytest.raises(ValueError, match="weighted_sampling"):
+        AvalancheConfig(weighted_sampling=True,
+                        sample_with_replacement=False)
+
+
+def test_distinct_network_converges_and_uniform_matches_stats():
+    """End-to-end with k distinct peers: the honest network still finalizes
+    everything, in a round count comparable to with-replacement sampling
+    (distinct draws carry slightly more information per round, so they may
+    only help)."""
+    n, t = 48, 6
+    rounds = {}
+    for wr in (True, False):
+        cfg = AvalancheConfig(sample_with_replacement=wr)
+        state = av.init(jax.random.key(0), n, t, cfg)
+        final = av.run(state, cfg, max_rounds=300)
+        assert bool(vr.has_finalized(final.records.confidence).all())
+        rounds[wr] = int(final.round)
+    assert rounds[False] <= rounds[True] + 5, rounds
+
+
+def test_distinct_sharded_converges():
+    from go_avalanche_tpu.parallel import sharded
+    from go_avalanche_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh(n_node_shards=4, n_tx_shards=2)
+    cfg = AvalancheConfig(sample_with_replacement=False)
+    n, t = 32, 8
+    state = sharded.shard_state(av.init(jax.random.key(0), n, t, cfg), mesh)
+    final = sharded.run_sharded(mesh, state, cfg, max_rounds=300)
     assert bool(vr.has_finalized(final.records.confidence).all())

@@ -1,0 +1,181 @@
+"""Streaming conflict-DAG (`models/streaming_dag.py`).
+
+The north-star composition under test: conflict sets stream through a
+bounded window at whole-set granularity, double-spends resolve to exactly
+one winner per set, outcomes match the dense DAG model, and the window
+bound holds throughout — BASELINE.json's "1M pending txs" x "UTXO
+conflict-set DAG" requirement in one mechanism.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_avalanche_tpu.config import AdversaryStrategy, AvalancheConfig
+from go_avalanche_tpu.models import dag, streaming_dag as sd
+from go_avalanche_tpu.ops import voterecord as vr
+
+
+def make_backlog(n_sets=12, c=2, scores=None, valid=None, init_pref=None):
+    if scores is None:
+        scores = jnp.arange(n_sets * c, dtype=jnp.int32).reshape(n_sets, c)
+    return sd.make_set_backlog(scores, init_pref=init_pref, valid=valid)
+
+
+def run_stream(n_nodes=16, n_sets=12, c=2, window_sets=4, cfg=None, seed=0,
+               backlog=None, max_rounds=5000):
+    cfg = cfg or AvalancheConfig()
+    if backlog is None:
+        backlog = make_backlog(n_sets, c)
+    state = sd.init(jax.random.key(seed), n_nodes, window_sets, backlog, cfg)
+    final = jax.jit(sd.run, static_argnames=("cfg", "max_rounds"))(
+        state, cfg, max_rounds)
+    return jax.device_get(final)
+
+
+def test_set_backlog_sorted_by_best_member_score():
+    scores = jnp.asarray([[1, 9], [5, 2], [7, 0]], jnp.int32)
+    b = make_backlog(scores=scores)
+    np.testing.assert_array_equal(np.asarray(b.score),
+                                  [[1, 9], [7, 0], [5, 2]])
+
+
+def test_set_backlog_default_pref_is_first_valid_member():
+    valid = jnp.asarray([[True, True], [False, True]])
+    b = sd.make_set_backlog(jnp.asarray([[9, 9], [9, 9]], jnp.int32),
+                            valid=valid)
+    np.testing.assert_array_equal(np.asarray(b.init_pref),
+                                  [[True, False], [False, True]])
+
+
+def test_every_set_resolves_with_exactly_one_winner():
+    final = run_stream()
+    summary = sd.resolution_summary(final)
+    assert summary["sets_settled_fraction"] == 1.0
+    assert summary["sets_one_winner_fraction"] == 1.0
+    out = final.outputs
+    assert np.asarray(out.settled).all()
+    assert (np.asarray(out.accepted).sum(axis=1) == 1).all()
+    assert (np.asarray(out.settle_round)
+            > np.asarray(out.admit_round)).all()
+
+
+def test_winner_is_the_initially_preferred_member():
+    # Honest network, deterministic first-member prior: lane 0 always wins.
+    final = run_stream(n_sets=8, c=3, window_sets=3)
+    acc = np.asarray(final.outputs.accepted)
+    np.testing.assert_array_equal(acc[:, 0], np.ones(8, bool))
+    assert not acc[:, 1:].any()
+
+
+def test_window_bound_holds_throughout():
+    cfg = AvalancheConfig()
+    backlog = make_backlog(n_sets=10, c=2)
+    state = sd.init(jax.random.key(0), 12, 3, backlog, cfg)
+    occupied_max = 0
+    for _ in range(200):
+        state, tel = jax.jit(sd.step, static_argnames=("cfg",))(state, cfg)
+        occupied_max = max(occupied_max, int(tel.occupied_sets))
+        if bool(sd.drained(state, cfg)):
+            break
+    assert occupied_max <= 3
+    assert bool(sd.drained(state, cfg))
+
+
+def test_streaming_dag_matches_dense():
+    """Outcome parity: with the window sized to hold the WHOLE backlog and
+    an identical PRNG key, streaming reduces to the dense DAG — the same
+    per-(node, tx) confidence trajectory, hence identical winners."""
+    n, n_sets, c = 16, 6, 2
+    cfg = AvalancheConfig()
+    scores = jnp.full((n_sets, c), 7, jnp.int32)   # uniform: order is stable
+    backlog = sd.make_set_backlog(scores)
+
+    state = sd.init(jax.random.key(42), n, n_sets, backlog, cfg)
+    final = jax.jit(sd.run, static_argnames=("cfg", "max_rounds"))(
+        state, cfg, 4000)
+
+    cs = jnp.arange(n_sets * c, dtype=jnp.int32) // c
+    dense = dag.init(jax.random.key(42), n, cs, cfg)
+    dense_final = jax.jit(dag.run, static_argnames=("cfg", "max_rounds"))(
+        dense, cfg, 4000)
+
+    conf = dense_final.base.records.confidence
+    dense_fin_acc = np.asarray(vr.has_finalized(conf, cfg)
+                               & vr.is_accepted(conf))
+    dense_votes = dense_fin_acc.sum(axis=0)
+    dense_winner = dense_votes * 2 > n
+
+    out = jax.device_get(final.outputs)
+    assert np.asarray(out.settled).all()
+    np.testing.assert_array_equal(
+        np.asarray(out.accepted).reshape(-1), dense_winner)
+    np.testing.assert_array_equal(
+        np.asarray(out.accept_votes).reshape(-1), dense_votes)
+
+
+def test_streaming_dag_small_window_same_winners_as_dense():
+    """The parity that matters at scale: a bounded window (smaller than the
+    backlog) must still resolve every set to the same winner lane the dense
+    model picks (deterministic honest outcome: the initially preferred
+    member)."""
+    n, n_sets, c = 16, 10, 2
+    cfg = AvalancheConfig()
+    final = run_stream(n_nodes=n, n_sets=n_sets, c=c, window_sets=3, cfg=cfg)
+    acc = np.asarray(final.outputs.accepted)
+    assert np.asarray(final.outputs.settled).all()
+    np.testing.assert_array_equal(acc[:, 0], np.ones(n_sets, bool))
+    assert not acc[:, 1:].any()
+
+
+def test_padded_short_sets_never_win_and_settle_invalid():
+    # Capacity-3 backlog where every set really has 2 members.
+    n_sets, c = 6, 3
+    valid = jnp.ones((n_sets, c), jnp.bool_).at[:, 2].set(False)
+    backlog = sd.make_set_backlog(
+        jnp.full((n_sets, c), 5, jnp.int32), valid=valid)
+    final = run_stream(n_sets=n_sets, c=c, window_sets=2, backlog=backlog)
+    out = final.outputs
+    assert np.asarray(out.settled).all()
+    acc = np.asarray(out.accepted)
+    assert not acc[:, 2].any()            # padding lanes never win
+    assert (acc.sum(axis=1) == 1).all()   # real members still resolve
+
+
+def test_contested_priors_still_resolve_one_winner():
+    """Split initial preferences inside each set (half the nodes prefer
+    member 0, half member 1 — modelled as both-preferred priors): sampling
+    noise must break the tie and every set must still converge to exactly
+    one network winner."""
+    n_sets, c = 8, 2
+    pref = jnp.ones((n_sets, c), jnp.bool_)    # both members start preferred
+    backlog = sd.make_set_backlog(jnp.full((n_sets, c), 3, jnp.int32),
+                                  init_pref=pref)
+    final = run_stream(n_nodes=32, n_sets=n_sets, c=c, window_sets=4,
+                       backlog=backlog, max_rounds=8000)
+    summary = sd.resolution_summary(final)
+    assert summary["sets_settled_fraction"] == 1.0
+    assert summary["sets_one_winner_fraction"] == 1.0
+
+
+def test_streaming_dag_under_byzantine_flip():
+    cfg = AvalancheConfig(byzantine_fraction=0.15, flip_probability=1.0,
+                          adversary_strategy=AdversaryStrategy.FLIP)
+    final = run_stream(n_nodes=32, n_sets=8, c=2, window_sets=4, cfg=cfg,
+                       max_rounds=8000)
+    summary = sd.resolution_summary(final)
+    assert summary["sets_settled_fraction"] == 1.0
+    assert summary["sets_one_winner_fraction"] > 0.9
+
+
+def test_run_scan_telemetry_shapes():
+    cfg = AvalancheConfig()
+    state = sd.init(jax.random.key(0), 8, 2, make_backlog(4, 2), cfg)
+    final, tel = jax.jit(sd.run_scan,
+                         static_argnames=("cfg", "n_rounds"))(state, cfg, 10)
+    assert tel.retired_sets.shape == (10,)
+    assert tel.round.polls.shape == (10,)
+    assert int(tel.occupied_sets[0]) == 2
