@@ -127,10 +127,10 @@ def _local_round(
 
     pollable = (base.added & alive_local[:, None] & base.valid[None, :]
                 & jnp.logical_not(fin) & jnp.logical_not(rival_settled))
-    # Per-shard poll cap, as in `parallel/sharded.py`: exact when T fits
-    # the cap, approximate otherwise.
-    local_cap = max(1, cfg.max_element_poll // n_tx_shards)
-    polled = av.capped_poll_mask(pollable, base.score_rank, local_cap)
+    # Global 4096-inv cap across tx shards, as in `parallel/sharded.py`.
+    polled = sharded.global_capped_poll_mask(pollable, base.score_rank,
+                                             cfg.max_element_poll,
+                                             n_tx_shards)
 
     # Uniform or latency-weighted peer draws, exactly as in
     # `parallel/sharded._local_round`: the weighted CDF is global/replicated

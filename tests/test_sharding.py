@@ -102,3 +102,57 @@ def test_output_shardings_preserved():
     in_sh = state.records.confidence.sharding
     out_sh = s1.records.confidence.sharding
     assert in_sh.is_equivalent_to(out_sh, 2)
+
+
+def test_global_capped_poll_mask_matches_flat_oracle(mesh):
+    """The sharded poll cap must reproduce the flat `capped_poll_mask`
+    EXACTLY — the global `AvalancheMaxElementPoll` semantics
+    (`avalanche.go:17`), not the old per-shard cap//n approximation."""
+    from jax.sharding import PartitionSpec as P
+
+    n, t, cap = 16, 64, 10
+    n_tx = mesh.shape["txs"]
+    rng = np.random.default_rng(0)
+    pollable = jnp.asarray(rng.random((n, t)) < 0.6)
+    rank = jnp.asarray(rng.permutation(t), jnp.int32)
+
+    flat = av.capped_poll_mask(pollable, rank, cap)
+
+    fn = jax.shard_map(
+        lambda p, r: sharded.global_capped_poll_mask(p, r, cap, n_tx),
+        mesh=mesh, in_specs=(P("nodes", "txs"), P("txs")),
+        out_specs=P("nodes", "txs"), check_vma=False)
+    out = jax.jit(fn)(pollable, rank)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(flat))
+    # Cap is tight: nodes with >= cap pollable targets keep exactly cap.
+    counts = np.asarray(out).sum(axis=1)
+    full = np.asarray(pollable).sum(axis=1) >= cap
+    assert (counts[full] == cap).all()
+
+
+def test_gossip_heard_packed_matches_unpacked_oracle(mesh):
+    """The bit-packed or-scatter + all_to_all OR must equal the plain
+    'any pollster polled me about t' relation computed densely."""
+    from jax.sharding import PartitionSpec as P
+
+    n, t, k = 32, 32, 4
+    n_node_shards = mesh.shape["nodes"]
+    rng = np.random.default_rng(1)
+    peers = jnp.asarray(rng.integers(0, n, (n, k)), jnp.int32)
+    polled = jnp.asarray(rng.random((n, t)) < 0.5)
+
+    expected = np.zeros((n, t), bool)
+    for i in range(n):
+        for j in range(k):
+            expected[int(peers[i, j])] |= np.asarray(polled[i])
+
+    def local(peers_blk, polled_blk):
+        t_local = polled_blk.shape[1]
+        packed = sharded._gossip_heard_packed(peers_blk, polled_blk, n)
+        return unpack_bool_plane(packed, t_local)
+
+    fn = jax.shard_map(local, mesh=mesh,
+                       in_specs=(P("nodes", None), P("nodes", "txs")),
+                       out_specs=P("nodes", "txs"), check_vma=False)
+    out = jax.jit(fn)(peers, polled)
+    np.testing.assert_array_equal(np.asarray(out), expected)
