@@ -1,0 +1,464 @@
+// Package connector is a Go client for the go_avalanche_tpu Connector
+// server — the wire form of the seam the reference example drives in
+// process (examples/basic-preconcensus/main.go:110-193): CreateNode /
+// AddTarget / GetInvs / Query / RegisterVotes per node, plus remote
+// control of the batched TPU simulator (SimInit / SimRun).
+//
+// It mirrors go_avalanche_tpu/connector/client.py and
+// native/connector/client.h method-for-method, speaking the v2 frame
+// format defined in go_avalanche_tpu/connector/protocol.py (the single
+// source of truth):
+//
+//	u32be frame_length | u8 message_type | little-endian payload
+//
+// Vendored: this environment has no Go toolchain, so correctness is
+// pinned by golden byte fixtures generated from the Python protocol
+// module (testdata/*.bin, regenerated+verified by
+// tests/test_connector_go.py) and replayed by client_test.go wherever a
+// Go toolchain exists.
+package connector
+
+import (
+	"bufio"
+	"bytes"
+	"encoding/binary"
+	"fmt"
+	"io"
+	"math"
+	"net"
+)
+
+// Message types (protocol.py MsgType).
+const (
+	msgPing          = 1
+	msgPong          = 2
+	msgCreateNode    = 3
+	msgAddTarget     = 4
+	msgGetInvs       = 5
+	msgQuery         = 6
+	msgRegisterVotes = 7
+	msgIsAccepted    = 8
+	msgGetConfidence = 9
+	msgGetRound      = 10
+	msgSimInit       = 11
+	msgSimRun        = 12
+	msgOK            = 14
+	msgI64           = 15
+	msgShutdown      = 16
+	msgInvs          = 17
+	msgVotes         = 18
+	msgUpdates       = 19
+	msgSimStats      = 20
+	msgError         = 21
+)
+
+const maxFrame = 64 * 1024 * 1024 // sanity bound, matches protocol.py
+
+// Vote is one (hash, err) pair; err semantics follow the reference
+// (vote.go:3-22): 0 = yes, 1 = no, -1 = neutral/abstain.
+type Vote struct {
+	Hash int64
+	Err  int32
+}
+
+// Update is one (hash, status) pair; status values follow the reference
+// Status enum (avalanche.go:44-56).
+type Update struct {
+	Hash   int64
+	Status int8
+}
+
+// SimStats is the SIM_RUN reply (protocol.py SIM_STATS).
+type SimStats struct {
+	Round             uint32
+	FinalizedFraction float64
+	Polls             int64
+	VotesApplied      int64
+	Flips             int64
+	Finalizations     int64
+}
+
+// Adversary strategy bytes for SimInit's v2 tail (config.py
+// AdversaryStrategy order).
+const (
+	AdversaryFlip           = 0
+	AdversaryEquivocate     = 1
+	AdversaryOpposeMajority = 2
+)
+
+// Client drives one Connector server connection. Not safe for concurrent
+// use; open one Client per goroutine (the server is one-thread-per-conn).
+type Client struct {
+	conn net.Conn
+	r    *bufio.Reader
+}
+
+// Dial connects to a Connector server at host:port.
+func Dial(addr string) (*Client, error) {
+	conn, err := net.Dial("tcp", addr)
+	if err != nil {
+		return nil, err
+	}
+	if tc, ok := conn.(*net.TCPConn); ok {
+		_ = tc.SetNoDelay(true)
+	}
+	return &Client{conn: conn, r: bufio.NewReader(conn)}, nil
+}
+
+// Close tears down the connection.
+func (c *Client) Close() error { return c.conn.Close() }
+
+// ---------------------------------------------------------------- framing
+
+// encodeFrame builds one wire frame: u32be length, u8 type, payload.
+func encodeFrame(msgType byte, payload []byte) []byte {
+	out := make([]byte, 4+1+len(payload))
+	binary.BigEndian.PutUint32(out, uint32(1+len(payload)))
+	out[4] = msgType
+	copy(out[5:], payload)
+	return out
+}
+
+func (c *Client) call(msgType byte, payload []byte, expect byte) ([]byte, error) {
+	if _, err := c.conn.Write(encodeFrame(msgType, payload)); err != nil {
+		return nil, err
+	}
+	var header [4]byte
+	if _, err := io.ReadFull(c.r, header[:]); err != nil {
+		return nil, err
+	}
+	length := binary.BigEndian.Uint32(header[:])
+	if length < 1 || length > maxFrame {
+		return nil, fmt.Errorf("connector: bad frame length %d", length)
+	}
+	body := make([]byte, length)
+	if _, err := io.ReadFull(c.r, body); err != nil {
+		return nil, err
+	}
+	replyType, reply := body[0], body[1:]
+	if replyType == msgError {
+		return nil, fmt.Errorf("connector: server error: %s", decodeError(reply))
+	}
+	if replyType != expect {
+		return nil, fmt.Errorf("connector: unexpected reply %d to %d",
+			replyType, msgType)
+	}
+	return reply, nil
+}
+
+// ------------------------------------------------------- payload encoding
+//
+// All little-endian, mirroring protocol.py's struct formats.
+
+type wbuf struct{ bytes.Buffer }
+
+func (w *wbuf) u8(v byte)     { w.WriteByte(v) }
+func (w *wbuf) u32(v uint32)  { var b [4]byte; binary.LittleEndian.PutUint32(b[:], v); w.Write(b[:]) }
+func (w *wbuf) i32(v int32)   { w.u32(uint32(v)) }
+func (w *wbuf) i64(v int64)   { var b [8]byte; binary.LittleEndian.PutUint64(b[:], uint64(v)); w.Write(b[:]) }
+func (w *wbuf) f64(v float64) { var b [8]byte; binary.LittleEndian.PutUint64(b[:], math.Float64bits(v)); w.Write(b[:]) }
+func (w *wbuf) boolByte(v bool) {
+	if v {
+		w.u8(1)
+	} else {
+		w.u8(0)
+	}
+}
+
+func encodeI64s(values []int64) []byte {
+	var w wbuf
+	w.u32(uint32(len(values)))
+	for _, v := range values {
+		w.i64(v)
+	}
+	return w.Bytes()
+}
+
+func encodeVotes(votes []Vote) []byte {
+	var w wbuf
+	w.u32(uint32(len(votes)))
+	for _, v := range votes {
+		w.i64(v.Hash)
+		w.i32(v.Err)
+	}
+	return w.Bytes()
+}
+
+// ------------------------------------------------------- payload decoding
+
+type rbuf struct {
+	b   []byte
+	off int
+	err error
+}
+
+func (r *rbuf) need(n int) []byte {
+	if r.err != nil {
+		return nil
+	}
+	if r.off+n > len(r.b) {
+		r.err = fmt.Errorf("connector: truncated payload (%d+%d > %d)",
+			r.off, n, len(r.b))
+		return nil
+	}
+	s := r.b[r.off : r.off+n]
+	r.off += n
+	return s
+}
+
+func (r *rbuf) u8() byte {
+	if s := r.need(1); s != nil {
+		return s[0]
+	}
+	return 0
+}
+
+func (r *rbuf) u32() uint32 {
+	if s := r.need(4); s != nil {
+		return binary.LittleEndian.Uint32(s)
+	}
+	return 0
+}
+
+func (r *rbuf) i64() int64 {
+	if s := r.need(8); s != nil {
+		return int64(binary.LittleEndian.Uint64(s))
+	}
+	return 0
+}
+
+func (r *rbuf) f64() float64 {
+	if s := r.need(8); s != nil {
+		return math.Float64frombits(binary.LittleEndian.Uint64(s))
+	}
+	return 0
+}
+
+func decodeI64s(payload []byte) ([]int64, error) {
+	r := rbuf{b: payload}
+	n := r.u32()
+	out := make([]int64, 0, n)
+	for i := uint32(0); i < n; i++ {
+		out = append(out, r.i64())
+	}
+	return out, r.err
+}
+
+func decodeVotes(payload []byte) ([]Vote, error) {
+	r := rbuf{b: payload}
+	n := r.u32()
+	out := make([]Vote, 0, n)
+	for i := uint32(0); i < n; i++ {
+		h := r.i64()
+		e := int32(r.u32())
+		out = append(out, Vote{Hash: h, Err: e})
+	}
+	return out, r.err
+}
+
+func decodeUpdates(payload []byte) (bool, []Update, error) {
+	r := rbuf{b: payload}
+	ok := r.u8() != 0
+	n := r.u32()
+	out := make([]Update, 0, n)
+	for i := uint32(0); i < n; i++ {
+		h := r.i64()
+		s := int8(r.u8())
+		out = append(out, Update{Hash: h, Status: s})
+	}
+	return ok, out, r.err
+}
+
+func decodeSimStats(payload []byte) (SimStats, error) {
+	r := rbuf{b: payload}
+	st := SimStats{
+		Round:             r.u32(),
+		FinalizedFraction: r.f64(),
+		Polls:             r.i64(),
+		VotesApplied:      r.i64(),
+		Flips:             r.i64(),
+		Finalizations:     r.i64(),
+	}
+	return st, r.err
+}
+
+func decodeError(payload []byte) string {
+	r := rbuf{b: payload}
+	n := r.u32()
+	if s := r.need(int(n)); s != nil {
+		return string(s)
+	}
+	return "<malformed error frame>"
+}
+
+// --------------------------------------------------------------- messages
+
+// Ping checks liveness.
+func (c *Client) Ping() (bool, error) {
+	_, err := c.call(msgPing, nil, msgPong)
+	return err == nil, err
+}
+
+// CreateNode instantiates a per-node consensus engine on the server
+// (the per-node Processor, main.go:73-87).
+func (c *Client) CreateNode(nodeID int64) (bool, error) {
+	var w wbuf
+	w.i64(nodeID)
+	r, err := c.call(msgCreateNode, w.Bytes(), msgOK)
+	if err != nil {
+		return false, err
+	}
+	return len(r) > 0 && r[0] != 0, nil
+}
+
+// AddTarget begins reconciling a target on a node (processor.go:45-58).
+func (c *Client) AddTarget(nodeID, hash int64, accepted, valid bool,
+	score int64) (bool, error) {
+	var w wbuf
+	w.i64(nodeID)
+	w.i64(hash)
+	w.boolByte(accepted)
+	w.boolByte(valid)
+	w.i64(score)
+	r, err := c.call(msgAddTarget, w.Bytes(), msgOK)
+	if err != nil {
+		return false, err
+	}
+	return len(r) > 0 && r[0] != 0, nil
+}
+
+// GetInvs returns the node's next poll inventory (processor.go:144-170).
+func (c *Client) GetInvs(nodeID int64) ([]int64, error) {
+	var w wbuf
+	w.i64(nodeID)
+	r, err := c.call(msgGetInvs, w.Bytes(), msgInvs)
+	if err != nil {
+		return nil, err
+	}
+	return decodeI64s(r)
+}
+
+// Query polls a peer node: it gossip-admits unseen targets and answers
+// one vote per inv from its own acceptance state (main.go:168-193).
+func (c *Client) Query(nodeID int64, hashes []int64) ([]Vote, error) {
+	var w wbuf
+	w.i64(nodeID)
+	w.Write(encodeI64s(hashes))
+	r, err := c.call(msgQuery, w.Bytes(), msgVotes)
+	if err != nil {
+		return nil, err
+	}
+	return decodeVotes(r)
+}
+
+// RegisterVotes ingests a peer's response (processor.go:61-122). Returns
+// the server's ok flag plus any status updates.
+func (c *Client) RegisterVotes(nodeID, fromNode, round int64,
+	votes []Vote) (bool, []Update, error) {
+	var w wbuf
+	w.i64(nodeID)
+	w.i64(fromNode)
+	w.i64(round)
+	w.Write(encodeVotes(votes))
+	r, err := c.call(msgRegisterVotes, w.Bytes(), msgUpdates)
+	if err != nil {
+		return false, nil, err
+	}
+	return decodeUpdates(r)
+}
+
+// IsAccepted reports the node's current preference for a target
+// (processor.go:125-130; unknown/finalized-deleted targets are false).
+func (c *Client) IsAccepted(nodeID, hash int64) (bool, error) {
+	var w wbuf
+	w.i64(nodeID)
+	w.i64(hash)
+	r, err := c.call(msgIsAccepted, w.Bytes(), msgOK)
+	if err != nil {
+		return false, err
+	}
+	return len(r) > 0 && r[0] != 0, nil
+}
+
+// GetConfidence returns the node's confidence in a target, or -1 if
+// unknown (the wire has no exceptions).
+func (c *Client) GetConfidence(nodeID, hash int64) (int64, error) {
+	var w wbuf
+	w.i64(nodeID)
+	w.i64(hash)
+	r, err := c.call(msgGetConfidence, w.Bytes(), msgI64)
+	if err != nil {
+		return 0, err
+	}
+	rr := rbuf{b: r}
+	v := rr.i64()
+	return v, rr.err
+}
+
+// GetRound returns the node's poll round counter.
+func (c *Client) GetRound(nodeID int64) (int64, error) {
+	var w wbuf
+	w.i64(nodeID)
+	r, err := c.call(msgGetRound, w.Bytes(), msgI64)
+	if err != nil {
+		return 0, err
+	}
+	rr := rbuf{b: r}
+	v := rr.i64()
+	return v, rr.err
+}
+
+// SimInitConfig parameterizes the batched TPU simulator (SIM_INIT v2).
+type SimInitConfig struct {
+	Nodes             uint32
+	Txs               uint32
+	Seed              uint32
+	K                 uint32
+	FinalizationScore uint32
+	Gossip            bool
+	ByzantineFraction float64
+	DropProbability   float64
+	// v2 tail (Adversary*: one of the Adversary* constants).
+	AdversaryStrategy byte
+	FlipProbability   float64
+	ChurnProbability  float64
+}
+
+// SimInit (re)initializes the server-side batched simulator.
+func (c *Client) SimInit(cfg SimInitConfig) (bool, error) {
+	var w wbuf
+	w.u32(cfg.Nodes)
+	w.u32(cfg.Txs)
+	w.u32(cfg.Seed)
+	w.u32(cfg.K)
+	w.u32(cfg.FinalizationScore)
+	w.boolByte(cfg.Gossip)
+	w.f64(cfg.ByzantineFraction)
+	w.f64(cfg.DropProbability)
+	w.u8(cfg.AdversaryStrategy)
+	w.f64(cfg.FlipProbability)
+	w.f64(cfg.ChurnProbability)
+	r, err := c.call(msgSimInit, w.Bytes(), msgOK)
+	if err != nil {
+		return false, err
+	}
+	return len(r) > 0 && r[0] != 0, nil
+}
+
+// SimRun advances the batched simulator n rounds and returns aggregate
+// statistics.
+func (c *Client) SimRun(rounds uint32) (SimStats, error) {
+	var w wbuf
+	w.u32(rounds)
+	r, err := c.call(msgSimRun, w.Bytes(), msgSimStats)
+	if err != nil {
+		return SimStats{}, err
+	}
+	return decodeSimStats(r)
+}
+
+// ShutdownServer asks the server to stop accepting work.
+func (c *Client) ShutdownServer() error {
+	_, err := c.call(msgShutdown, nil, msgOK)
+	return err
+}

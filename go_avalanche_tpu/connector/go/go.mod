@@ -1,0 +1,3 @@
+module go-avalanche-tpu/connector
+
+go 1.21

@@ -1,0 +1,59 @@
+"""The Go client's golden fixtures stay pinned to the Python protocol.
+
+The vendored Go client (`go_avalanche_tpu/connector/go/`) can't be compiled
+here (no Go toolchain); its byte-level contract is enforced by comparing
+the checked-in `testdata/*.bin` fixtures against what `protocol.py`
+generates TODAY, plus decode checks mirroring `client_test.go`'s expected
+values.  If this test fails, regenerate with
+`python -m go_avalanche_tpu.connector.go_fixtures` and re-run `go test`
+wherever Go exists.
+"""
+
+import os
+import struct
+
+from go_avalanche_tpu.connector import go_fixtures, protocol as proto
+
+
+def test_fixture_files_match_protocol_exactly():
+    fixtures = go_fixtures.build_fixtures()
+    assert len(fixtures) >= 20
+    for name, frame in fixtures.items():
+        path = os.path.join(go_fixtures.TESTDATA_DIR, name + ".bin")
+        assert os.path.exists(path), f"{name}: fixture file missing — " \
+            "run python -m go_avalanche_tpu.connector.go_fixtures"
+        with open(path, "rb") as fh:
+            on_disk = fh.read()
+        assert on_disk == frame, f"{name}: fixture drifted from protocol.py"
+
+
+def test_fixture_frames_are_wellformed():
+    for name, frame in go_fixtures.build_fixtures().items():
+        (length,) = struct.unpack(">I", frame[:4])
+        assert length == len(frame) - 4, name
+        assert frame[4] in set(proto.MsgType), name
+
+
+def test_reply_fixture_decoded_values_match_go_test_expectations():
+    """The literals hard-coded in client_test.go, checked on this side."""
+    f = go_fixtures.build_fixtures()
+
+    def payload(name):
+        return f[name][5:]
+
+    invs, _ = proto.unpack_i64s(payload("rep_invs"))
+    assert invs == [66, 65]
+    votes, _ = proto.unpack_votes(payload("rep_votes"))
+    assert votes == [(65, 0), (66, 1), (67, -1 & 0xFFFFFFFF)] or \
+        votes == [(65, 0), (66, 1), (67, -1)]
+    ok, updates = proto.unpack_updates(payload("rep_updates"))
+    assert ok and updates == [(65, 3), (66, 0)]
+    stats = struct.unpack("<Id4q", payload("rep_sim_stats"))
+    assert stats == (250, 0.875, 1000, 8000, 3, 42)
+    assert proto.unpack_error(payload("rep_error")) == "boom"
+
+
+def test_go_sources_are_vendored():
+    godir = os.path.join(os.path.dirname(go_fixtures.__file__), "go")
+    for fname in ("client.go", "client_test.go", "go.mod", "README.md"):
+        assert os.path.exists(os.path.join(godir, fname)), fname
