@@ -35,6 +35,7 @@ from go_avalanche_tpu.config import AdversaryStrategy, AvalancheConfig
 from go_avalanche_tpu.models import avalanche as av
 from go_avalanche_tpu.models import backlog as bl
 from go_avalanche_tpu.models import dag, snowball
+from go_avalanche_tpu.models import streaming_dag as sdg
 from go_avalanche_tpu.ops import voterecord as vr
 from go_avalanche_tpu.utils import metrics
 
@@ -121,10 +122,11 @@ def config3_byzantine_mix(quick: bool) -> Dict:
     network-wide stall with no finalizations.  Pinned by
     `tests/test_adversary.py::test_equivocation_stalls_dag_liveness`.
     """
-    # 50k x 1024: the DAG's per-round segment ops materialize int32
-    # [T, N] / [S, N] intermediates; 100k rows overflows the v5e HBM
-    # headroom under the while_loop (worker crash), 50k fits.
-    n, t = (512, 64) if quick else (50_000, 1024)
+    # 100k nodes per BASELINE configs[3].  t=512 keeps the DAG's per-round
+    # segment intermediates ([T, N] planes) inside v5e HBM headroom under
+    # the while_loop at 100k rows (1024-tx columns fit at 50k but crash the
+    # worker at 100k).
+    n, t = (512, 64) if quick else (100_000, 512)
     max_rounds = 400 if quick else 600
     conflict_set = jnp.arange(t, dtype=jnp.int32) // 2
     out: Dict = {"name": (f"byzantine mix ({n} nodes, 20% adversarial, "
@@ -201,6 +203,34 @@ def config5_backlog_scale(quick: bool) -> Dict:
     }
 
 
+def config6_streaming_conflict(quick: bool) -> Dict:
+    """The literal north-star workload: 100k nodes x 1M pending txs in
+    2-tx UTXO conflict sets, streamed through a bounded conflict window
+    (models/streaming_dag) on one chip."""
+    n, b_sets, c, w_sets = ((64, 1024, 2, 32) if quick
+                            else (100_000, 500_000, 2, 1024))
+    cfg = AvalancheConfig(gossip=False, max_element_poll=w_sets * c)
+    scores = jax.random.randint(jax.random.key(1), (b_sets, c), 0, 1 << 20)
+    backlog = sdg.make_set_backlog(scores)
+    state = sdg.init(jax.random.key(0), n, w_sets, backlog, cfg)
+    t0 = time.time()
+    final = jax.jit(sdg.run, static_argnames=("cfg", "max_rounds"))(
+        state, cfg, 500_000)
+    rounds = int(jax.device_get(final.dag.base.round))
+    wall = time.time() - t0
+    summary = sdg.resolution_summary(final)
+    return {
+        "name": (f"streaming conflict-DAG ({n} nodes, {b_sets * c} txs in "
+                 f"{b_sets} conflict sets, {w_sets}-set window)"),
+        "rounds": rounds,
+        "sets_settled_fraction": summary["sets_settled_fraction"],
+        "sets_one_winner_fraction": summary["sets_one_winner_fraction"],
+        "txs_per_sec": round(summary["txs_settled"] / wall, 1),
+        "settle_latency_median": summary["settle_latency_median"],
+        "wall_s": round(wall, 3),
+    }
+
+
 CONFIGS = [
     config0_reference_example,
     config1_snowball,
@@ -208,6 +238,7 @@ CONFIGS = [
     config3_byzantine_mix,
     config4_churn_latency,
     config5_backlog_scale,
+    config6_streaming_conflict,
 ]
 
 
@@ -219,8 +250,9 @@ def render_results_md(results, backend: str) -> str:
         "throughput north star is measured separately by `bench.py`.",
         "Sharded execution (config \"byzantine mix\" names a sharded DAG) is",
         "validated on an 8-device virtual mesh by `tests/test_sharded_dag.py`",
-        "(and `tests/test_sharding.py` for the plain sharded round);",
-        "wall-clock here is single-chip.",
+        "(and `tests/test_sharding.py` for the plain sharded round,",
+        "`tests/test_sharded_streaming_dag.py` for the streaming",
+        "conflict-DAG); wall-clock here is single-chip.",
         "",
         "| Config | Rounds | Outcome | Median finality | p90 | Wall (s) |",
         "|---|---|---|---|---|---|",
@@ -235,7 +267,132 @@ def render_results_md(results, backend: str) -> str:
             f"| {fin.get('median', '—')} | {fin.get('p90', '—')} "
             f"| {r['wall_s']} |")
     lines.append("")
+    lines.extend(_render_analysis_sections())
     return "\n".join(lines)
+
+
+def _render_analysis_sections() -> list:
+    """Appendix sections generated from recorded analysis artifacts
+    (`examples/out/*.json`), when present."""
+    lines = []
+
+    fit_path = REPO / "examples" / "out" / "finality_fit.json"
+    if fit_path.exists():
+        fit = json.loads(fit_path.read_text()).get("log_n_fit")
+        if fit:
+            lines += [
+                "## Paper fidelity: rounds-to-finality vs log(n)",
+                "",
+                "The Avalanche paper's claim that finality latency grows",
+                "~logarithmically with network size, quantified "
+                "(`examples/finality_curves.py --json-out ...`, honest "
+                "networks, k=8 so one round ingests 8 votes):",
+                "",
+                f"    median = {fit['a']} + {fit['b_rounds_per_doubling']}"
+                f" * log2(n)    R^2(log) = {fit['r2_log']}"
+                f"  vs  R^2(linear-in-n) = {fit['r2_linear_in_n']}",
+                "",
+                "| nodes | measured median | fitted | residual |",
+                "|---|---|---|---|",
+            ]
+            for p in fit["points"]:
+                lines.append(f"| {p['nodes']} | {p['measured']} "
+                             f"| {p['fitted']} | {p['residual']:+.2f} |")
+            lines += [
+                "",
+                "The log fit's residuals stay within a fraction of a round "
+                "across a",
+                "32x size range while the linear-in-n fit underperforms — "
+                "the curve",
+                "is logarithmic, as the paper predicts "
+                "(artifact: `examples/out/finality_fit.json`).",
+                "",
+            ]
+
+    eq_path = REPO / "examples" / "out" / "equivocation_threshold.json"
+    if eq_path.exists():
+        eq = json.loads(eq_path.read_text())
+        cells = eq["cells"]
+        lines += [
+            "## Liveness threshold under equivocation",
+            "",
+            "Sweep of byzantine_fraction (eps) x flip_probability (p) on "
+            "the conflict",
+            "DAG (`examples/equivocation_threshold.py`; fraction of "
+            "(honest node, set)",
+            "pairs resolved within "
+            f"{eq['config']['rounds']} rounds at "
+            f"{eq['config']['nodes']} nodes):",
+            "",
+            "| strategy | p | stall threshold eps (resolved < 0.5) | "
+            "effective lie rate q = eps*p |",
+            "|---|---|---|---|",
+        ]
+        for key, eps in eq["stall_threshold_eps"].items():
+            strategy, p = key.rsplit("_p", 1)
+            q = round(float(p) * eps, 4) if eps is not None else None
+            lines.append(
+                f"| {strategy} | {p} | {eps if eps is not None else 'none (live through eps=0.3)'} "
+                f"| {q if q is not None else '—'} |")
+        # Collapse check: the threshold is organized by q, not by eps or p.
+        # live_max = largest q such that EVERY equivocate cell at q' <= q
+        # resolved >= 0.95; stall_min = smallest q such that EVERY cell at
+        # q' >= q resolved < 0.5.  The band between them is the transition.
+        eq_cells = [c for c in cells if c["strategy"] == "equivocate"]
+        qs = sorted({c["q"] for c in eq_cells})
+        live_max = None
+        for q in qs:
+            if all(c["resolved"] >= 0.95 for c in eq_cells if c["q"] <= q):
+                live_max = q
+        stall_min = None
+        for q in reversed(qs):
+            if all(c["resolved"] < 0.5 for c in eq_cells if c["q"] >= q):
+                stall_min = q
+        if live_max is None or stall_min is None:
+            return lines + [
+                "",
+                "**Finding.** The sweep did not produce a clean q-organized "
+                "live/stall split",
+                "(see the cells in the artifact) — regenerate with "
+                "`examples/equivocation_threshold.py`.",
+                "",
+            ]
+        lines += [
+            "",
+            "**Finding.** The equivocation stall is organized by the "
+            "effective lie",
+            f"rate q = eps*p: every cell with q <= {live_max} stays live "
+            "(resolved >= 0.95)",
+            f"and every cell with q >= {stall_min} stalls (resolved < "
+            "0.5), regardless of",
+            "how q factors into eps x p; the transition band between them "
+            "is narrow.",
+            "The threshold (q* ~ 0.02-0.04) sits an order of magnitude "
+            "below the",
+            "vote-window chit-starvation bound (P[Bin(8, 1-q/2) >= 7] is "
+            "still ~0.98",
+            "at q = 0.05), so the adversary is NOT starving the 8-vote "
+            "window — it is",
+            "attacking the metastable preference loop: equivocators feed "
+            "losing lanes",
+            "conclusive-yes runs until `preferred_in_set` diverges across "
+            "honest nodes.",
+            "FLIP at the same q stays fully live through q = 0.3: coherent "
+            "lies are",
+            "out-voted; only *inconsistent* lies (equivocation) attack "
+            "liveness. This",
+            "matches the Avalanche paper's scope: its liveness guarantee "
+            "covers only",
+            "*virtuous* (conflict-free) transactions, and it explicitly "
+            "allows rogue",
+            "double-spends to remain undecided forever — the stall is "
+            "protocol-real,",
+            "not a simulator artifact, and the simulator now quantifies "
+            "where it",
+            "begins (artifact: `examples/out/equivocation_threshold.json`).",
+            "",
+        ]
+    return lines
 
 
 def main() -> None:

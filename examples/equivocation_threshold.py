@@ -1,0 +1,135 @@
+"""Locate the DAG liveness threshold under the equivocation adversary.
+
+RESULTS.md records that 20% per-target equivocators stall conflict-set
+resolution completely (the canonical Avalanche liveness attack), while 20%
+FLIP liars are simply out-voted.  This sweep turns that single observation
+into a threshold map: byzantine_fraction (eps) x flip_probability (p) on
+the conflict-DAG model, for both EQUIVOCATE and FLIP, measuring the
+fraction of (honest node, conflict set) pairs resolved within a round
+budget.
+
+Sweep economics: eps only enters at `init` (the byzantine mask is sim
+*state*), so the grid costs one XLA compile per distinct p per strategy —
+not per cell.
+
+The quantity that organizes the result is the **effective lie rate**
+q = eps * p: the probability that any one sampled response is adversarial.
+For the winner lane of a set, an equivocator answers yes with prob 1/2, so
+the per-vote yes-probability seen by an honest node is 1 - q/2 and a
+window (8) needs quorum (7) yes bits to bump confidence once
+(`vote.go:55-69`).  A conclusive-NO needs >= 7 of 8 lying-no bits —
+vanishing for small q — so the first-order stall mechanism is not
+preference flipping but *chit starvation on the losers*: equivocators feed
+the losing lanes conclusive-yes runs, the losers' confidence words rise,
+`preferred_in_set` ties break differently on different nodes, and honest
+voters stop agreeing which lane to support (the votes-own-preference
+coupling).  The empirical threshold below is therefore far lower than the
+binomial chit-starvation bound P[Bin(8, 1-q/2) >= 7], and THAT is the
+finding: the adversary attacks the metastable preference loop, not the
+vote window.
+
+Usage:
+    python examples/equivocation_threshold.py [--nodes 512] [--txs 64]
+        [--rounds 600] [--json-out examples/out/equivocation_threshold.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")  # allow running from the repo root
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from go_avalanche_tpu.config import AdversaryStrategy, AvalancheConfig
+from go_avalanche_tpu.models import dag
+from go_avalanche_tpu.ops import voterecord as vr
+
+EPS_GRID = (0.025, 0.05, 0.075, 0.1, 0.15, 0.2, 0.3)
+P_GRID = (0.25, 0.5, 0.75, 1.0)
+
+
+def resolved_fraction(state: dag.DagSimState, cfg: AvalancheConfig,
+                      set_size: int) -> float:
+    """Fraction of (honest live node, set) pairs with exactly one
+    finalized-accepted winner."""
+    conf = state.base.records.confidence
+    fin_acc = np.asarray(jax.device_get(
+        vr.has_finalized(conf, cfg) & vr.is_accepted(conf)))
+    honest = np.asarray(jax.device_get(
+        jnp.logical_not(state.base.byzantine) & state.base.alive))
+    n, t = fin_acc.shape
+    winners = fin_acc.reshape(n, t // set_size, set_size).sum(axis=2)
+    return float((winners[honest] == 1).mean()) if honest.any() else 0.0
+
+
+def sweep_cell(n_nodes: int, n_txs: int, set_size: int, rounds: int,
+               eps: float, p: float, strategy: AdversaryStrategy,
+               seed: int = 0) -> dict:
+    cfg = AvalancheConfig(byzantine_fraction=eps, flip_probability=p,
+                          adversary_strategy=strategy)
+    cs = jnp.arange(n_txs, dtype=jnp.int32) // set_size
+    state = dag.init(jax.random.key(seed), n_nodes, cs, cfg)
+    final, _ = jax.jit(dag.run_scan, static_argnames=("cfg", "n_rounds"))(
+        state, cfg, rounds)
+    frac = resolved_fraction(final, cfg, set_size)
+    return {"eps": eps, "p": p, "q": round(eps * p, 4),
+            "strategy": strategy.value, "resolved": round(frac, 4)}
+
+
+def main(argv=None) -> dict:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--nodes", type=int, default=512)
+    ap.add_argument("--txs", type=int, default=64)
+    ap.add_argument("--conflict-size", type=int, default=2)
+    ap.add_argument("--rounds", type=int, default=600)
+    ap.add_argument("--json-out", type=str,
+                    default="examples/out/equivocation_threshold.json")
+    args = ap.parse_args(argv)
+
+    cells = []
+    t0 = time.time()
+    for strategy in (AdversaryStrategy.EQUIVOCATE, AdversaryStrategy.FLIP):
+        for p in P_GRID:
+            for eps in EPS_GRID:
+                cell = sweep_cell(args.nodes, args.txs, args.conflict_size,
+                                  args.rounds, eps, p, strategy)
+                cells.append(cell)
+                print(f"{strategy.value:>12} eps={eps:<5} p={p:<4} "
+                      f"q={cell['q']:<6} resolved={cell['resolved']}",
+                      flush=True)
+
+    # Threshold per (strategy, p): smallest eps with resolved < 0.5.
+    thresholds = {}
+    for strategy in ("equivocate", "flip"):
+        for p in P_GRID:
+            col = [c for c in cells
+                   if c["strategy"] == strategy and c["p"] == p]
+            stalled = [c["eps"] for c in col if c["resolved"] < 0.5]
+            thresholds[f"{strategy}_p{p}"] = min(stalled) if stalled else None
+
+    result = {
+        "config": {"nodes": args.nodes, "txs": args.txs,
+                   "conflict_size": args.conflict_size,
+                   "rounds": args.rounds,
+                   "backend": jax.devices()[0].platform},
+        "cells": cells,
+        "stall_threshold_eps": thresholds,
+        "elapsed_s": round(time.time() - t0, 1),
+    }
+    os.makedirs(os.path.dirname(args.json_out), exist_ok=True)
+    with open(args.json_out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(f"\nthresholds (smallest eps with resolved<0.5): {thresholds}")
+    print(f"artifact: {args.json_out} ({result['elapsed_s']}s)")
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -96,7 +96,12 @@ def init(
     n_nodes: int,
     n_txs: int,
     cfg: AvalancheConfig = DEFAULT_CONFIG,
-    init_pref: Optional[jax.Array] = None,   # bool [T]; default all-accepted
+    init_pref: Optional[jax.Array] = None,   # bool [T] or [N, T]; default all-
+                                             #   accepted.  A 2-D plane gives
+                                             #   per-NODE priors — contested
+                                             #   networks (nodes first saw
+                                             #   different spends) rather than
+                                             #   unanimous ones
     scores: Optional[jax.Array] = None,      # [T]; default uniform (tx-like)
     added: Optional[jax.Array] = None,       # bool [N, T]; default all
     valid: Optional[jax.Array] = None,       # bool [T]; default all
@@ -113,6 +118,9 @@ def init(
     """
     if init_pref is None:
         init_pref = jnp.ones((n_txs,), jnp.bool_)
+    init_pref = jnp.asarray(init_pref, jnp.bool_)
+    if init_pref.ndim == 1:
+        init_pref = jnp.broadcast_to(init_pref[None, :], (n_nodes, n_txs))
     if scores is None:
         scores = jnp.ones((n_txs,), jnp.int32)
     if added is None:
@@ -124,8 +132,7 @@ def init(
 
     n_byz = int(round(cfg.byzantine_fraction * n_nodes))
     return AvalancheSimState(
-        records=vr.init_state(jnp.broadcast_to(init_pref[None, :],
-                                               (n_nodes, n_txs))),
+        records=vr.init_state(init_pref),
         added=jnp.asarray(added, jnp.bool_),
         valid=jnp.asarray(valid, jnp.bool_),
         score_rank=score_ranks(scores),

@@ -211,21 +211,18 @@ def _retire_and_refill(
     row_idx = jnp.where(settled, state.slot_set, s_b)   # s_b = dropped write
     out = state.outputs
 
-    def scatter(plane, value_w, fill=None):
-        vals = value_w.reshape(s_w, c)
-        return plane.at[row_idx].set(vals if fill is None else fill,
+    def scatter(plane, rows):
+        return plane.at[row_idx].set(jnp.broadcast_to(rows, (s_w, c)),
                                      mode="drop")
 
     out = SetOutputs(
-        settled=scatter(out.settled, jnp.ones((w,), jnp.bool_)),
-        accepted=scatter(out.accepted, accepted),
-        accept_votes=scatter(out.accept_votes, accept_votes),
-        settle_round=out.settle_round.at[row_idx].set(
-            jnp.broadcast_to(base.round, (s_w, c)).astype(jnp.int32),
-            mode="drop"),
-        admit_round=out.admit_round.at[row_idx].set(
-            jnp.broadcast_to(state.slot_admit_round[:, None], (s_w, c)),
-            mode="drop"),
+        settled=scatter(out.settled, jnp.bool_(True)),
+        accepted=scatter(out.accepted, accepted.reshape(s_w, c)),
+        accept_votes=scatter(out.accept_votes, accept_votes.reshape(s_w, c)),
+        settle_round=scatter(out.settle_round,
+                             base.round.astype(jnp.int32)),
+        admit_round=scatter(out.admit_round,
+                            state.slot_admit_round[:, None]),
     )
 
     # --- refill: free set-slots take the next backlog sets in order.

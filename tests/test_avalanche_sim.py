@@ -141,3 +141,22 @@ def test_scan_and_while_loop_agree_on_settled_state():
     assert bool(av.all_settled(final_scan, cfg))
     # Telemetry: total finalizations = every (node, tx) pair once.
     assert int(np.asarray(tel.finalizations).sum()) == 24 * 3
+
+
+def test_init_accepts_per_node_priors():
+    """2-D init_pref gives contested networks: per-node initial
+    preferences, which still converge to network-wide agreement."""
+    import jax
+
+    cfg = AvalancheConfig()
+    pref = jax.random.bernoulli(jax.random.key(7), 0.5, (48, 4))
+    state = av.init(jax.random.key(0), 48, 4, cfg, init_pref=pref)
+    np.testing.assert_array_equal(
+        np.asarray(vr.is_accepted(state.records.confidence)),
+        np.asarray(pref))
+    final = av.run(state, cfg, max_rounds=500)
+    fin = np.asarray(vr.has_finalized(final.records.confidence, cfg))
+    assert fin.all()
+    # Every tx ends with ONE network-wide answer.
+    acc = np.asarray(vr.is_accepted(final.records.confidence))
+    assert ((acc.all(axis=0)) | (~acc).all(axis=0)).all()

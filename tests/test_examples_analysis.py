@@ -1,0 +1,35 @@
+"""Analysis helpers shipped with the examples (fit + threshold sweep)."""
+
+import numpy as np
+
+
+def test_fit_log_n_recovers_planted_coefficients():
+    from examples.finality_curves import fit_log_n
+
+    ns = [128, 256, 512, 1024, 4096]
+    pts = [{"nodes": n, "median": 10.0 + 2.5 * np.log2(n)} for n in ns]
+    fit = fit_log_n(pts)
+    assert abs(fit["a"] - 10.0) < 1e-6
+    assert abs(fit["b_rounds_per_doubling"] - 2.5) < 1e-6
+    assert fit["r2_log"] == 1.0
+    assert fit["r2_linear_in_n"] < 1.0
+    assert all(abs(p["residual"]) < 1e-6 for p in fit["points"])
+
+
+def test_fit_log_n_flags_linear_growth():
+    from examples.finality_curves import fit_log_n
+
+    ns = [128, 256, 512, 1024, 4096]
+    pts = [{"nodes": n, "median": 0.01 * n} for n in ns]
+    fit = fit_log_n(pts)
+    assert fit["r2_linear_in_n"] > fit["r2_log"]
+
+
+def test_equivocation_sweep_cell_runs_small():
+    from examples.equivocation_threshold import sweep_cell
+    from go_avalanche_tpu.config import AdversaryStrategy
+
+    cell = sweep_cell(32, 8, 2, 60, eps=0.0, p=1.0,
+                      strategy=AdversaryStrategy.FLIP)
+    assert cell["resolved"] == 1.0
+    assert cell["q"] == 0.0
