@@ -128,7 +128,16 @@ class ConnectorClient:
                  drop_probability: float = 0.0,
                  adversary_strategy: str = "flip",
                  flip_probability: float = 1.0,
-                 churn_probability: float = 0.0) -> bool:
+                 churn_probability: float = 0.0,
+                 model: str = "avalanche",
+                 conflict_size: int = 2,
+                 window_sets: int = 0) -> bool:
+        """(Re)initialize the server-side batched simulator.
+
+        `model` selects the family (v3 tail): "avalanche" (default),
+        "dag" (conflict sets of `conflict_size`), or "streaming_dag"
+        (`window_sets` set-slots; 0 = auto-size to sets/8).
+        """
         strategies = [s.value for s in AdversaryStrategy]
         _, r = self._call(
             proto.MsgType.SIM_INIT,
@@ -136,7 +145,9 @@ class ConnectorClient:
                         finalization_score, 1 if gossip else 0,
                         byzantine_fraction, drop_probability)
             + struct.pack("<Bdd", strategies.index(adversary_strategy),
-                          flip_probability, churn_probability),
+                          flip_probability, churn_probability)
+            + struct.pack("<BII", proto.SIM_MODELS.index(model), conflict_size,
+                          window_sets),
             [proto.MsgType.OK])
         return bool(r[0])
 

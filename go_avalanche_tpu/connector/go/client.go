@@ -86,6 +86,13 @@ const (
 	AdversaryOpposeMajority = 2
 )
 
+// Model bytes for SimInit's v3 tail (server.py SIM_MODELS order).
+const (
+	ModelAvalanche    = 0
+	ModelDag          = 1
+	ModelStreamingDag = 2
+)
+
 // Client drives one Connector server connection. Not safe for concurrent
 // use; open one Client per goroutine (the server is one-thread-per-conn).
 type Client struct {
@@ -422,6 +429,11 @@ type SimInitConfig struct {
 	AdversaryStrategy byte
 	FlipProbability   float64
 	ChurnProbability  float64
+	// v3 tail: model family (one of the Model* constants), conflict-set
+	// size (dag/streaming), and streaming window set-slots (0 = auto).
+	Model        byte
+	ConflictSize uint32
+	WindowSets   uint32
 }
 
 // SimInit (re)initializes the server-side batched simulator.
@@ -438,6 +450,13 @@ func (c *Client) SimInit(cfg SimInitConfig) (bool, error) {
 	w.u8(cfg.AdversaryStrategy)
 	w.f64(cfg.FlipProbability)
 	w.f64(cfg.ChurnProbability)
+	w.u8(cfg.Model)
+	conflictSize := cfg.ConflictSize
+	if conflictSize == 0 {
+		conflictSize = 2
+	}
+	w.u32(conflictSize)
+	w.u32(cfg.WindowSets)
 	r, err := c.call(msgSimInit, w.Bytes(), msgOK)
 	if err != nil {
 		return false, err

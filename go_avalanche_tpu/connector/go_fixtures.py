@@ -53,6 +53,11 @@ def build_fixtures() -> Dict[str, bytes]:
         proto.MsgType.SIM_INIT,
         struct.pack("<IIIIIBdd", 100, 50, 1, 8, 128, 1, 0.2, 0.05)
         + struct.pack("<Bdd", 1, 0.35, 0.01))
+    f["req_sim_init_v3"] = proto.pack_frame(
+        proto.MsgType.SIM_INIT,
+        struct.pack("<IIIIIBdd", 100, 50, 1, 8, 128, 1, 0.2, 0.05)
+        + struct.pack("<Bdd", 1, 0.35, 0.01)
+        + struct.pack("<BII", 2, 2, 16))
     f["req_sim_run"] = proto.pack_frame(
         proto.MsgType.SIM_RUN, struct.pack("<I", 250))
     f["req_shutdown"] = proto.pack_frame(proto.MsgType.SHUTDOWN)

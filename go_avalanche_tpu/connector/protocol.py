@@ -31,6 +31,10 @@ from typing import List, Optional, Sequence, Tuple
 
 MAX_FRAME = 64 * 1024 * 1024  # sanity bound, not a protocol limit
 
+# SIM_INIT v3 model bytes, in wire order (mirrored by the Go client's
+# Model* constants and native/connector/protocol.h).
+SIM_MODELS = ("avalanche", "dag", "streaming_dag")
+
 
 class MsgType(enum.IntEnum):
     # requests
@@ -48,6 +52,9 @@ class MsgType(enum.IntEnum):
                            #  + optional v2 tail {strategy B, flip d, churn d}
                            #  (strategy: 0=flip 1=equivocate 2=oppose_majority;
                            #   older clients omit the tail)
+                           #  + optional v3 tail {model B, conflict_size I,
+                           #  window_sets I} (model: 0=avalanche 1=dag
+                           #  2=streaming_dag; window_sets 0 = auto)
     SIM_RUN = 12           # {rounds I}
     SHUTDOWN = 16
     # replies

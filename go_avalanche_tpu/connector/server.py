@@ -29,6 +29,7 @@ from typing import Dict, List, Optional, Tuple
 
 from go_avalanche_tpu.config import AdversaryStrategy, AvalancheConfig
 from go_avalanche_tpu.connector import protocol as proto
+from go_avalanche_tpu.connector.protocol import SIM_MODELS
 from go_avalanche_tpu.types import Response, Vote
 
 try:
@@ -104,7 +105,14 @@ class _PyEngine:
 
 
 class _SimBackend:
-    """Lazy wrapper over the batched TPU simulator (models/avalanche)."""
+    """Lazy wrapper over the batched TPU simulators.
+
+    The v3 SIM_INIT tail selects the model family: plain multi-target
+    (`models/avalanche`, the default), conflict DAG (`models/dag`), or
+    the streaming conflict-DAG (`models/streaming_dag`).  SIM_STATS'
+    `finalized_fraction` generalizes per model: record-finalized fraction,
+    (node, set)-resolved fraction, or backlog-settled fraction.
+    """
 
     def __init__(self) -> None:
         # One lock for the whole backend: SIM_INIT/SIM_RUN from different
@@ -113,39 +121,92 @@ class _SimBackend:
         self._lock = threading.Lock()
         self._state = None
         self._cfg: Optional[AvalancheConfig] = None
+        self._model = "avalanche"
         self._totals = [0, 0, 0, 0]  # polls, votes, flips, finalizations
 
     def init(self, n_nodes: int, n_txs: int, seed: int,
-             cfg: AvalancheConfig) -> None:
+             cfg: AvalancheConfig, model: str = "avalanche",
+             conflict_size: int = 2, window_sets: int = 0) -> None:
         import jax
-        from go_avalanche_tpu.models import avalanche as av
+        import jax.numpy as jnp
 
         with self._lock:
             self._cfg = cfg
-            self._state = av.init(jax.random.key(seed), n_nodes, n_txs, cfg)
+            self._model = model
+            if model == "avalanche":
+                from go_avalanche_tpu.models import avalanche as av
+                self._state = av.init(jax.random.key(seed), n_nodes, n_txs,
+                                      cfg)
+            elif model == "dag":
+                from go_avalanche_tpu.models import dag
+                if n_txs % conflict_size:
+                    raise proto.ProtocolError(
+                        f"SIM_INIT: txs ({n_txs}) must divide by "
+                        f"conflict_size ({conflict_size})")
+                cs = jnp.arange(n_txs, dtype=jnp.int32) // conflict_size
+                self._state = dag.init(jax.random.key(seed), n_nodes, cs,
+                                       cfg)
+            elif model == "streaming_dag":
+                from go_avalanche_tpu.models import streaming_dag as sdg
+                if n_txs % conflict_size:
+                    raise proto.ProtocolError(
+                        f"SIM_INIT: txs ({n_txs}) must divide by "
+                        f"conflict_size ({conflict_size})")
+                n_sets = n_txs // conflict_size
+                w_sets = window_sets or max(1, n_sets // 8)
+                backlog = sdg.make_set_backlog(jnp.arange(
+                    n_txs, dtype=jnp.int32).reshape(n_sets, conflict_size))
+                self._state = sdg.init(jax.random.key(seed), n_nodes,
+                                       w_sets, backlog, cfg)
+            else:
+                raise proto.ProtocolError(f"SIM_INIT: unknown model {model}")
             self._totals = [0, 0, 0, 0]
 
     def run(self, n_rounds: int) -> Tuple[int, float, List[int]]:
         import jax
         import numpy as np
-        from go_avalanche_tpu.models import avalanche as av
         from go_avalanche_tpu.ops import voterecord as vr
 
         with self._lock:
             if self._state is None or self._cfg is None:
                 raise proto.ProtocolError("SIM_INIT required before SIM_RUN")
-            state, tel = jax.jit(
-                av.run_scan, static_argnames=("cfg", "n_rounds"))(
-                    self._state, self._cfg, n_rounds)
+            if self._model == "avalanche":
+                from go_avalanche_tpu.models import avalanche as av
+                state, tel = jax.jit(
+                    av.run_scan, static_argnames=("cfg", "n_rounds"))(
+                        self._state, self._cfg, n_rounds)
+                rnd = state.round
+                fin_frac = float(np.asarray(jax.device_get(
+                    vr.has_finalized(state.records.confidence,
+                                     self._cfg))).mean())
+            elif self._model == "dag":
+                from go_avalanche_tpu.models import dag
+                state, tel = jax.jit(
+                    dag.run_scan, static_argnames=("cfg", "n_rounds"))(
+                        self._state, self._cfg, n_rounds)
+                rnd = state.base.round
+                conf = state.base.records.confidence
+                fin_acc = np.asarray(jax.device_get(
+                    vr.has_finalized(conf, self._cfg)
+                    & vr.is_accepted(conf)))
+                c = fin_acc.shape[1] // state.n_sets
+                fin_frac = float(
+                    (dag.winners_per_set(fin_acc, c) == 1).mean())
+            else:  # streaming_dag
+                from go_avalanche_tpu.models import streaming_dag as sdg
+                state, stel = jax.jit(
+                    sdg.run_scan, static_argnames=("cfg", "n_rounds"))(
+                        self._state, self._cfg, n_rounds)
+                tel = stel.round
+                rnd = state.dag.base.round
+                fin_frac = float(np.asarray(jax.device_get(
+                    state.outputs.settled)).mean())
             self._state = state
             sums = [int(np.asarray(jax.device_get(x)).sum())
                     for x in (tel.polls, tel.votes_applied, tel.flips,
                               tel.finalizations)]
             self._totals = [a + b for a, b in zip(self._totals, sums)]
-            fin = np.asarray(jax.device_get(
-                vr.has_finalized(state.records.confidence, self._cfg)))
-            return int(jax.device_get(state.round)), float(fin.mean()), \
-                list(self._totals)
+            return int(jax.device_get(rnd)), fin_frac, list(self._totals)
 
 
 class ConnectorServer:
@@ -321,7 +382,8 @@ class ConnectorServer:
             extra = {}
             # v2 optional extension (older clients omit it): adversary
             # strategy byte + flip/churn probabilities.
-            if len(payload) >= base_len + struct.calcsize("<Bdd"):
+            v2_len = struct.calcsize("<Bdd")
+            if len(payload) >= base_len + v2_len:
                 strat, flip_p, churn = struct.unpack_from("<Bdd", payload,
                                                           base_len)
                 strategies = list(AdversaryStrategy)
@@ -335,10 +397,25 @@ class ConnectorServer:
                     adversary_strategy=strategies[strat],
                     flip_probability=flip_p,
                     churn_probability=churn)
+            # v3 optional extension: model byte + conflict_size + window
+            # set-slots (streaming only; 0 = auto).
+            model, conflict_size, window_sets = "avalanche", 2, 0
+            if len(payload) >= base_len + v2_len + struct.calcsize("<BII"):
+                model_b, conflict_size, window_sets = struct.unpack_from(
+                    "<BII", payload, base_len + v2_len)
+                if model_b >= len(SIM_MODELS):
+                    raise proto.ProtocolError(
+                        f"SIM_INIT model byte {model_b} out of range "
+                        f"(valid: 0..{len(SIM_MODELS) - 1}: "
+                        + ", ".join(f"{i}={m}"
+                                    for i, m in enumerate(SIM_MODELS)) + ")")
+                model = SIM_MODELS[model_b]
             cfg = AvalancheConfig(
                 k=k, finalization_score=fin, gossip=bool(gossip),
                 byzantine_fraction=byz, drop_probability=drop, **extra)
-            self._sim.init(n_nodes, n_txs, seed, cfg)
+            self._sim.init(n_nodes, n_txs, seed, cfg, model=model,
+                           conflict_size=conflict_size,
+                           window_sets=window_sets)
             return M.OK, struct.pack("<B", 1)
 
         if msg_type == M.SIM_RUN:

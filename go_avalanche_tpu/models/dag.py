@@ -211,6 +211,19 @@ def round_step(
     return DagSimState(new_base, state.conflict_set, state.n_sets), telemetry
 
 
+def winners_per_set(fin_acc, set_size: int):
+    """Finalized-accepted member count per CONTIGUOUS set; ``[N, T//c]``.
+
+    Host-side analysis counterpart of the on-device segment ops, for the
+    standard ``idx // set_size`` partition: a (node, set) pair is resolved
+    iff its count is exactly 1.  Accepts numpy or jnp planes; callers
+    filter node rows (honest / alive) to taste.  Shared by the connector
+    sim backend, the baseline suite, and the threshold sweep.
+    """
+    n, t = fin_acc.shape
+    return fin_acc.reshape(n, t // set_size, set_size).sum(axis=2)
+
+
 def settled(state: DagSimState,
             cfg: AvalancheConfig = DEFAULT_CONFIG) -> jax.Array:
     """True when every (live node, set) resolved: a member finalized accepted

@@ -174,3 +174,49 @@ def test_sim_init_invalid_strategy_byte_is_protocol_error(server):
                     [proto_mod.MsgType.OK])
         # The connection survives the error and valid inits still work.
         assert c.ping()
+
+
+def test_sim_init_v3_model_selection(server):
+    """The v3 tail drives the DAG and streaming models over the wire."""
+    with _client(server) as c:
+        assert c.sim_init(24, 16, seed=0, k=8, finalization_score=16,
+                          model="dag", conflict_size=2)
+        stats = c.sim_run(120)
+        assert stats.finalized_fraction == 1.0  # every (node, set) resolved
+
+        assert c.sim_init(16, 24, seed=0, k=8, finalization_score=16,
+                          model="streaming_dag", conflict_size=2,
+                          window_sets=4)
+        stats = c.sim_run(200)
+        assert stats.finalized_fraction == 1.0  # whole backlog settled
+
+
+def test_sim_init_v2_frame_still_accepted(server):
+    """A v2 frame (adversary tail, no model tail) keeps working."""
+    import struct
+
+    from go_avalanche_tpu.connector import protocol as proto_mod
+
+    with _client(server) as c:
+        payload = (struct.pack("<IIIIIBdd", 16, 4, 0, 8, 16, 1, 0.0, 0.0)
+                   + struct.pack("<Bdd", 0, 1.0, 0.0))
+        t, r = c._call(proto_mod.MsgType.SIM_INIT, payload,
+                       [proto_mod.MsgType.OK])
+        assert r[0] == 1
+        assert c.sim_run(40).finalized_fraction == 1.0
+
+
+def test_sim_init_invalid_model_byte_is_protocol_error(server):
+    import struct
+
+    from go_avalanche_tpu.connector import protocol as proto_mod
+
+    with _client(server) as c:
+        payload = (struct.pack("<IIIIIBdd", 16, 4, 0, 8, 16, 1, 0.0, 0.0)
+                   + struct.pack("<Bdd", 0, 1.0, 0.0)
+                   + struct.pack("<BII", 7, 2, 0))
+        with pytest.raises(proto.ProtocolError,
+                           match=r"model byte 7 out of range"):
+            c._call(proto_mod.MsgType.SIM_INIT, payload,
+                    [proto_mod.MsgType.OK])
+        assert c.ping()
