@@ -101,7 +101,7 @@ def config2_dag(quick: bool) -> Dict:
     fin_acc = np.asarray(vr.has_finalized(conf, cfg)
                          & vr.is_accepted(conf))
     # One winner per 2-tx set on every node.
-    winners = fin_acc.reshape(n, t // 2, 2).sum(axis=2)
+    winners = dag.winners_per_set(fin_acc, 2)
     return {
         "name": f"avalanche DAG ({n} nodes, {t}-tx UTXO conflict graph)",
         "rounds": rounds,
@@ -145,8 +145,7 @@ def config3_byzantine_mix(quick: bool) -> Dict:
         fin_acc = np.asarray(vr.has_finalized(conf, cfg)
                              & vr.is_accepted(conf))
         honest = ~np.asarray(final.base.byzantine)
-        winners = fin_acc[honest].reshape(
-            int(honest.sum()), t // 2, 2).sum(axis=2)
+        winners = dag.winners_per_set(fin_acc[honest], 2)
         out[f"{strat.value}_rounds"] = rounds
         out[f"{strat.value}_honest_sets_resolved"] = float(
             (winners == 1).mean())

@@ -36,6 +36,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
@@ -64,8 +65,7 @@ def resolved_fraction(state: dag.DagSimState, cfg: AvalancheConfig,
         vr.has_finalized(conf, cfg) & vr.is_accepted(conf)))
     honest = np.asarray(jax.device_get(
         jnp.logical_not(state.base.byzantine) & state.base.alive))
-    n, t = fin_acc.shape
-    winners = fin_acc.reshape(n, t // set_size, set_size).sum(axis=2)
+    winners = dag.winners_per_set(fin_acc, set_size)
     return float((winners[honest] == 1).mean()) if honest.any() else 0.0
 
 
@@ -76,8 +76,12 @@ def sweep_cell(n_nodes: int, n_txs: int, set_size: int, rounds: int,
                           adversary_strategy=strategy)
     cs = jnp.arange(n_txs, dtype=jnp.int32) // set_size
     state = dag.init(jax.random.key(seed), n_nodes, cs, cfg)
+    # eps only enters `init` (the byzantine mask is STATE); zero it in the
+    # jitted config so all eps cells share one compile per (strategy, p) —
+    # without this the static cfg hash retraces the 600-round scan per cell.
+    run_cfg = dataclasses.replace(cfg, byzantine_fraction=0.0)
     final, _ = jax.jit(dag.run_scan, static_argnames=("cfg", "n_rounds"))(
-        state, cfg, rounds)
+        state, run_cfg, rounds)
     frac = resolved_fraction(final, cfg, set_size)
     return {"eps": eps, "p": p, "q": round(eps * p, 4),
             "strategy": strategy.value, "resolved": round(frac, 4)}

@@ -1,0 +1,115 @@
+"""Streaming-scheduler scaling: settle throughput vs window and node count.
+
+RESULTS.md's 1M-tx row (config 5) demonstrates the backlog scheduler at
+1,024 nodes; the north star wants 100k.  The retire/refill cadence and the
+``[N, W]`` window footprint both change with N and W, so this sweep
+measures settled-txs/sec across that grid for the plain backlog and the
+streaming conflict-DAG, producing the scaling datum that a single
+full-size run cannot: does throughput hold as the window widens and the
+node axis grows toward 100k?
+
+Method note: each cell streams a backlog sized `fill * W` (a fixed number
+of window generations, default 8) rather than a fixed B, so every cell
+does comparable *scheduler* work per slot and wall-clock differences
+isolate the per-round cost of the window itself.
+
+    python examples/window_scaling.py                    # full grid (TPU)
+    python examples/window_scaling.py --nodes 1024,16384 --windows 1024,4096
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")  # allow running from the repo root
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from go_avalanche_tpu.config import AvalancheConfig
+from go_avalanche_tpu.models import backlog as bl
+from go_avalanche_tpu.models import streaming_dag as sdg
+
+
+def cell_backlog(n_nodes: int, window: int, fill: int, seed: int) -> dict:
+    cfg = AvalancheConfig(gossip=False, max_element_poll=window)
+    b = fill * window
+    backlog = bl.make_backlog(
+        jax.random.randint(jax.random.key(seed + 1), (b,), 0, 1 << 20))
+    state = bl.init(jax.random.key(seed), n_nodes, window, backlog, cfg)
+    t0 = time.time()
+    final = jax.jit(bl.run, static_argnames=("cfg", "max_rounds"))(
+        state, cfg, 500_000)
+    rounds = int(jax.device_get(final.sim.round))
+    wall = time.time() - t0
+    settled = np.asarray(jax.device_get(final.outputs.settled))
+    return {
+        "model": "backlog", "nodes": n_nodes, "window": window, "txs": b,
+        "rounds": rounds, "settled_fraction": float(settled.mean()),
+        "txs_per_sec": round(float(settled.sum()) / wall, 1),
+        "wall_s": round(wall, 2),
+    }
+
+
+def cell_streaming_dag(n_nodes: int, window: int, fill: int,
+                       seed: int) -> dict:
+    c = 2
+    w_sets = window // c
+    cfg = AvalancheConfig(gossip=False, max_element_poll=window)
+    b_sets = fill * w_sets
+    backlog = sdg.make_set_backlog(
+        jax.random.randint(jax.random.key(seed + 1), (b_sets, c), 0, 1 << 20))
+    state = sdg.init(jax.random.key(seed), n_nodes, w_sets, backlog, cfg)
+    t0 = time.time()
+    final = jax.jit(sdg.run, static_argnames=("cfg", "max_rounds"))(
+        state, cfg, 500_000)
+    rounds = int(jax.device_get(final.dag.base.round))
+    wall = time.time() - t0
+    summary = sdg.resolution_summary(final)
+    return {
+        "model": "streaming_dag", "nodes": n_nodes, "window": window,
+        "txs": b_sets * c, "rounds": rounds,
+        "settled_fraction": summary["sets_settled_fraction"],
+        "one_winner_fraction": summary["sets_one_winner_fraction"],
+        "txs_per_sec": round(summary["txs_settled"] / wall, 1),
+        "wall_s": round(wall, 2),
+    }
+
+
+def main(argv=None) -> list:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--nodes", type=str, default="1024,8192,32768,100000")
+    ap.add_argument("--windows", type=str, default="1024,4096")
+    ap.add_argument("--fill", type=int, default=8,
+                    help="backlog = fill * window txs per cell")
+    ap.add_argument("--models", type=str, default="backlog,streaming_dag")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--json-out", type=str,
+                    default="examples/out/window_scaling.json")
+    args = ap.parse_args(argv)
+
+    runners = {"backlog": cell_backlog, "streaming_dag": cell_streaming_dag}
+    cells = []
+    for model in args.models.split(","):
+        for n in (int(x) for x in args.nodes.split(",")):
+            for w in (int(x) for x in args.windows.split(",")):
+                cell = runners[model](n, w, args.fill, args.seed)
+                cells.append(cell)
+                print(json.dumps(cell), flush=True)
+
+    result = {"backend": jax.devices()[0].platform, "fill": args.fill,
+              "cells": cells}
+    os.makedirs(os.path.dirname(args.json_out), exist_ok=True)
+    with open(args.json_out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(f"artifact: {args.json_out}")
+    return cells
+
+
+if __name__ == "__main__":
+    main()

@@ -33,3 +33,13 @@ def test_equivocation_sweep_cell_runs_small():
                       strategy=AdversaryStrategy.FLIP)
     assert cell["resolved"] == 1.0
     assert cell["q"] == 0.0
+
+
+def test_window_scaling_cells_run_small():
+    from examples.window_scaling import cell_backlog, cell_streaming_dag
+
+    c1 = cell_backlog(16, 8, fill=2, seed=0)
+    assert c1["settled_fraction"] == 1.0 and c1["txs"] == 16
+    c2 = cell_streaming_dag(16, 8, fill=2, seed=0)
+    assert c2["settled_fraction"] == 1.0
+    assert c2["one_winner_fraction"] == 1.0
