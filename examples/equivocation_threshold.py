@@ -127,7 +127,7 @@ def main(argv=None) -> dict:
         "stall_threshold_eps": thresholds,
         "elapsed_s": round(time.time() - t0, 1),
     }
-    os.makedirs(os.path.dirname(args.json_out), exist_ok=True)
+    os.makedirs(os.path.dirname(args.json_out) or ".", exist_ok=True)
     with open(args.json_out, "w") as f:
         json.dump(result, f, indent=1)
     print(f"\nthresholds (smallest eps with resolved<0.5): {thresholds}")

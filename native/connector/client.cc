@@ -214,7 +214,9 @@ bool ConnectorClient::SimInit(uint32_t n_nodes, uint32_t n_txs, uint32_t seed,
                               uint32_t k, uint32_t finalization_score,
                               bool gossip, double byzantine, double drop,
                               uint8_t adversary_strategy,
-                              double flip_probability, double churn) {
+                              double flip_probability, double churn,
+                              uint8_t model, uint32_t conflict_size,
+                              uint32_t window_sets) {
   std::vector<uint8_t> p;
   PutLE(&p, n_nodes);
   PutLE(&p, n_txs);
@@ -227,6 +229,9 @@ bool ConnectorClient::SimInit(uint32_t n_nodes, uint32_t n_txs, uint32_t seed,
   PutU8(&p, adversary_strategy);  // v2 tail
   PutLE(&p, flip_probability);
   PutLE(&p, churn);
+  PutU8(&p, model);  // v3 tail (protocol.py SIM_MODELS order)
+  PutLE(&p, conflict_size);
+  PutLE(&p, window_sets);
   auto [t, r] = Call(MsgType::kSimInit, p, MsgType::kOk);
   return !r.empty() && r[0] != 0;
 }

@@ -46,11 +46,15 @@ class ConnectorClient {
   int64_t GetConfidence(int64_t node_id, int64_t hash);  // -1 if unknown
   int64_t GetRound(int64_t node_id);
   // adversary_strategy: 0=flip 1=equivocate 2=oppose_majority (the v2
-  // optional SIM_INIT tail; servers older than v2 ignore unknown tails).
+  // optional SIM_INIT tail).  model: 0=avalanche 1=dag 2=streaming_dag
+  // (the v3 tail; conflict_size for dag/streaming, window_sets for
+  // streaming, 0 = auto).  Mirrors protocol.py SIM_MODELS.
   bool SimInit(uint32_t n_nodes, uint32_t n_txs, uint32_t seed, uint32_t k,
                uint32_t finalization_score, bool gossip, double byzantine,
                double drop, uint8_t adversary_strategy = 0,
-               double flip_probability = 1.0, double churn = 0.0);
+               double flip_probability = 1.0, double churn = 0.0,
+               uint8_t model = 0, uint32_t conflict_size = 2,
+               uint32_t window_sets = 0);
   SimStats SimRun(uint32_t rounds);
   void ShutdownServer();
 

@@ -156,10 +156,7 @@ def test_streaming_dag_state_roundtrips(tmp_path):
     path = str(tmp_path / "sdg.npz")
     save_checkpoint(path, state)
     restored = restore_checkpoint(path, jax.tree.map(lambda x: x, state))
-    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(restored)):
-        if jax.dtypes.issubdtype(getattr(a, "dtype", None), jax.dtypes.prng_key):
-            a, b = jax.random.key_data(a), jax.random.key_data(b)
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert_states_equal(state, restored)
 
     fin_a = jax.device_get(sd.run(state, cfg, max_rounds=2000))
     fin_b = jax.device_get(sd.run(restored, cfg, max_rounds=2000))
