@@ -159,11 +159,28 @@ func (c *Client) call(msgType byte, payload []byte, expect byte) ([]byte, error)
 
 type wbuf struct{ bytes.Buffer }
 
-func (w *wbuf) u8(v byte)     { w.WriteByte(v) }
-func (w *wbuf) u32(v uint32)  { var b [4]byte; binary.LittleEndian.PutUint32(b[:], v); w.Write(b[:]) }
-func (w *wbuf) i32(v int32)   { w.u32(uint32(v)) }
-func (w *wbuf) i64(v int64)   { var b [8]byte; binary.LittleEndian.PutUint64(b[:], uint64(v)); w.Write(b[:]) }
-func (w *wbuf) f64(v float64) { var b [8]byte; binary.LittleEndian.PutUint64(b[:], math.Float64bits(v)); w.Write(b[:]) }
+func (w *wbuf) u8(v byte) { w.WriteByte(v) }
+
+func (w *wbuf) u32(v uint32) {
+	var b [4]byte
+	binary.LittleEndian.PutUint32(b[:], v)
+	w.Write(b[:])
+}
+
+func (w *wbuf) i32(v int32) { w.u32(uint32(v)) }
+
+func (w *wbuf) i64(v int64) {
+	var b [8]byte
+	binary.LittleEndian.PutUint64(b[:], uint64(v))
+	w.Write(b[:])
+}
+
+func (w *wbuf) f64(v float64) {
+	var b [8]byte
+	binary.LittleEndian.PutUint64(b[:], math.Float64bits(v))
+	w.Write(b[:])
+}
+
 func (w *wbuf) boolByte(v bool) {
 	if v {
 		w.u8(1)
