@@ -111,10 +111,13 @@ def preferred_in_set(
     best = jax.ops.segment_max(strength.T, conflict_set,
                                num_segments=n_sets)    # [S, N]
     is_best = strength == best.T[:, conflict_set]      # broadcast per tx
-    # Tie-break to the lowest tx index among the maxima.
+    # Tie-break to the lowest tx index among the maxima.  The index planes
+    # are the other [N, T]-sized transients; narrow them when T allows
+    # (int16 halves another high-water contributor at fleet node counts).
     t = confidence.shape[-1]
-    idx = jnp.arange(t, dtype=jnp.int32)
-    idx_masked = jnp.where(is_best, idx, t)            # non-best -> sentinel
+    idx_dt = jnp.int16 if t < 0x7FFF else jnp.int32
+    idx = jnp.arange(t, dtype=idx_dt)
+    idx_masked = jnp.where(is_best, idx, idx_dt(t))    # non-best -> sentinel
     first_best = jax.ops.segment_min(idx_masked.T, conflict_set,
                                      num_segments=n_sets)  # [S, N]
     return idx[None, :] == first_best.T[:, conflict_set]
@@ -137,7 +140,7 @@ def round_step(
     fin_acc = fin & vr.is_accepted(base.records.confidence)
 
     # A set is settled for a node once any member finalized accepted.
-    set_done = jax.ops.segment_max(fin_acc.astype(jnp.int32).T,
+    set_done = jax.ops.segment_max(fin_acc.astype(jnp.uint8).T,
                                    state.conflict_set,
                                    num_segments=state.n_sets)  # [S, N]
     rival_settled = (set_done.T[:, state.conflict_set] > 0) \
@@ -230,7 +233,7 @@ def settled(state: DagSimState,
     for every set on every live node."""
     fin_acc = (vr.has_finalized(state.base.records.confidence, cfg)
                & vr.is_accepted(state.base.records.confidence))
-    set_done = jax.ops.segment_max(fin_acc.astype(jnp.int32).T,
+    set_done = jax.ops.segment_max(fin_acc.astype(jnp.uint8).T,
                                    state.conflict_set,
                                    num_segments=state.n_sets)   # [S, N]
     return jnp.where(state.base.alive[None, :], set_done > 0, True).all()

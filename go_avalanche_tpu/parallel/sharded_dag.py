@@ -121,7 +121,7 @@ def _local_round(
     alive_local = lax.dynamic_slice(base.alive, (offset,), (n_local,))
 
     # --- rival-settled freeze: local segment pass over local columns.
-    set_done = jax.ops.segment_max(fin_acc.astype(jnp.int32).T, cs_local,
+    set_done = jax.ops.segment_max(fin_acc.astype(jnp.uint8).T, cs_local,
                                    num_segments=state.n_sets)
     rival_settled = (set_done.T[:, cs_local] > 0) & jnp.logical_not(fin_acc)
 
@@ -260,7 +260,7 @@ def run_sharded_dag(
             fin_acc = (vr.has_finalized(base.records.confidence, cfg)
                        & vr.is_accepted(base.records.confidence))
             set_done = jax.ops.segment_max(
-                fin_acc.astype(jnp.int32).T, cs_local,
+                fin_acc.astype(jnp.uint8).T, cs_local,
                 num_segments=st.n_sets)
             open_sets = ((set_done.T[:, cs_local] == 0)
                          & alive_local[:, None] & base.valid[None, :])
