@@ -1,0 +1,63 @@
+#!/usr/bin/env bash
+# One-shot TPU measurement pass: regenerates every recorded artifact that
+# needs real hardware, in dependency order.  Run from the repo root on a
+# machine where the accelerator answers (probe first: a 256x256 matmul
+# must return within seconds — see bench.py's resilience notes).
+#
+#   bash benchmarks/measure_all.sh [quick]
+#
+# Artifacts written:
+#   RESULTS.md + benchmarks/results.json     (baseline_suite, all configs)
+#   examples/out/window_scaling.json         (scheduler scaling grid)
+#   examples/out/equivocation_threshold.json (liveness threshold sweep)
+#   bench JSON line on stdout                (throughput north star)
+set -euo pipefail
+
+QUICK="${1:-}"
+
+echo "== probe =="
+python - << 'EOF'
+import jax, jax.numpy as jnp
+print("backend:", jax.devices()[0].platform)
+print("matmul:", float(jnp.sum(jnp.ones((256, 256)) @ jnp.ones((256, 256)))))
+EOF
+
+echo "== baseline suite =="
+if [ "$QUICK" = "quick" ]; then
+  python benchmarks/baseline_suite.py --quick --no-write
+else
+  python benchmarks/baseline_suite.py
+fi
+
+echo "== window scaling =="
+if [ "$QUICK" = "quick" ]; then
+  python examples/window_scaling.py --nodes 256,1024 --windows 64,128 \
+      --fill 2 --json-out /tmp/window_scaling_quick.json
+else
+  python examples/window_scaling.py
+fi
+
+echo "== equivocation threshold =="
+if [ "$QUICK" != "quick" ]; then
+  python examples/equivocation_threshold.py
+fi
+
+echo "== bench =="
+python bench.py
+
+if [ "$QUICK" = "quick" ]; then
+  echo "quick mode: skipping RESULTS.md re-render (nothing fresh to fold in)"
+  exit 0
+fi
+
+echo "== re-render RESULTS.md with fresh artifacts =="
+python - << 'EOF'
+import importlib.util, json, sys
+sys.path.insert(0, ".")
+spec = importlib.util.spec_from_file_location("bs", "benchmarks/baseline_suite.py")
+m = importlib.util.module_from_spec(spec); spec.loader.exec_module(m)
+data = json.load(open("benchmarks/results.json"))
+open("RESULTS.md", "w").write(
+    m.render_results_md(data["results"], data["backend"]))
+print("RESULTS.md rendered")
+EOF

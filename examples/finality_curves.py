@@ -39,14 +39,11 @@ def run_point(n_nodes: int, n_txs: int, byzantine: float, seed: int,
               contested: bool = False) -> dict:
     cfg = AvalancheConfig(byzantine_fraction=byzantine,
                           adversary_strategy=AdversaryStrategy(adversary))
-    init_pref = None
-    if contested:
-        # Per-NODE 50/50 priors: the paper's experimental setup, where the
-        # network must actually converge on a value.  A unanimous network
-        # finalizes in exactly ceil((6 + finalization)/k) rounds at EVERY
-        # size — a flat line that proves nothing about scaling.
-        init_pref = jax.random.bernoulli(
-            jax.random.key(seed + 1), 0.5, (n_nodes, n_txs))
+    # Per-NODE 50/50 priors: the paper's experimental setup, where the
+    # network must actually converge on a value (a unanimous network's
+    # finality is size-independent — a flat line that proves nothing).
+    init_pref = (av.contested_init_pref(seed, n_nodes, n_txs)
+                 if contested else None)
     state = av.init(jax.random.key(seed), n_nodes, n_txs, cfg,
                     init_pref=init_pref)
     t0 = time.perf_counter()

@@ -78,6 +78,19 @@ class SimTelemetry(NamedTuple):
     admissions: jax.Array      # int32 — gossip admissions this round
 
 
+def contested_init_pref(seed: int, n_nodes: int, n_txs: int) -> jax.Array:
+    """Per-NODE 50/50 initial preferences; bool ``[N, T]``.
+
+    The contested-prior convention shared by `run_sim --contested` and
+    `examples/finality_curves.py --contested`: nodes first saw different
+    spends, so the network must genuinely converge per tx (unanimous
+    priors finalize in ceil((6 + finalization)/k) rounds at every size).
+    The key offsets the sim seed so priors and round draws decorrelate.
+    """
+    return jax.random.bernoulli(jax.random.key(seed + 1), 0.5,
+                                (n_nodes, n_txs))
+
+
 def score_ranks(scores: jax.Array) -> jax.Array:
     """Rank targets by descending score; int32 [T], 0 = best.
 

@@ -368,4 +368,6 @@ def resolution_summary(state: StreamingDagState) -> dict:
         "txs_settled": int(np.asarray(out.settled)[valid].sum()),
         "settle_latency_median": float(np.median(latency))
         if latency.size else None,
+        "settle_latency_p90": float(np.percentile(latency, 90))
+        if latency.size else None,
     }

@@ -89,7 +89,10 @@ def run_avalanche(args, cfg: AvalancheConfig) -> Dict:
     from go_avalanche_tpu.models import avalanche as av
     from go_avalanche_tpu.ops import voterecord as vr
 
-    state = av.init(jax.random.key(args.seed), args.nodes, args.txs, cfg)
+    init_pref = (av.contested_init_pref(args.seed, args.nodes, args.txs)
+                 if args.contested else None)
+    state = av.init(jax.random.key(args.seed), args.nodes, args.txs, cfg,
+                    init_pref=init_pref)
     if args.mesh:
         from go_avalanche_tpu.parallel import sharded
 
@@ -281,6 +284,9 @@ def main(argv=None) -> Dict:
     parser.add_argument("--yes-fraction", type=float, default=1.0,
                         help="slush/snowflake/snowball: initial "
                              "yes-preference fraction")
+    parser.add_argument("--contested", action="store_true",
+                        help="avalanche: per-NODE 50/50 initial preferences "
+                             "(the network must actually converge per tx)")
     parser.add_argument("--conflict-size", type=int, default=2,
                         help="dag: txs per conflict set")
     parser.add_argument("--slots", type=int, default=64,

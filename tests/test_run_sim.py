@@ -137,3 +137,13 @@ def test_cli_distinct_peers(capsys):
                    "--finalization-score", "16", "--distinct-peers",
                    "--json"])
     assert result["finalized_fraction"] == 1.0
+
+
+def test_cli_contested_avalanche(capsys):
+    result = main(["--model", "avalanche", "--nodes", "48", "--txs", "8",
+                   "--finalization-score", "16", "--contested", "--json"])
+    assert result["finalized_fraction"] == 1.0
+    # Contested networks need strictly more rounds than unanimous ones.
+    unanimous = main(["--model", "avalanche", "--nodes", "48", "--txs", "8",
+                      "--finalization-score", "16", "--json"])
+    assert result["rounds"] > unanimous["rounds"]
