@@ -9,12 +9,19 @@ as batched array computation.  Layers:
   (this pkg) L1 — wire/data types, config, clock
   processor  L2 — host-side per-node Processor with full reference API parity
   net        L3 — Connman peer registry
-  models/    L4 — batched network simulators (snowball, avalanche, DAG)
+  models/    L4 — batched network simulators (slush, snowflake, snowball,
+             avalanche, conflict DAG, streaming backlog, streaming
+             conflict-DAG — the north-star composition)
   parallel/  mesh + shard_map sharding of the simulators
   utils/     golden oracle, checkpointing, metrics
 """
 
-from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG, VoteMode
+from go_avalanche_tpu.config import (
+    AdversaryStrategy,
+    AvalancheConfig,
+    DEFAULT_CONFIG,
+    VoteMode,
+)
 from go_avalanche_tpu.clock import Clock, StubClock
 from go_avalanche_tpu.net import Connman
 from go_avalanche_tpu.processor import Processor
@@ -39,6 +46,7 @@ from go_avalanche_tpu.types import (
 __version__ = "0.1.0"
 
 __all__ = [
+    "AdversaryStrategy",
     "AvalancheConfig",
     "DEFAULT_CONFIG",
     "VoteMode",
