@@ -102,6 +102,12 @@ class AvalancheConfig:
     weighted_sampling: bool = False   # draw peers prop. to latency weights
                                       #   (times aliveness); self-draws
                                       #   become abstentions
+    n_clusters: int = 1               # > 1: clustered topology — nodes in
+                                      #   contiguous-block clusters; draws
+                                      #   prefer the own cluster (below).
+                                      #   Composes with latency weights.
+    cluster_locality: float = 0.8     # P(draw lands in own cluster), for
+                                      #   equal-size clusters / uniform base
     gossip: bool = True
     strict_validation: bool = False
 
@@ -130,6 +136,14 @@ class AvalancheConfig:
                 "weighted_sampling requires sample_with_replacement: exact "
                 "weighted draws without replacement need per-row Gumbel "
                 "top-k over all N peers (O(N^2) state)")
+        if self.n_clusters < 1:
+            raise ValueError("n_clusters must be >= 1 (1 = no clustering)")
+        if self.n_clusters > 1 and not self.sample_with_replacement:
+            raise ValueError(
+                "clustered topology requires sample_with_replacement "
+                "(same O(N^2) argument as weighted_sampling)")
+        if not (0.0 <= self.cluster_locality <= 1.0):
+            raise ValueError("cluster_locality must be in [0, 1]")
         if not (0.5 < self.alpha <= 1.0):
             raise ValueError("alpha must be in (0.5, 1.0]")
 

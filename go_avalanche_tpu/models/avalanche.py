@@ -37,11 +37,7 @@ from go_avalanche_tpu.ops.bitops import (
     popcount8,
     unpack_bool_plane,
 )
-from go_avalanche_tpu.ops.sampling import (
-    sample_peers_uniform,
-    sample_peers_weighted,
-    self_sample_mask,
-)
+from go_avalanche_tpu.ops.sampling import draw_peers
 from go_avalanche_tpu.utils.tracing import annotate
 
 
@@ -196,20 +192,14 @@ def round_step(
         polled = capped_poll_mask(pollable, state.score_rank,
                                   cfg.max_element_poll)
 
-    # --- peer sampling: uniform, or latency-weighted (BASELINE config 5).
-    # In the weighted mode peers are drawn proportionally to latency_weight
-    # times aliveness (dead peers are never drawn), and self-draws — which
-    # per-row exclusion can't cheaply rule out — become abstentions.
+    # --- peer sampling: uniform (with/without replacement),
+    # latency-weighted (BASELINE config 5), or clustered topology — the
+    # shared `ops/sampling.draw_peers` dispatch.  In the weighted/clustered
+    # families self-draws (which per-row exclusion can't cheaply rule out)
+    # become abstentions.
     with annotate("sample_peers"):
-        if cfg.weighted_sampling:
-            w = state.latency_weight * state.alive.astype(jnp.float32)
-            peers = sample_peers_weighted(k_sample, w, n, cfg.k)
-            self_draw = self_sample_mask(peers)
-        else:
-            peers = sample_peers_uniform(
-                k_sample, n, cfg.k, cfg.exclude_self,
-                with_replacement=cfg.sample_with_replacement)
-            self_draw = None
+        peers, self_draw = draw_peers(k_sample, cfg, state.latency_weight,
+                                      state.alive, n)
 
     # --- response model: byzantine lies and dropped responses, decided
     # per (poller, draw) — a lying peer's whole response is transformed per

@@ -35,11 +35,7 @@ from jax import lax
 from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
 from go_avalanche_tpu.models import avalanche as av
 from go_avalanche_tpu.ops import adversary, voterecord as vr
-from go_avalanche_tpu.ops.sampling import (
-    sample_peers_uniform,
-    sample_peers_weighted,
-    self_sample_mask,
-)
+from go_avalanche_tpu.ops.sampling import draw_peers
 
 
 @jax.tree_util.register_pytree_node_class
@@ -152,17 +148,10 @@ def round_step(
                                  cfg.max_element_poll)
 
     # Peer sampling + failure model: identical axes to the flat simulator
-    # (`models/avalanche.py`) — uniform or latency-weighted draws, byzantine
-    # lies, dropped responses, churn.
-    if cfg.weighted_sampling:
-        w = base.latency_weight * base.alive.astype(jnp.float32)
-        peers = sample_peers_weighted(k_sample, w, n, cfg.k)
-        self_draw = self_sample_mask(peers)
-    else:
-        peers = sample_peers_uniform(
-            k_sample, n, cfg.k, cfg.exclude_self,
-            with_replacement=cfg.sample_with_replacement)
-        self_draw = None
+    # (`models/avalanche.py`) — the shared draw dispatch, byzantine lies,
+    # dropped responses, churn.
+    peers, self_draw = draw_peers(k_sample, cfg, base.latency_weight,
+                                  base.alive, n)
     lie = adversary.lie_mask(k_byz, peers, base.byzantine, cfg)
     responded = base.alive[peers]
     if self_draw is not None:

@@ -127,6 +127,90 @@ def sample_peers_weighted(
     return jnp.clip(idx, 0, weights.shape[0] - 1).astype(jnp.int32)
 
 
+def sample_peers_clustered(
+    key: jax.Array,
+    weights: jax.Array,
+    n_rows: int,
+    k: int,
+    n_clusters: int,
+    locality: float,
+    id_offset: int | jax.Array = 0,
+) -> jax.Array:
+    """Clustered-topology k-peer sample; int32 ``[n_rows, k]`` global ids.
+
+    Nodes partition into `n_clusters` contiguous-block clusters (cluster of
+    global id i = ``i * C // N`` — derived, never stored, so no state plane
+    is added).  A draw lands in the drawing node's own cluster with
+    probability ``locality`` (for equal-size clusters and uniform base
+    weights) and spreads the rest evenly over the other clusters; within a
+    cluster, draws follow the base `weights` propensities (latency x
+    aliveness).  This is the two-level geographic-locality model the
+    DAG-simulator literature uses, kept TPU-shaped: per-source-CLUSTER
+    weight rows ``[C, N]`` instead of per-source-node O(N^2), one CDF per
+    cluster, and a static C-loop of searchsorted calls.
+
+    With replacement; callers turn self-draws into abstentions via
+    `self_sample_mask` (as in the weighted mode).
+    """
+    weights = jnp.asarray(weights, jnp.float32)
+    n_nodes = weights.shape[0]
+    c_ids = jnp.arange(n_clusters, dtype=jnp.int32)
+    cluster_of_all = (jnp.arange(n_nodes, dtype=jnp.int32)
+                      * n_clusters // n_nodes)                  # [N]
+    onehot = cluster_of_all[None, :] == c_ids[:, None]          # [C, N]
+    spread = (1.0 - locality) / max(n_clusters - 1, 1)
+    w_cn = jnp.where(onehot, locality, spread) * weights[None, :]
+    cdf = jnp.cumsum(w_cn, axis=1)                              # [C, N]
+    total = cdf[:, -1]                                          # [C]
+
+    rows_cluster = ((jnp.arange(n_rows, dtype=jnp.int32)
+                     + jnp.asarray(id_offset, jnp.int32))
+                    * n_clusters // n_nodes)                    # [rows]
+    u = jax.random.uniform(key, (n_rows, k), jnp.float32) \
+        * total[rows_cluster][:, None]
+    peers = jnp.zeros((n_rows, k), jnp.int32)
+    for c in range(n_clusters):   # static, C is small (topology knob)
+        idx_c = jnp.clip(jnp.searchsorted(cdf[c], u, side="right"),
+                         0, n_nodes - 1).astype(jnp.int32)
+        peers = jnp.where((rows_cluster == c)[:, None], idx_c, peers)
+    return peers
+
+
+def draw_peers(
+    key: jax.Array,
+    cfg,
+    latency_weight: jax.Array,
+    alive: jax.Array,
+    n_nodes: int,
+    n_local: int | None = None,
+    id_offset: int | jax.Array = 0,
+) -> tuple:
+    """The per-round peer draw shared by every multi-target model.
+
+    Dispatches on the config: clustered topology (`n_clusters > 1`),
+    latency-weighted, or uniform (with/without replacement, self-excluded).
+    Returns ``(peers [rows, k], self_draw)`` where `self_draw` is a bool
+    mask in the weighted/clustered families (per-row exclusion there would
+    be O(N^2); callers abstain those draws) and None in the uniform family
+    (exclusion is exact).
+    """
+    rows = n_nodes if n_local is None else n_local
+    if cfg.n_clusters > 1:
+        w = latency_weight * alive.astype(jnp.float32)
+        peers = sample_peers_clustered(key, w, rows, cfg.k, cfg.n_clusters,
+                                       cfg.cluster_locality,
+                                       id_offset=id_offset)
+        return peers, self_sample_mask(peers, id_offset=id_offset)
+    if cfg.weighted_sampling:
+        w = latency_weight * alive.astype(jnp.float32)
+        peers = sample_peers_weighted(key, w, rows, cfg.k)
+        return peers, self_sample_mask(peers, id_offset=id_offset)
+    peers = sample_peers_uniform(key, n_nodes, cfg.k, cfg.exclude_self,
+                                 n_local=n_local, id_offset=id_offset,
+                                 with_replacement=cfg.sample_with_replacement)
+    return peers, None
+
+
 def self_sample_mask(peers: jax.Array,
                      id_offset: int | jax.Array = 0) -> jax.Array:
     """Bool ``[n, k]``: True where a draw landed on the sampling node itself.

@@ -53,11 +53,7 @@ from go_avalanche_tpu.models.avalanche import (
 )
 from go_avalanche_tpu.ops import adversary, voterecord as vr
 from go_avalanche_tpu.ops.bitops import pack_bool_plane, unpack_bool_plane
-from go_avalanche_tpu.ops.sampling import (
-    sample_peers_uniform,
-    sample_peers_weighted,
-    self_sample_mask,
-)
+from go_avalanche_tpu.ops.sampling import draw_peers
 from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS
 
 
@@ -225,18 +221,11 @@ def _local_round(
     polled = global_capped_poll_mask(pollable, state.score_rank,
                                      cfg.max_element_poll, n_tx_shards)
 
-    # --- sample k global peer ids for the local rows (uniform or
-    # latency-weighted; the weighted CDF is global/replicated).
-    if cfg.weighted_sampling:
-        w = state.latency_weight * state.alive.astype(jnp.float32)
-        peers = sample_peers_weighted(k_sample, w, n_local, cfg.k)
-        self_draw = self_sample_mask(peers, id_offset=offset)
-    else:
-        peers = sample_peers_uniform(
-            k_sample, n_global, cfg.k, cfg.exclude_self,
-            n_local=n_local, id_offset=offset,
-            with_replacement=cfg.sample_with_replacement)
-        self_draw = None
+    # --- sample k global peer ids for the local rows: the shared draw
+    # dispatch (weighted CDFs / cluster rows are global + replicated).
+    peers, self_draw = draw_peers(k_sample, cfg, state.latency_weight,
+                                  state.alive, n_global,
+                                  n_local=n_local, id_offset=offset)
 
     lie = adversary.lie_mask(k_byz, peers, state.byzantine, cfg)
     responded = state.alive[peers]

@@ -40,11 +40,7 @@ from go_avalanche_tpu.models import dag as dag_model
 from go_avalanche_tpu.models.dag import DagSimState
 from go_avalanche_tpu.ops import adversary, voterecord as vr
 from go_avalanche_tpu.ops.bitops import pack_bool_plane, unpack_bool_plane
-from go_avalanche_tpu.ops.sampling import (
-    sample_peers_uniform,
-    sample_peers_weighted,
-    self_sample_mask,
-)
+from go_avalanche_tpu.ops.sampling import draw_peers
 from go_avalanche_tpu.parallel import sharded
 from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS
 
@@ -132,19 +128,10 @@ def _local_round(
                                              cfg.max_element_poll,
                                              n_tx_shards)
 
-    # Uniform or latency-weighted peer draws, exactly as in
-    # `parallel/sharded._local_round`: the weighted CDF is global/replicated
-    # and self-draws become abstentions (per-row exclusion is O(N^2) there).
-    if cfg.weighted_sampling:
-        w = base.latency_weight * base.alive.astype(jnp.float32)
-        peers = sample_peers_weighted(k_sample, w, n_local, cfg.k)
-        self_draw = self_sample_mask(peers, id_offset=offset)
-    else:
-        peers = sample_peers_uniform(
-            k_sample, n_global, cfg.k, cfg.exclude_self,
-            n_local=n_local, id_offset=offset,
-            with_replacement=cfg.sample_with_replacement)
-        self_draw = None
+    # The shared draw dispatch, exactly as in `parallel/sharded`.
+    peers, self_draw = draw_peers(k_sample, cfg, base.latency_weight,
+                                  base.alive, n_global,
+                                  n_local=n_local, id_offset=offset)
     lie = adversary.lie_mask(k_byz, peers, base.byzantine, cfg)
     responded = base.alive[peers]
     if self_draw is not None:

@@ -50,6 +50,8 @@ def build_config(args: argparse.Namespace) -> AvalancheConfig:
         gossip=not args.no_gossip,
         weighted_sampling=args.weighted,
         sample_with_replacement=not args.distinct_peers,
+        n_clusters=args.clusters,
+        cluster_locality=args.cluster_locality,
         byzantine_fraction=args.byzantine,
         flip_probability=args.flip_probability,
         adversary_strategy=AdversaryStrategy(args.adversary),
@@ -281,6 +283,14 @@ def main(argv=None) -> Dict:
                         help="sample k DISTINCT peers per node per round "
                              "(without replacement; the protocol's real "
                              "query semantics)")
+    parser.add_argument("--clusters", type=int, default=1,
+                        help="clustered topology: nodes in this many "
+                             "contiguous clusters; draws prefer the own "
+                             "cluster (1 = off; models: avalanche, dag, "
+                             "backlog, streaming_dag — like --weighted)")
+    parser.add_argument("--cluster-locality", type=float, default=0.8,
+                        help="P(a draw lands in the drawing node's own "
+                             "cluster)")
     parser.add_argument("--yes-fraction", type=float, default=1.0,
                         help="slush/snowflake/snowball: initial "
                              "yes-preference fraction")

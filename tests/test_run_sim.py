@@ -147,3 +147,10 @@ def test_cli_contested_avalanche(capsys):
     unanimous = main(["--model", "avalanche", "--nodes", "48", "--txs", "8",
                       "--finalization-score", "16", "--json"])
     assert result["rounds"] > unanimous["rounds"]
+
+
+def test_cli_clustered_topology(capsys):
+    result = main(["--model", "avalanche", "--nodes", "48", "--txs", "8",
+                   "--finalization-score", "16", "--clusters", "4",
+                   "--cluster-locality", "0.9", "--json"])
+    assert result["finalized_fraction"] == 1.0

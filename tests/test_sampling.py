@@ -178,3 +178,115 @@ def test_distinct_sharded_converges():
     state = sharded.shard_state(av.init(jax.random.key(0), n, t, cfg), mesh)
     final = sharded.run_sharded(mesh, state, cfg, max_rounds=300)
     assert bool(vr.has_finalized(final.records.confidence).all())
+
+
+def test_clustered_locality_statistics():
+    from go_avalanche_tpu.ops.sampling import sample_peers_clustered
+
+    n, k, c, loc = 64, 8, 4, 0.8
+    w = jnp.ones((n,))
+    own = 0
+    total = 0
+    for seed in range(32):
+        p = np.asarray(sample_peers_clustered(jax.random.key(seed), w, n, k,
+                                              c, loc))
+        cluster_of = np.arange(n) * c // n
+        own += (cluster_of[p] == cluster_of[:, None]).sum()
+        total += p.size
+    frac = own / total
+    assert abs(frac - loc) < 0.03, frac
+
+
+def test_clustered_respects_base_weights():
+    from go_avalanche_tpu.ops.sampling import sample_peers_clustered
+
+    n, k, c = 32, 8, 4
+    w = jnp.ones((n,)).at[5].set(0.0).at[20].set(0.0)   # dead peers
+    p = np.asarray(sample_peers_clustered(jax.random.key(0), w, n, k,
+                                          c, 0.7))
+    assert not np.isin(p, [5, 20]).any()
+    assert (p >= 0).all() and (p < n).all()
+
+
+def test_clustered_full_locality_never_leaves_cluster():
+    from go_avalanche_tpu.ops.sampling import sample_peers_clustered
+
+    n, k, c = 48, 8, 6
+    p = np.asarray(sample_peers_clustered(jax.random.key(1), jnp.ones((n,)),
+                                          n, k, c, 1.0))
+    cluster_of = np.arange(n) * c // n
+    assert (cluster_of[p] == cluster_of[:, None]).all()
+
+
+def test_clustered_sharded_offset_rows():
+    from go_avalanche_tpu.ops.sampling import sample_peers_clustered
+
+    # A shard owning rows [16, 32) of a 64-node, 4-cluster network: its
+    # rows belong to cluster 1 and with locality=1 draw only cluster 1.
+    p = np.asarray(sample_peers_clustered(jax.random.key(2),
+                                          jnp.ones((64,)), 16, 8, 4, 1.0,
+                                          id_offset=16))
+    assert ((p >= 16) & (p < 32)).all()
+
+
+def test_clustered_config_validation():
+    with pytest.raises(ValueError, match="n_clusters"):
+        AvalancheConfig(n_clusters=0)
+    with pytest.raises(ValueError, match="clustered"):
+        AvalancheConfig(n_clusters=4, sample_with_replacement=False)
+    with pytest.raises(ValueError, match="cluster_locality"):
+        AvalancheConfig(cluster_locality=1.5)
+
+
+def test_draw_peers_uniform_dispatch_matches_direct():
+    from go_avalanche_tpu.ops.sampling import draw_peers
+
+    cfg = AvalancheConfig()
+    key = jax.random.key(9)
+    peers, self_draw = draw_peers(key, cfg, jnp.ones((32,)),
+                                  jnp.ones((32,), jnp.bool_), 32)
+    direct = sample_peers_uniform(key, 32, cfg.k, cfg.exclude_self)
+    assert self_draw is None
+    np.testing.assert_array_equal(np.asarray(peers), np.asarray(direct))
+
+
+def test_clustered_network_converges():
+    cfg = AvalancheConfig(n_clusters=4, cluster_locality=0.9)
+    n, t = 64, 6
+    state = av.init(jax.random.key(0), n, t, cfg)
+    final = av.run(state, cfg, max_rounds=300)
+    assert bool(vr.has_finalized(final.records.confidence).all())
+
+
+def test_clustered_sharded_converges():
+    from go_avalanche_tpu.parallel import sharded
+    from go_avalanche_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh(n_node_shards=4, n_tx_shards=2)
+    cfg = AvalancheConfig(n_clusters=4, cluster_locality=0.9)
+    state = sharded.shard_state(av.init(jax.random.key(0), 32, 8, cfg), mesh)
+    final = sharded.run_sharded(mesh, state, cfg, max_rounds=300)
+    assert bool(vr.has_finalized(final.records.confidence).all())
+
+
+def test_clustered_locality_partition_splits_decisions():
+    """The topology knob has real consensus consequences: with
+    per-CLUSTER contested priors, extreme locality behaves like a network
+    partition — each cluster quickly finalizes its OWN color (a global
+    safety split, exactly what Avalanche's uniform-sampling assumption
+    exists to prevent), while mixed sampling forces one network-wide
+    answer per tx."""
+    n, t = 64, 4
+    cluster_pref = (jnp.arange(n) * 4 // n) % 2 == 0
+    pref = jnp.broadcast_to(cluster_pref[:, None], (n, t))
+    split_txs = {}
+    for loc in (0.5, 0.98):
+        cfg = AvalancheConfig(n_clusters=4, cluster_locality=loc)
+        state = av.init(jax.random.key(1), n, t, cfg, init_pref=pref)
+        final = av.run(state, cfg, max_rounds=2000)
+        fin = np.asarray(vr.has_finalized(final.records.confidence, cfg))
+        assert fin.all(), (loc, fin.mean())
+        acc = np.asarray(vr.is_accepted(final.records.confidence))
+        unanimous = acc.all(axis=0) | (~acc).all(axis=0)
+        split_txs[loc] = int((~unanimous).sum())
+    assert split_txs[0.5] == 0, split_txs          # mixed draws: one answer
+    assert split_txs[0.98] > 0, split_txs          # partition-like: split
