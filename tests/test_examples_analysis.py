@@ -43,3 +43,29 @@ def test_window_scaling_cells_run_small():
     c2 = cell_streaming_dag(16, 8, fill=2, seed=0)
     assert c2["settled_fraction"] == 1.0
     assert c2["one_winner_fraction"] == 1.0
+
+
+def test_equivocation_artifact_reproduces_cross_backend():
+    """The recorded (TPU-measured) threshold artifact is PRNG-exact: any
+    cell re-run on this backend must reproduce its resolved fraction
+    bit-for-bit.  Guards both artifact staleness and cross-backend
+    determinism of the analysis."""
+    import json
+    import os
+
+    import pytest
+
+    path = "examples/out/equivocation_threshold.json"
+    if not os.path.exists(path):
+        pytest.skip("artifact not recorded")
+    from examples.equivocation_threshold import sweep_cell
+    from go_avalanche_tpu.config import AdversaryStrategy
+
+    art = json.load(open(path))
+    c = art["config"]
+    cell = next(x for x in art["cells"]
+                if x["strategy"] == "equivocate" and x["p"] == 1.0
+                and x["eps"] == 0.05)
+    redo = sweep_cell(c["nodes"], c["txs"], c["conflict_size"], c["rounds"],
+                      cell["eps"], cell["p"], AdversaryStrategy.EQUIVOCATE)
+    assert redo["resolved"] == cell["resolved"], (redo, cell)
