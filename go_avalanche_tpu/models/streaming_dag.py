@@ -138,6 +138,7 @@ def init(
         base=base,
         conflict_set=jnp.arange(w, dtype=jnp.int32) // c,
         n_sets=window_sets,
+        set_size=c,   # static witness: the window partition is arange//c
     )
     zeros = jnp.zeros((s_b, c), jnp.int32)
     return StreamingDagState(
@@ -271,7 +272,7 @@ def _retire_and_refill(
     )
     return StreamingDagState(
         dag=dag_model.DagSimState(new_base, state.dag.conflict_set,
-                                  state.dag.n_sets),
+                                  state.dag.n_sets, state.dag.set_size),
         slot_set=new_set,
         slot_admit_round=jnp.where(take, base.round,
                                    state.slot_admit_round),

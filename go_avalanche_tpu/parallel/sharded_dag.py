@@ -45,14 +45,15 @@ from go_avalanche_tpu.parallel import sharded
 from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS
 
 
-def dag_state_specs(n_sets: int) -> DagSimState:
+def dag_state_specs(n_sets: int, set_size=None) -> DagSimState:
     """PartitionSpecs for every leaf of `DagSimState`.
 
-    `n_sets` rides along as the pytree's static aux data so the spec tree
-    and the value tree unflatten identically.
+    `n_sets` and `set_size` ride along as the pytree's static aux data so
+    the spec tree and the value tree unflatten identically.
     """
     return DagSimState(base=sharded.state_specs(),
-                       conflict_set=P(TXS_AXIS), n_sets=n_sets)
+                       conflict_set=P(TXS_AXIS), n_sets=n_sets,
+                       set_size=set_size)
 
 
 def shard_dag_state(state: DagSimState, mesh) -> DagSimState:
@@ -80,7 +81,7 @@ def shard_dag_state(state: DagSimState, mesh) -> DagSimState:
                 f"between tx shards {i} and {i + 1}")
     return jax.tree.map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
-        state, dag_state_specs(state.n_sets))
+        state, dag_state_specs(state.n_sets, state.set_size))
 
 
 def _local_sets(conflict_set_local: jax.Array) -> jax.Array:
@@ -116,10 +117,17 @@ def _local_round(
     fin_acc = fin & vr.is_accepted(base.records.confidence)
     alive_local = lax.dynamic_slice(base.alive, (offset,), (n_local,))
 
-    # --- rival-settled freeze: local segment pass over local columns.
-    set_done = jax.ops.segment_max(fin_acc.astype(jnp.uint8).T, cs_local,
-                                   num_segments=state.n_sets)
-    rival_settled = (set_done.T[:, cs_local] > 0) & jnp.logical_not(fin_acc)
+    # --- rival-settled freeze: local set pass over local columns (the
+    # non-straddling contract makes the fixed partition locally contiguous,
+    # so the reshape fast path applies per shard too).
+    if state.set_size is not None:
+        rival_settled = (dag_model.set_any_fixed(fin_acc, state.set_size)
+                         & jnp.logical_not(fin_acc))
+    else:
+        set_done = jax.ops.segment_max(fin_acc.astype(jnp.uint8).T, cs_local,
+                                       num_segments=state.n_sets)
+        rival_settled = (set_done.T[:, cs_local] > 0) \
+            & jnp.logical_not(fin_acc)
 
     pollable = (base.added & alive_local[:, None] & base.valid[None, :]
                 & jnp.logical_not(fin) & jnp.logical_not(rival_settled))
@@ -141,8 +149,12 @@ def _local_round(
                                            peers.shape)
 
     # --- response plane: preferred-in-set, packed + all-gathered.
-    prefs_local = dag_model.preferred_in_set(base.records.confidence,
-                                             cs_local, state.n_sets)
+    if state.set_size is not None:
+        prefs_local = dag_model.preferred_in_set_fixed(
+            base.records.confidence, state.set_size)
+    else:
+        prefs_local = dag_model.preferred_in_set(base.records.confidence,
+                                                 cs_local, state.n_sets)
     packed_global = lax.all_gather(pack_bool_plane(prefs_local), NODES_AXIS,
                                    axis=0, tiled=True)
     if cfg.adversary_strategy is AdversaryStrategy.OPPOSE_MAJORITY:
@@ -192,11 +204,12 @@ def _local_round(
         score_rank=base.score_rank, byzantine=base.byzantine,
         alive=alive, latency_weight=base.latency_weight,
         finalized_at=finalized_at, round=base.round + 1, key=k_next)
-    return DagSimState(new_base, state.conflict_set, state.n_sets), telemetry
+    return DagSimState(new_base, state.conflict_set, state.n_sets,
+                       state.set_size), telemetry
 
 
-def _shard_mapped(mesh, n_sets: int, fn, tel: bool = True):
-    specs = dag_state_specs(n_sets)
+def _shard_mapped(mesh, n_sets: int, fn, tel: bool = True, set_size=None):
+    specs = dag_state_specs(n_sets, set_size)
     if tel:
         tel_specs = av.SimTelemetry(*([P()] * len(av.SimTelemetry._fields)))
         out_specs = (specs, tel_specs)
@@ -214,12 +227,14 @@ def make_sharded_dag_round_step(mesh, cfg: AvalancheConfig = DEFAULT_CONFIG):
     n_tx = mesh.shape[TXS_AXIS]
 
     def step(state: DagSimState):
-        key = (state.base.records.votes.shape[0], state.n_sets)
+        key = (state.base.records.votes.shape[0], state.n_sets,
+               state.set_size)
         if key not in cache:
             n_global = key[0]
             cache[key] = jax.jit(_shard_mapped(
                 mesh, state.n_sets,
-                lambda s: _local_round(s, cfg, n_global, n_tx)))
+                lambda s: _local_round(s, cfg, n_global, n_tx),
+                set_size=state.set_size))
         return cache[key](state)
 
     return step
@@ -246,10 +261,14 @@ def run_sharded_dag(
             cs_local = _local_sets(st.conflict_set)
             fin_acc = (vr.has_finalized(base.records.confidence, cfg)
                        & vr.is_accepted(base.records.confidence))
-            set_done = jax.ops.segment_max(
-                fin_acc.astype(jnp.uint8).T, cs_local,
-                num_segments=st.n_sets)
-            open_sets = ((set_done.T[:, cs_local] == 0)
+            if st.set_size is not None:
+                set_done_t = dag_model.set_any_fixed(fin_acc, st.set_size)
+            else:
+                set_done = jax.ops.segment_max(
+                    fin_acc.astype(jnp.uint8).T, cs_local,
+                    num_segments=st.n_sets)
+                set_done_t = set_done.T[:, cs_local] > 0
+            open_sets = (jnp.logical_not(set_done_t)
                          & alive_local[:, None] & base.valid[None, :])
             return lax.psum(open_sets.any().astype(jnp.int32),
                             (NODES_AXIS, TXS_AXIS)) > 0
@@ -266,5 +285,6 @@ def run_sharded_dag(
         final, _ = lax.while_loop(cond, body, (s, unresolved(s)))
         return final
 
-    fn = _shard_mapped(mesh, state.n_sets, local_run, tel=False)
+    fn = _shard_mapped(mesh, state.n_sets, local_run, tel=False,
+                       set_size=state.set_size)
     return jax.jit(fn)(state)

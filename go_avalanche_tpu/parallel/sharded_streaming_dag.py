@@ -55,10 +55,11 @@ from go_avalanche_tpu.parallel import sharded, sharded_dag
 from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS
 
 
-def streaming_dag_state_specs(n_sets: int) -> StreamingDagState:
+def streaming_dag_state_specs(n_sets: int,
+                              set_size=None) -> StreamingDagState:
     """PartitionSpecs for every leaf of `StreamingDagState`."""
     return StreamingDagState(
-        dag=sharded_dag.dag_state_specs(n_sets),
+        dag=sharded_dag.dag_state_specs(n_sets, set_size),
         slot_set=P(TXS_AXIS),
         slot_admit_round=P(TXS_AXIS),
         backlog=SetBacklog(score=P(), init_pref=P(), valid=P()),
@@ -87,7 +88,8 @@ def shard_streaming_dag_state(state: StreamingDagState,
             f"the set capacity ({c}) so sets do not straddle tx shards")
     return jax.tree.map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
-        state, streaming_dag_state_specs(state.dag.n_sets))
+        state, streaming_dag_state_specs(state.dag.n_sets,
+                                         state.dag.set_size))
 
 
 def _merge_rows(old, row_idx, rows, s_b):
@@ -229,7 +231,7 @@ def _local_retire_and_refill(
     retired = lax.psum(settled.sum().astype(jnp.int32), TXS_AXIS)
     return StreamingDagState(
         dag=dag_model.DagSimState(new_base, state.dag.conflict_set,
-                                  state.dag.n_sets),
+                                  state.dag.n_sets, state.dag.set_size),
         slot_set=new_set,
         slot_admit_round=jnp.where(take, base.round,
                                    state.slot_admit_round),
@@ -260,8 +262,8 @@ def _local_step(
     return state._replace(dag=new_dag), tel
 
 
-def _shard_mapped(mesh, n_sets: int, fn, with_tel=True):
-    specs = streaming_dag_state_specs(n_sets)
+def _shard_mapped(mesh, n_sets: int, fn, with_tel=True, set_size=None):
+    specs = streaming_dag_state_specs(n_sets, set_size)
     if with_tel:
         tel_specs = StreamingDagTelemetry(
             round=av.SimTelemetry(*([P()] * len(av.SimTelemetry._fields))),
@@ -281,12 +283,14 @@ def make_sharded_streaming_dag_step(mesh,
 
     def step(state: StreamingDagState):
         c = state.backlog.score.shape[1]
-        key = (state.dag.base.records.votes.shape[0], state.dag.n_sets, c)
+        key = (state.dag.base.records.votes.shape[0], state.dag.n_sets, c,
+               state.dag.set_size)
         if key not in cache:
             n_global = key[0]
             cache[key] = jax.jit(_shard_mapped(
                 mesh, state.dag.n_sets,
-                lambda s: _local_step(s, cfg, c, n_global, n_tx)))
+                lambda s: _local_step(s, cfg, c, n_global, n_tx),
+                set_size=state.dag.set_size))
         return cache[key](state)
 
     return step
@@ -328,7 +332,8 @@ def run_sharded_streaming_dag(
         final, _ = _local_retire_and_refill(final, cfg, c, refill=False)
         return final
 
-    fn = _shard_mapped(mesh, state.dag.n_sets, local_run, with_tel=False)
+    fn = _shard_mapped(mesh, state.dag.n_sets, local_run, with_tel=False,
+                       set_size=state.dag.set_size)
     return jax.jit(fn)(state)
 
 
@@ -349,4 +354,5 @@ def run_scan_sharded_streaming_dag(
             return new_s, tel
         return lax.scan(body, s, None, length=n_rounds)
 
-    return jax.jit(_shard_mapped(mesh, state.dag.n_sets, local_scan))(state)
+    return jax.jit(_shard_mapped(mesh, state.dag.n_sets, local_scan,
+                                 set_size=state.dag.set_size))(state)

@@ -134,3 +134,60 @@ def test_dag_churn_toggles_membership():
     new_state, _ = jax.jit(dag.round_step, static_argnames="cfg")(state, cfg)
     alive = np.asarray(new_state.base.alive)
     assert 0 < alive.sum() < 64  # ~half toggled dead in one round
+
+
+def test_fixed_partition_fast_path_matches_segment():
+    # The reshape+argmax fast path (set_size witness) must agree with the
+    # general segment path on every plane it replaces, for random
+    # confidence words including ties within a set.
+    key = jax.random.key(7)
+    n, s, c = 8, 6, 4
+    t = s * c
+    conflict_set = jnp.arange(t, dtype=jnp.int32) // c
+    conf = jax.random.randint(key, (n, t), 0, 1 << 9).astype(jnp.uint16)
+    # Force ties inside some sets so the lowest-index tie-break is hit.
+    conf = conf.at[:, 1].set(conf[:, 0]).at[:, c + 2].set(conf[:, c])
+    slow = dag.preferred_in_set(conf, conflict_set, s)
+    fast = dag.preferred_in_set_fixed(conf, c)
+    np.testing.assert_array_equal(np.asarray(slow), np.asarray(fast))
+
+    fin_acc = jax.random.bernoulli(jax.random.key(8), 0.3, (n, t))
+    seg = jax.ops.segment_max(fin_acc.astype(jnp.uint8).T, conflict_set,
+                              num_segments=s)
+    np.testing.assert_array_equal(
+        np.asarray(seg.T[:, conflict_set] > 0),
+        np.asarray(dag.set_any_fixed(fin_acc, c)))
+
+
+def test_init_detects_fixed_partition():
+    cfg = AvalancheConfig()
+    st = dag.init(jax.random.key(0), 4, jnp.arange(12, dtype=jnp.int32) // 3,
+                  cfg)
+    assert st.set_size == 3
+    # Ragged partition: no witness, segment path.
+    st2 = dag.init(jax.random.key(0), 4,
+                   jnp.array([0, 0, 1, 1, 1, 2], jnp.int32), cfg)
+    assert st2.set_size is None
+    # Same-size sets but permuted (non-contiguous): no witness.
+    st3 = dag.init(jax.random.key(0), 4,
+                   jnp.array([0, 1, 0, 1], jnp.int32), cfg)
+    assert st3.set_size is None
+
+
+def test_fixed_partition_run_matches_generic_run():
+    # End-to-end: the same 2-tx-set network run with and without the
+    # fast-path witness converges identically (same PRNG stream, same
+    # update rule => bit-identical confidence planes).
+    cfg = AvalancheConfig()
+    n, s, c = 32, 4, 2
+    cs = jnp.arange(s * c, dtype=jnp.int32) // c
+    state = dag.init(jax.random.key(3), n, cs, cfg)
+    assert state.set_size == c
+    generic = dag.DagSimState(base=state.base, conflict_set=state.conflict_set,
+                              n_sets=state.n_sets)   # set_size=None
+    fast_final = dag.run(state, cfg, max_rounds=400)
+    slow_final = dag.run(generic, cfg, max_rounds=400)
+    np.testing.assert_array_equal(
+        np.asarray(fast_final.base.records.confidence),
+        np.asarray(slow_final.base.records.confidence))
+    assert int(fast_final.base.round) == int(slow_final.base.round)
