@@ -171,6 +171,38 @@ def _run_attempt(argv: list[str], timeout_s: float) -> tuple[dict | None, str]:
     return None, f"rc={proc.returncode}: " + " | ".join(tail[-3:])
 
 
+def _attach_prev_delta(parsed: dict) -> dict:
+    """Annotate the result with the previous round's recorded number.
+
+    The driver archives each round's line in `BENCH_r{N}.json`; comparing
+    against the latest one makes a regression visible IN the new artifact
+    itself (the r02->r03 5% drop landed silently because nothing compared
+    rounds).  Same-workload comparisons only — a metric-string mismatch
+    (shape/backend change) skips the delta rather than implying one.
+    """
+    import glob
+    import re
+    try:
+        rounds = []
+        for path in glob.glob(os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "BENCH_r*.json")):
+            m = re.search(r"BENCH_r(\d+)\.json$", path)
+            if m:  # numeric sort: r100 must not sort before r99
+                rounds.append((int(m.group(1)), path))
+        if not rounds:
+            return parsed
+        prev_round, prev_path = max(rounds)
+        prev = json.loads(open(prev_path).read()).get("parsed", {})
+        if prev.get("metric") == parsed.get("metric") and prev.get("value"):
+            parsed["prev_round"] = prev_round
+            parsed["prev_value"] = prev["value"]
+            parsed["delta_vs_prev_pct"] = round(
+                100.0 * (parsed["value"] - prev["value"]) / prev["value"], 2)
+    except (OSError, ValueError, KeyError, json.JSONDecodeError):
+        pass  # the delta is best-effort; never break the one-line contract
+    return parsed
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     # 16384^2 measured fastest on v5e (~60B votes/s; 8192^2 ~57B, 32k x 16k
@@ -207,7 +239,7 @@ def main() -> None:
     for attempt in range(args.attempts):
         parsed, diag = _run_attempt(size, args.attempt_timeout)
         if parsed is not None:
-            print(json.dumps(parsed))
+            print(json.dumps(_attach_prev_delta(parsed)))
             return
         errors.append(f"attempt {attempt + 1}: {diag}")
         if attempt + 1 < args.attempts:

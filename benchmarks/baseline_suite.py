@@ -205,16 +205,32 @@ def config5_backlog_scale(quick: bool) -> Dict:
 def config6_streaming_conflict(quick: bool) -> Dict:
     """The literal north-star workload: 100k nodes x 1M pending txs in
     2-tx UTXO conflict sets, streamed through a bounded conflict window
-    (models/streaming_dag) on one chip."""
-    n, b_sets, c, w_sets = ((64, 1024, 2, 32) if quick
-                            else (100_000, 500_000, 2, 1024))
-    cfg = AvalancheConfig(gossip=False, max_element_poll=w_sets * c)
-    scores = jax.random.randint(jax.random.key(1), (b_sets, c), 0, 1 << 20)
-    backlog = sdg.make_set_backlog(scores)
-    state = sdg.init(jax.random.key(0), n, w_sets, backlog, cfg)
+    (models/streaming_dag) on one chip.
+
+    Executed via `run_chunked` — a single 500k-round while_loop dispatch
+    runs >10 minutes on this workload and trips the TPU worker's liveness
+    watchdog (the round-3 "TPU worker process crashed" failure); ~25s
+    chunks with host syncs run to completion.  No checkpointing here (a
+    crash mid-suite loses this row only); `benchmarks/northstar.py` is the
+    resilient driver for this config — async checkpoints, a heartbeat
+    watchdog, and process-level resume — and can rewrite this row via
+    `--update-results`.
+    """
+    from benchmarks.workload import NORTH_STAR, QUICK, northstar_state
+
+    shape = QUICK if quick else NORTH_STAR
+    n, b_sets = shape["nodes"], shape["backlog_sets"]
+    c, w_sets = shape["set_cap"], shape["window_sets"]
+    state, cfg = northstar_state(**shape)
     t0 = time.time()
-    final = jax.jit(sdg.run, static_argnames=("cfg", "max_rounds"))(
-        state, cfg, 500_000)
+
+    def progress(rounds, s):
+        left = int(jax.device_get(s.next_idx))
+        print(f"  config6: round {rounds}, {left}/{b_sets} sets admitted, "
+              f"{time.time() - t0:.0f}s", file=sys.stderr, flush=True)
+
+    final = sdg.run_chunked(state, cfg, max_rounds=500_000,
+                            chunk=64 if quick else 256, progress=progress)
     rounds = int(jax.device_get(final.dag.base.round))
     wall = time.time() - t0
     summary = sdg.resolution_summary(final)
@@ -226,6 +242,7 @@ def config6_streaming_conflict(quick: bool) -> Dict:
         "sets_one_winner_fraction": summary["sets_one_winner_fraction"],
         "txs_per_sec": round(summary["txs_settled"] / wall, 1),
         "settle_latency_median": summary["settle_latency_median"],
+        "settle_latency_p90": summary["settle_latency_p90"],
         "wall_s": round(wall, 3),
     }
 
@@ -247,6 +264,10 @@ def render_results_md(results, backend: str) -> str:
         "",
         f"Backend: `{backend}`.  Produced by `benchmarks/baseline_suite.py`;",
         "throughput north star is measured separately by `bench.py`.",
+        "Wall-clocks include host dispatch through the axon tunnel and vary",
+        "~2-3x with tunnel load between refreshes — compare rows within one",
+        "refresh, not across them (per-row deltas are only attributable to",
+        "code when the whole table was re-measured together).",
         "Sharded execution (config \"byzantine mix\" names a sharded DAG) is",
         "validated on an 8-device virtual mesh by `tests/test_sharded_dag.py`",
         "(and `tests/test_sharding.py` for the plain sharded round,",
@@ -261,10 +282,12 @@ def render_results_md(results, backend: str) -> str:
         outcome = "; ".join(
             f"{k}={v}" for k, v in r.items()
             if k not in ("name", "rounds", "wall_s", "finality"))
+        rounds = r["rounds"] if r["rounds"] is not None else "—"
+        wall = r["wall_s"] if r["wall_s"] is not None else "—"
         lines.append(
-            f"| {r['name']} | {r['rounds']} | {outcome} "
+            f"| {r['name']} | {rounds} | {outcome} "
             f"| {fin.get('median', '—')} | {fin.get('p90', '—')} "
-            f"| {r['wall_s']} |")
+            f"| {wall} |")
     lines.append("")
     lines.extend(_render_analysis_sections())
     return "\n".join(lines)
@@ -411,7 +434,10 @@ def main() -> None:
         try:
             r = fn(args.quick)
         except Exception as e:  # record and keep measuring the rest
-            r = {"name": fn.__name__, "rounds": "—", "wall_s": "—",
+            # Numeric fields stay null on failure (never placeholder
+            # strings) so downstream consumers of results.json don't break;
+            # the error lives in its own field.
+            r = {"name": fn.__name__, "rounds": None, "wall_s": None,
                  "error": f"{type(e).__name__}: {e}"}
         results.append(r)
         print(json.dumps(r), flush=True)
@@ -419,7 +445,8 @@ def main() -> None:
     if not args.no_write and args.only is None and not args.quick:
         (REPO / "RESULTS.md").write_text(render_results_md(results, backend))
         (REPO / "benchmarks" / "results.json").write_text(
-            json.dumps({"backend": backend, "results": results}, indent=1))
+            json.dumps({"backend": backend, "results": results}, indent=1)
+            + "\n")
 
 
 if __name__ == "__main__":

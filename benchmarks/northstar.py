@@ -1,0 +1,255 @@
+"""North-star driver: 100k nodes x 1M-tx streaming conflict-DAG, resiliently.
+
+The literal BASELINE.json scale target (`benchmarks/baseline_suite.py`
+config6) needs ~8k rounds / ~12 minutes of sustained TPU work through the
+axon tunnel, and the tunnel has twice failed to sustain it: round 3 killed a
+single 500k-round while_loop dispatch outright ("TPU worker process crashed
+or restarted ... kernel fault"), and in round 4 a 256-round chunked run
+wedged a device call forever at ~77% drained (futex wait, no error, healthy
+backend in the next process).  Neither failure is data-dependent — resuming
+past the wedge point works — so the fix is process-level:
+
+  worker   runs `streaming_dag.run_chunked` with a checkpoint every few
+           chunks and a progress heartbeat file every chunk;
+  parent   watches the heartbeat; a stalled worker is killed and a fresh
+           process resumes from the last checkpoint (the backend re-inits
+           clean).  Wall-clock is accounted across ALL attempts, restarts
+           and re-compiles included — the honest end-to-end number.
+
+Emits ONE JSON line with rounds, txs/sec, sets_one_winner_fraction and
+settle-latency percentiles; `--update-results` rewrites the config6 row of
+`benchmarks/results.json` + `RESULTS.md` in place.
+
+    python benchmarks/northstar.py            # full shape, ~12 min healthy
+    python benchmarks/northstar.py --quick    # CI-sized smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+REPO = Path(__file__).resolve().parent.parent
+
+from benchmarks.workload import (  # noqa: E402 — after the sys.path insert
+    NORTH_STAR as FULL,
+    QUICK,
+    northstar_state,
+)
+
+
+def worker(args: argparse.Namespace) -> None:
+    import threading
+
+    import jax
+
+    if args.force_cpu:
+        # The axon sitecustomize overrides the JAX_PLATFORMS env var, so
+        # pinning CPU must happen via config AFTER the jax import (same
+        # trick as tests/conftest.py) — this is how the --quick smoke runs
+        # on CPU-only boxes (CI) without touching the tunnel.
+        jax.config.update("jax_platforms", "cpu")
+
+    from go_avalanche_tpu.models import streaming_dag as sdg
+    from go_avalanche_tpu.utils.checkpoint import (
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+    def beat(note: str) -> None:
+        """Startup heartbeats: checkpoint restore is itself a ~100s
+        device transfer, so the worker must prove liveness to the parent
+        watchdog before the first chunk completes."""
+        Path(args.progress).write_text(json.dumps({"startup": note}) + "\n")
+
+    beat("init")
+    shape = QUICK if args.quick else FULL
+    state, cfg = northstar_state(**shape)
+    beat("state built")
+    if os.path.exists(args.ckpt):
+        state = restore_checkpoint(args.ckpt, state)
+        print(f"resumed from {args.ckpt} at round "
+              f"{int(jax.device_get(state.dag.base.round))}",
+              file=sys.stderr, flush=True)
+        beat("checkpoint restored")
+
+    t0 = time.time()
+    # Checkpoints are written from a BACKGROUND thread: the ~1.9GB
+    # device->host fetch runs at ~19MB/s through the axon tunnel (~100s,
+    # measured r4 — 4x a chunk's compute), so a synchronous save would
+    # double the run.  Device arrays are immutable, so snapshotting the
+    # chunk-boundary state while later chunks compute is race-free; the
+    # write itself is atomic (tmp + rename) so a mid-save kill can't tear
+    # the file.  One save at a time; boundaries are skipped while a save
+    # is in flight.
+    ckpt_thread: list = [None]
+    chunk_i = [0]
+
+    def progress(rounds, s):
+        Path(args.progress).write_text(json.dumps({
+            "round": rounds,
+            "admitted": int(jax.device_get(s.next_idx)),
+            "attempt_wall_s": round(time.time() - t0, 1),
+        }) + "\n")
+        chunk_i[0] += 1
+        th = ckpt_thread[0]
+        if chunk_i[0] % args.ckpt_every == 0 and (th is None
+                                                  or not th.is_alive()):
+            th = threading.Thread(target=save_checkpoint,
+                                  args=(args.ckpt, s), daemon=True)
+            th.start()
+            ckpt_thread[0] = th
+
+    final = sdg.run_chunked(
+        state, cfg, max_rounds=500_000, chunk=args.chunk,
+        progress=progress)
+    if ckpt_thread[0] is not None:
+        ckpt_thread[0].join()
+
+    summary = sdg.resolution_summary(final)
+    shape_name = (f"{shape['nodes']} nodes, "
+                  f"{shape['backlog_sets'] * shape['set_cap']} txs in "
+                  f"{shape['backlog_sets']} conflict sets, "
+                  f"{shape['window_sets']}-set window")
+    Path(args.result).write_text(json.dumps({
+        "name": f"streaming conflict-DAG ({shape_name})",
+        "rounds": int(jax.device_get(final.dag.base.round)),
+        "sets_settled_fraction": summary["sets_settled_fraction"],
+        "sets_one_winner_fraction": summary["sets_one_winner_fraction"],
+        "txs_settled": summary["txs_settled"],
+        "settle_latency_median": summary["settle_latency_median"],
+        "settle_latency_p90": summary["settle_latency_p90"],
+        "backend": jax.default_backend(),
+    }) + "\n")
+
+
+def parent(args: argparse.Namespace) -> None:
+    workdir = Path(args.workdir)
+    workdir.mkdir(parents=True, exist_ok=True)
+    ckpt = str(workdir / "northstar.npz")
+    progress = str(workdir / "progress.json")
+    result = str(workdir / "result.json")
+    for p in (progress, result):
+        if os.path.exists(p):
+            os.unlink(p)
+    if not args.resume and os.path.exists(ckpt):
+        os.unlink(ckpt)
+
+    # Honest wall-clock across parent restarts: a --resume continuation
+    # adds to the accumulated time of the attempts that produced the
+    # checkpoint, so txs_per_sec never credits resumed work as free.
+    wall_file = workdir / "wall_accum.json"
+    accum = 0.0
+    if args.resume and wall_file.exists():
+        accum = json.loads(wall_file.read_text()).get("accum_s", 0.0)
+    t_start = time.time()
+    attempts = 0
+    while attempts < args.max_attempts:
+        attempts += 1
+        child_args = [sys.executable, os.path.abspath(__file__), "--worker",
+                      f"--ckpt={ckpt}", f"--progress={progress}",
+                      f"--result={result}", f"--chunk={args.chunk}",
+                      f"--ckpt-every={args.ckpt_every}"]
+        if args.quick:
+            child_args.append("--quick")
+        if args.force_cpu:
+            child_args.append("--force-cpu")
+        proc = subprocess.Popen(child_args, stderr=sys.stderr)
+        # Heartbeat watchdog: a chunk takes ~25s healthy (first one
+        # ~45s with compile); no heartbeat for stall_timeout => the device
+        # call wedged => kill and resume from checkpoint in a new process.
+        last_beat = time.time()
+        while proc.poll() is None:
+            time.sleep(5)
+            wall_file.write_text(json.dumps(
+                {"accum_s": round(accum + time.time() - t_start, 1)}) + "\n")
+            if os.path.exists(progress):
+                mtime = os.path.getmtime(progress)
+                if mtime > last_beat:
+                    last_beat = mtime
+            if time.time() - last_beat > args.stall_timeout:
+                print(f"attempt {attempts}: no heartbeat for "
+                      f"{args.stall_timeout:.0f}s — killing worker",
+                      file=sys.stderr, flush=True)
+                proc.send_signal(signal.SIGKILL)
+                proc.wait()
+                break
+        if proc.returncode == 0 and os.path.exists(result):
+            out = json.loads(Path(result).read_text())
+            wall = accum + time.time() - t_start
+            out["wall_s"] = round(wall, 3)
+            out["txs_per_sec"] = round(out.pop("txs_settled") / wall, 1)
+            out["attempts"] = attempts
+            print(json.dumps(out), flush=True)
+            if args.update_results:
+                _update_results(out)
+            return
+        print(f"attempt {attempts} ended (rc={proc.returncode}); resuming "
+              f"from checkpoint", file=sys.stderr, flush=True)
+    print(json.dumps({"error": f"no result after {attempts} attempts"}))
+    sys.exit(1)
+
+
+def _update_results(row: dict) -> None:
+    """Rewrite the config6 row of benchmarks/results.json and RESULTS.md."""
+    from benchmarks.baseline_suite import render_results_md
+
+    path = REPO / "benchmarks" / "results.json"
+    data = json.loads(path.read_text())
+    results = data["results"]
+    idx = next((i for i, r in enumerate(results)
+                if "streaming conflict-DAG" in str(r.get("name", ""))
+                or r.get("name") == "config6_streaming_conflict"), None)
+    row = dict(row)
+    # The row keeps its own "backend" field: results.json's top-level
+    # backend describes the suite refresh, and a north-star rerun on a
+    # different backend must stay labeled rather than inherit it.
+    if row.get("backend") == data.get("backend"):
+        row.pop("backend", None)
+    if idx is None:   # no config6 row to replace: append, never overwrite
+        results.append(row)
+    else:
+        results[idx] = row
+    path.write_text(json.dumps(data, indent=1) + "\n")
+    (REPO / "RESULTS.md").write_text(
+        render_results_md(results, data.get("backend", "?")))
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--quick", action="store_true")
+    parser.add_argument("--force-cpu", action="store_true",
+                        help="pin the CPU backend (smoke-testing the "
+                             "driver on boxes without the accelerator)")
+    parser.add_argument("--worker", action="store_true")
+    parser.add_argument("--resume", action="store_true",
+                        help="reuse an existing checkpoint instead of "
+                             "starting fresh")
+    parser.add_argument("--chunk", type=int, default=256)
+    parser.add_argument("--ckpt-every", type=int, default=4,
+                        help="chunks between (async) checkpoint saves")
+    parser.add_argument("--stall-timeout", type=float, default=240.0)
+    parser.add_argument("--max-attempts", type=int, default=12)
+    parser.add_argument("--workdir", type=str,
+                        default=str(REPO / "benchmarks" / "northstar_work"))
+    parser.add_argument("--update-results", action="store_true")
+    parser.add_argument("--ckpt", type=str, default="")
+    parser.add_argument("--progress", type=str, default="")
+    parser.add_argument("--result", type=str, default="")
+    args = parser.parse_args()
+    if args.worker:
+        worker(args)
+    else:
+        parent(args)
+
+
+if __name__ == "__main__":
+    main()

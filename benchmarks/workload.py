@@ -1,0 +1,52 @@
+"""The north-star streaming conflict-DAG workload, built in ONE place.
+
+Three benchmark surfaces measure this same config (BASELINE.json
+north_star: 100k nodes x 1M pending txs in 2-tx UTXO conflict sets through
+a bounded window): `baseline_suite.config6_streaming_conflict` (suite
+row), `northstar.py` (resilient full-scale driver), and
+`bench_streaming.py` (votes/sec).  They must construct bit-identical
+state — same seeds, same score range, same config — or their numbers stop
+describing one workload.  This module is that single construction.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+from typing import Tuple
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+NORTH_STAR = dict(nodes=100_000, backlog_sets=500_000, set_cap=2,
+                  window_sets=1024)
+QUICK = dict(nodes=64, backlog_sets=1024, set_cap=2, window_sets=32)
+
+# Seeds pinned for cross-surface comparability: key(1) draws the scores,
+# key(0) seeds the sim (same convention as `bench.py`'s flagship state).
+_SCORE_SEED, _SIM_SEED, _SCORE_MAX = 1, 0, 1 << 20
+
+
+def northstar_config(window_sets: int, set_cap: int):
+    """The AvalancheConfig every north-star surface runs under: gossip off
+    (every node pre-seeded, as in the reference example's feed) and a poll
+    cap covering the whole window."""
+    from go_avalanche_tpu.config import AvalancheConfig
+
+    return AvalancheConfig(gossip=False,
+                           max_element_poll=window_sets * set_cap)
+
+
+def northstar_state(nodes: int, backlog_sets: int, set_cap: int,
+                    window_sets: int) -> Tuple[object, object]:
+    """Build (state, cfg) for the streaming conflict-DAG workload."""
+    import jax
+
+    from go_avalanche_tpu.models import streaming_dag as sdg
+
+    cfg = northstar_config(window_sets, set_cap)
+    scores = jax.random.randint(jax.random.key(_SCORE_SEED),
+                                (backlog_sets, set_cap), 0, _SCORE_MAX)
+    backlog = sdg.make_set_backlog(scores)
+    state = sdg.init(jax.random.key(_SIM_SEED), nodes, window_sets,
+                     backlog, cfg)
+    return state, cfg

@@ -36,6 +36,7 @@ from jax import lax
 from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
 from go_avalanche_tpu.models import avalanche as av
 from go_avalanche_tpu.ops import adversary, voterecord as vr
+from go_avalanche_tpu.ops.bitops import pack_bool_plane, unpack_bool_plane
 from go_avalanche_tpu.ops.sampling import draw_peers
 
 
@@ -214,9 +215,15 @@ def round_step(
     else:
         prefs = preferred_in_set(base.records.confidence, state.conflict_set,
                                  state.n_sets)
+    # Bit-pack the preference plane BEFORE the k row-gathers, as in
+    # `models/avalanche.round_step`: each gather then reads T/8 bytes per
+    # row instead of T (measured 23.0ms -> 10.6ms for the gather+pack stage
+    # at 100k nodes x 2048 txs on v5e — the streaming north-star shape).
     minority_t = adversary.minority_plane(prefs)
+    packed_prefs = pack_bool_plane(prefs)
     yes_pack, consider_pack = adversary.pack_adversarial_votes(
-        lambda j: prefs[peers[:, j]], responded, lie, k_byz, cfg, minority_t)
+        lambda j: unpack_bool_plane(packed_prefs[peers[:, j]], t),
+        responded, lie, k_byz, cfg, minority_t)
 
     records, changed = vr.register_packed_votes(
         base.records, yes_pack, consider_pack, cfg.k, cfg, update_mask=polled)

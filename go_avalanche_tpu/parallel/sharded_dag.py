@@ -21,7 +21,7 @@ targets; the equivocation coin additionally folds the txs-shard index
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -45,7 +45,8 @@ from go_avalanche_tpu.parallel import sharded
 from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS
 
 
-def dag_state_specs(n_sets: int, set_size=None) -> DagSimState:
+def dag_state_specs(n_sets: int,
+                    set_size: Optional[int] = None) -> DagSimState:
     """PartitionSpecs for every leaf of `DagSimState`.
 
     `n_sets` and `set_size` ride along as the pytree's static aux data so
@@ -121,6 +122,13 @@ def _local_round(
     # non-straddling contract makes the fixed partition locally contiguous,
     # so the reshape fast path applies per shard too).
     if state.set_size is not None:
+        if t_local % state.set_size:
+            # `shard_dag_state` placement guarantees this; re-validate for
+            # states placed by other means so the failure names the
+            # contract instead of surfacing as a reshape-size trace error.
+            raise ValueError(
+                f"set_size={state.set_size} must divide the per-shard tx "
+                f"width ({t_local}) for the fixed-partition fast path")
         rival_settled = (dag_model.set_any_fixed(fin_acc, state.set_size)
                          & jnp.logical_not(fin_acc))
     else:
@@ -208,7 +216,8 @@ def _local_round(
                        state.set_size), telemetry
 
 
-def _shard_mapped(mesh, n_sets: int, fn, tel: bool = True, set_size=None):
+def _shard_mapped(mesh, n_sets: int, fn, tel: bool = True,
+                  set_size: Optional[int] = None):
     specs = dag_state_specs(n_sets, set_size)
     if tel:
         tel_specs = av.SimTelemetry(*([P()] * len(av.SimTelemetry._fields)))

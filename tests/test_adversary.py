@@ -87,6 +87,7 @@ def _final_snowball(cfg, n=128, yes_fraction=0.8, max_rounds=300, seed=0):
     return snowball.run(state, cfg, max_rounds)
 
 
+@pytest.mark.slow
 def test_oppose_majority_stalls_convergence_hardest():
     """With the same byzantine share, the minority-pushing adversary must
     finalize strictly fewer honest nodes than the FLIP adversary (which,
@@ -106,6 +107,7 @@ def test_oppose_majority_stalls_convergence_hardest():
         < outcomes[AdversaryStrategy.FLIP], outcomes
 
 
+@pytest.mark.slow
 def test_honest_network_unaffected_by_strategy_choice():
     # byzantine_fraction = 0: the strategy knob must be inert (bit-identical
     # final state across strategies for the same seed).
@@ -119,6 +121,7 @@ def test_honest_network_unaffected_by_strategy_choice():
 
 
 @pytest.mark.parametrize("strat", list(AdversaryStrategy))
+@pytest.mark.slow
 def test_multitarget_runs_under_every_strategy(strat):
     cfg = AvalancheConfig(byzantine_fraction=0.2, flip_probability=0.5,
                           adversary_strategy=strat)
@@ -139,6 +142,7 @@ def test_family_models_run_under_every_strategy(strat):
     assert int(f1.round) == 1
 
 
+@pytest.mark.slow
 def test_equivocation_slows_split_network():
     """A 50/50 split with equivocating byzantine peers must take longer to
     fully finalize than the same split with honest-only nodes."""
@@ -154,6 +158,7 @@ def test_equivocation_slows_split_network():
         int(f_honest.round), int(f_eq.round))
 
 
+@pytest.mark.slow
 def test_equivocation_stalls_dag_liveness():
     """The canonical Avalanche liveness attack: per-target equivocation on
     double-spends feeds confidence to BOTH sides of each conflict set until
@@ -206,6 +211,7 @@ def test_sharded_minority_matches_unsharded():
     assert np.array_equal(got, want)
 
 
+@pytest.mark.slow
 def test_sharded_equivocation_coin_differs_across_tx_shards():
     """The equivocation coin must be independent per target — in particular
     not tiled identically across txs shards (every other fault draw IS

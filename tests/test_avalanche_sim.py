@@ -9,6 +9,7 @@ invalidation, adversaries (SURVEY.md sections 2.4, 4 item c).
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from go_avalanche_tpu.config import AvalancheConfig
 from go_avalanche_tpu.models import avalanche as av
@@ -65,6 +66,7 @@ def test_gossip_disabled_stays_seeded():
     assert int(np.asarray(final.added).sum()) == t  # nothing spread
 
 
+@pytest.mark.slow
 def test_poll_cap_limits_polls_and_prioritizes_score():
     cfg = AvalancheConfig(max_element_poll=4)
     n, t = 16, 12
@@ -94,6 +96,7 @@ def test_invalid_targets_never_polled_or_finalized():
     assert (conf[:, 2] == 0).all()
 
 
+@pytest.mark.slow
 def test_byzantine_fraction_slows_but_converges():
     cfg_honest = AvalancheConfig()
     cfg_byz = AvalancheConfig(byzantine_fraction=0.2)
@@ -107,6 +110,7 @@ def test_byzantine_fraction_slows_but_converges():
     assert int(byz_final.round) >= int(honest_final.round)
 
 
+@pytest.mark.slow
 def test_telemetry_votes_accounting():
     cfg = AvalancheConfig()
     n, t = 32, 4
@@ -118,6 +122,7 @@ def test_telemetry_votes_accounting():
     assert int(tel.admissions) == 0  # everyone already has everything
 
 
+@pytest.mark.slow
 def test_determinism():
     cfg = AvalancheConfig(byzantine_fraction=0.1, drop_probability=0.1)
     a = av.run(av.init(jax.random.key(9), 32, 6, cfg), cfg, max_rounds=400)
@@ -129,6 +134,7 @@ def test_determinism():
     assert int(a.round) == int(b.round)
 
 
+@pytest.mark.slow
 def test_scan_and_while_loop_agree_on_settled_state():
     cfg = AvalancheConfig()
     s = av.init(jax.random.key(6), 24, 3, cfg)

@@ -88,6 +88,7 @@ def test_admission_is_score_order():
     assert (np.diff(admit) >= 0).all()
 
 
+@pytest.mark.slow
 def test_streaming_matches_dense_outcome():
     """Same txs through a small window vs one dense sim: same outcomes."""
     from go_avalanche_tpu.models import avalanche as av
@@ -124,6 +125,7 @@ def test_run_scan_telemetry_conserves_txs():
     assert (np.asarray(tel.backlog_left) >= 0).all()
 
 
+@pytest.mark.slow
 def test_drained_predicate():
     cfg = AvalancheConfig()
     b = bl.make_backlog(jnp.arange(6, dtype=jnp.int32))
@@ -135,12 +137,14 @@ def test_drained_predicate():
 
 
 @pytest.mark.parametrize("byz", [0.0, 0.25])
+@pytest.mark.slow
 def test_byzantine_stream_still_drains(byz):
     cfg = AvalancheConfig(byzantine_fraction=byz)
     final = run_stream(n_nodes=32, n_txs=8, window=4, cfg=cfg)
     assert np.asarray(final.outputs.settled).all()
 
 
+@pytest.mark.slow
 def test_capped_run_harvest_does_not_admit():
     """A max_rounds-capped run must not admit txs it will never poll."""
     cfg = AvalancheConfig()

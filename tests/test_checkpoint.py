@@ -38,6 +38,7 @@ def test_roundtrip(tmp_path, make):
     assert_states_equal(state, restored)
 
 
+@pytest.mark.slow
 def test_resume_continues_identical_trajectory(tmp_path):
     # Run 5 rounds, checkpoint, run 5 more; restoring the checkpoint and
     # re-running the last 5 must give bit-identical state (determinism +
@@ -69,6 +70,7 @@ def test_shape_mismatch_rejected(tmp_path):
         restore_checkpoint(path, wrong)
 
 
+@pytest.mark.slow
 def test_sharded_state_checkpoint(tmp_path):
     from go_avalanche_tpu.parallel import sharded
     from go_avalanche_tpu.parallel.mesh import make_mesh
@@ -138,6 +140,7 @@ def test_orbax_roundtrip_sharded(tmp_path):
         state.records.confidence.sharding
 
 
+@pytest.mark.slow
 def test_streaming_dag_state_roundtrips(tmp_path):
     """The north-star model's full state (nested dataclass pytree with
     static aux + NamedTuples) survives checkpoint/resume and the resumed

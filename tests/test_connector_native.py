@@ -39,6 +39,7 @@ def test_cpp_harness_converges(harness_bin):
         assert "nodes_fully_finalized=6/6" in out.stdout
 
 
+@pytest.mark.slow
 def test_cpp_harness_drives_batched_sim(harness_bin):
     with ConnectorServer() as srv:
         host, port = srv.address

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from go_avalanche_tpu.config import AvalancheConfig
 from go_avalanche_tpu.models import dag
@@ -60,6 +61,7 @@ def test_double_spend_resolves_to_single_winner():
     assert fin_acc.sum(axis=1).max() == 4  # one winner per set per node
 
 
+@pytest.mark.slow
 def test_split_initial_preference_still_agrees():
     # Half the network initially prefers tx0, half tx1 — the adversarial
     # double-spend race.  The network must still converge on ONE winner.
@@ -91,6 +93,7 @@ def test_singleton_sets_behave_like_plain_avalanche():
     assert bool(vr.is_accepted(final.base.records.confidence).all())
 
 
+@pytest.mark.slow
 def test_losers_stop_being_polled():
     cfg = AvalancheConfig()
     conflict_set = jnp.array([0, 0, 0], jnp.int32)  # 3-way conflict
@@ -174,6 +177,7 @@ def test_init_detects_fixed_partition():
     assert st3.set_size is None
 
 
+@pytest.mark.slow
 def test_fixed_partition_run_matches_generic_run():
     # End-to-end: the same 2-tx-set network run with and without the
     # fast-path witness converges identically (same PRNG stream, same

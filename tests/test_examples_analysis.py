@@ -1,6 +1,7 @@
 """Analysis helpers shipped with the examples (fit + threshold sweep)."""
 
 import numpy as np
+import pytest
 
 
 def test_fit_log_n_recovers_planted_coefficients():
@@ -35,6 +36,7 @@ def test_equivocation_sweep_cell_runs_small():
     assert cell["q"] == 0.0
 
 
+@pytest.mark.slow
 def test_window_scaling_cells_run_small():
     from examples.window_scaling import cell_backlog, cell_streaming_dag
 
@@ -45,6 +47,7 @@ def test_window_scaling_cells_run_small():
     assert c2["one_winner_fraction"] == 1.0
 
 
+@pytest.mark.slow
 def test_equivocation_artifact_reproduces_cross_backend():
     """The recorded (TPU-measured) threshold artifact is PRNG-exact: any
     cell re-run on this backend must reproduce its resolved fraction

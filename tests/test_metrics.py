@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from go_avalanche_tpu.config import AvalancheConfig
 from go_avalanche_tpu.models import snowball
@@ -61,6 +62,7 @@ def test_safety_failure_detection():
                               np.array([True, False]))
 
 
+@pytest.mark.slow
 def test_family_curves_runners_smoke():
     import jax
 

@@ -4,6 +4,8 @@ from __future__ import annotations
 
 import json
 
+import pytest
+
 from go_avalanche_tpu.run_sim import main
 
 
@@ -17,6 +19,7 @@ def test_cli_snowball(capsys):
     assert json.loads(line)["model"] == "snowball"
 
 
+@pytest.mark.slow
 def test_cli_avalanche_with_faults(capsys):
     result = main(["--model", "avalanche", "--nodes", "48", "--txs", "12",
                    "--finalization-score", "16", "--byzantine", "0.1",
@@ -26,6 +29,7 @@ def test_cli_avalanche_with_faults(capsys):
     assert result["finality_median"] >= 1
 
 
+@pytest.mark.slow
 def test_cli_dag_resolves_conflicts(capsys):
     result = main(["--model", "dag", "--nodes", "32", "--txs", "16",
                    "--conflict-size", "4", "--finalization-score", "16",
@@ -51,6 +55,7 @@ def test_cli_trace_writes_profile(tmp_path, capsys):
     assert found
 
 
+@pytest.mark.slow
 def test_cli_backlog_streams_all_txs(capsys):
     result = main(["--model", "backlog", "--nodes", "24", "--txs", "20",
                    "--slots", "4", "--finalization-score", "16", "--json"])
@@ -67,6 +72,7 @@ def test_cli_exit_status_zero():
                 "--finalization-score", "16", "--json"]) == 0
 
 
+@pytest.mark.slow
 def test_cli_slush_and_snowflake(capsys):
     r1 = main(["--model", "slush", "--nodes", "128", "--max-rounds", "60",
                "--json"])
@@ -79,12 +85,14 @@ def test_cli_slush_and_snowflake(capsys):
     capsys.readouterr()
 
 
+@pytest.mark.slow
 def test_cli_mesh_avalanche(capsys):
     result = main(["--model", "avalanche", "--nodes", "32", "--txs", "16",
                    "--finalization-score", "16", "--mesh", "4,2", "--json"])
     assert result["finalized_fraction"] == 1.0
 
 
+@pytest.mark.slow
 def test_cli_mesh_dag(capsys):
     result = main(["--model", "dag", "--nodes", "32", "--txs", "16",
                    "--conflict-size", "2", "--finalization-score", "16",
@@ -92,6 +100,7 @@ def test_cli_mesh_dag(capsys):
     assert result["sets_resolved_fraction"] == 1.0
 
 
+@pytest.mark.slow
 def test_cli_mesh_backlog(capsys):
     result = main(["--model", "backlog", "--nodes", "16", "--txs", "64",
                    "--slots", "16", "--finalization-score", "16",
@@ -107,6 +116,7 @@ def test_cli_mesh_rejects_unsupported_model(capsys):
         main(["--model", "snowball", "--mesh", "4,2"])
 
 
+@pytest.mark.slow
 def test_cli_streaming_dag(capsys):
     result = main(["--model", "streaming_dag", "--nodes", "24", "--txs",
                    "32", "--conflict-size", "2", "--slots", "4",
@@ -116,6 +126,7 @@ def test_cli_streaming_dag(capsys):
     assert result["sets_one_winner_fraction"] == 1.0
 
 
+@pytest.mark.slow
 def test_cli_mesh_streaming_dag(capsys):
     result = main(["--model", "streaming_dag", "--nodes", "16", "--txs",
                    "24", "--conflict-size", "2", "--slots", "4",
@@ -132,6 +143,7 @@ def test_cli_streaming_dag_rejects_indivisible_txs():
               "--conflict-size", "2"])
 
 
+@pytest.mark.slow
 def test_cli_distinct_peers(capsys):
     result = main(["--model", "avalanche", "--nodes", "32", "--txs", "8",
                    "--finalization-score", "16", "--distinct-peers",
@@ -139,6 +151,7 @@ def test_cli_distinct_peers(capsys):
     assert result["finalized_fraction"] == 1.0
 
 
+@pytest.mark.slow
 def test_cli_contested_avalanche(capsys):
     result = main(["--model", "avalanche", "--nodes", "48", "--txs", "8",
                    "--finalization-score", "16", "--contested", "--json"])
@@ -149,6 +162,7 @@ def test_cli_contested_avalanche(capsys):
     assert result["rounds"] > unanimous["rounds"]
 
 
+@pytest.mark.slow
 def test_cli_clustered_topology(capsys):
     result = main(["--model", "avalanche", "--nodes", "48", "--txs", "8",
                    "--finalization-score", "16", "--clusters", "4",

@@ -74,6 +74,7 @@ def test_runtime_mesh_unequal_slices_rejected(monkeypatch):
         runtime.make_runtime_mesh()
 
 
+@pytest.mark.slow
 def test_sharded_step_runs_on_runtime_mesh(monkeypatch):
     """The sharded round step works unchanged on a multi-slice mesh."""
     from go_avalanche_tpu.config import AvalancheConfig

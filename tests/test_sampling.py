@@ -81,6 +81,7 @@ def test_weighted_network_converges():
     assert bool(vr.has_finalized(final.records.confidence).all())
 
 
+@pytest.mark.slow
 def test_weighted_network_sharded_converges():
     from go_avalanche_tpu.parallel import sharded
     from go_avalanche_tpu.parallel.mesh import make_mesh
@@ -153,6 +154,7 @@ def test_weighted_without_replacement_config_rejected():
                         sample_with_replacement=False)
 
 
+@pytest.mark.slow
 def test_distinct_network_converges_and_uniform_matches_stats():
     """End-to-end with k distinct peers: the honest network still finalizes
     everything, in a round count comparable to with-replacement sampling
@@ -169,6 +171,7 @@ def test_distinct_network_converges_and_uniform_matches_stats():
     assert rounds[False] <= rounds[True] + 5, rounds
 
 
+@pytest.mark.slow
 def test_distinct_sharded_converges():
     from go_avalanche_tpu.parallel import sharded
     from go_avalanche_tpu.parallel.mesh import make_mesh
@@ -250,6 +253,7 @@ def test_draw_peers_uniform_dispatch_matches_direct():
     np.testing.assert_array_equal(np.asarray(peers), np.asarray(direct))
 
 
+@pytest.mark.slow
 def test_clustered_network_converges():
     cfg = AvalancheConfig(n_clusters=4, cluster_locality=0.9)
     n, t = 64, 6
@@ -268,6 +272,7 @@ def test_clustered_sharded_converges():
     assert bool(vr.has_finalized(final.records.confidence).all())
 
 
+@pytest.mark.slow
 def test_clustered_locality_partition_splits_decisions():
     """The topology knob has real consensus consequences: with
     per-CLUSTER contested priors, extreme locality behaves like a network

@@ -28,6 +28,7 @@ def stream(mesh, n_nodes=16, n_txs=20, window=8, cfg=None, seed=0,
 
 
 @pytest.mark.parametrize("mesh_shape", [(8, 1), (4, 2), (1, 8)])
+@pytest.mark.slow
 def test_sharded_stream_settles_everything(mesh_shape):
     mesh = make_mesh(n_node_shards=mesh_shape[0], n_tx_shards=mesh_shape[1])
     final = stream(mesh)
@@ -38,6 +39,7 @@ def test_sharded_stream_settles_everything(mesh_shape):
     assert int(final.next_idx) == 20
 
 
+@pytest.mark.slow
 def test_sharded_outcomes_match_unsharded():
     n_txs = 12
     pref = jnp.arange(n_txs) % 2 == 0
@@ -68,6 +70,7 @@ def test_sharded_invalid_txs_drop():
     assert (np.asarray(out.accept_votes)[-4:] == 0).all()
 
 
+@pytest.mark.slow
 def test_sharded_step_telemetry():
     cfg = AvalancheConfig()
     mesh = make_mesh(n_node_shards=4, n_tx_shards=2)
@@ -81,6 +84,7 @@ def test_sharded_step_telemetry():
     assert int(tel.round.polls) == 8 * 4
 
 
+@pytest.mark.slow
 def test_sharded_scan_retired_counts():
     cfg = AvalancheConfig()
     mesh = make_mesh(n_node_shards=8, n_tx_shards=1)

@@ -92,6 +92,7 @@ def test_sharded_dag_runs_under_every_strategy(strat):
     assert int(new_state.base.round) == 1
 
 
+@pytest.mark.slow
 def test_sharded_dag_equivocation_stall_matches_unsharded():
     """The liveness-attack phenomenology must survive sharding: equivocate
     stalls, flip resolves (same contract as the unsharded
@@ -113,6 +114,7 @@ def test_sharded_dag_equivocation_stall_matches_unsharded():
     assert fin_frac[AdversaryStrategy.EQUIVOCATE] < 0.1, fin_frac
 
 
+@pytest.mark.slow
 def test_sharded_dag_nodes_only_mesh():
     """A 1-wide txs axis (pure node parallelism) must work unchanged."""
     cfg = AvalancheConfig()
@@ -124,6 +126,7 @@ def test_sharded_dag_nodes_only_mesh():
     assert fin.all()
 
 
+@pytest.mark.slow
 def test_sharded_dag_churn_toggles_membership_matches_flat():
     """churn_probability must act in the sharded DAG exactly as in the flat
     model (round-1 advisor: the knob was silently dropped).  At churn=1.0

@@ -39,6 +39,7 @@ def test_placement_validates_set_granularity():
         ssd.shard_streaming_dag_state(state, mesh)
 
 
+@pytest.mark.slow
 def test_sharded_streaming_resolves_every_set():
     cfg = AvalancheConfig()
     mesh = _mesh()
@@ -53,6 +54,7 @@ def test_sharded_streaming_resolves_every_set():
     assert not acc[:, 1:].any()
 
 
+@pytest.mark.slow
 def test_sharded_streaming_step_telemetry_and_window_bound():
     cfg = AvalancheConfig()
     mesh = _mesh()
@@ -65,6 +67,7 @@ def test_sharded_streaming_step_telemetry_and_window_bound():
     assert int(state.dag.base.round) == 30
 
 
+@pytest.mark.slow
 def test_sharded_streaming_matches_unsharded_outcomes():
     """Winner parity, sharded vs unsharded scheduler (PRNG streams differ;
     the deterministic honest outcome does not)."""
@@ -97,6 +100,7 @@ def test_sharded_streaming_under_byzantine_flip():
     assert summary["sets_one_winner_fraction"] > 0.9
 
 
+@pytest.mark.slow
 def test_sharded_streaming_nodes_only_mesh():
     cfg = AvalancheConfig()
     mesh = make_mesh(n_node_shards=8, n_tx_shards=1,
@@ -108,6 +112,33 @@ def test_sharded_streaming_nodes_only_mesh():
     assert summary["sets_one_winner_fraction"] == 1.0
 
 
+@pytest.mark.slow
+def test_sharded_streaming_non_toy_shape():
+    """The mesh path at a non-toy shape: 512 nodes x 512-set backlog
+    streaming through a 64-set window over the full 4x2 mesh — so the
+    sharded scheduler's first exercise at depth isn't the 100k x 1M
+    hardware run (VERDICT r3 item 7).  Covers thousands of retire/refill
+    cycles crossing tx-shard boundaries; the honest-network contract
+    (every set settles, exactly one winner, winner = initially preferred
+    lane) must hold for the whole backlog."""
+    cfg = AvalancheConfig()
+    mesh = _mesh()
+    n_sets, c, w_sets = 512, 2, 64
+    backlog = sd.make_set_backlog(
+        jnp.full((n_sets, c), 5, jnp.int32))
+    state = ssd.shard_streaming_dag_state(
+        _state(n_nodes=512, n_sets=n_sets, c=c, window_sets=w_sets,
+               backlog=backlog, cfg=cfg), mesh)
+    final = ssd.run_sharded_streaming_dag(mesh, state, cfg, max_rounds=20000)
+    summary = sd.resolution_summary(jax.device_get(final))
+    assert summary["sets_settled_fraction"] == 1.0
+    assert summary["sets_one_winner_fraction"] == 1.0
+    acc = np.asarray(jax.device_get(final.outputs.accepted))
+    np.testing.assert_array_equal(acc[:, 0], np.ones(n_sets, bool))
+    assert not acc[:, 1:].any()
+
+
+@pytest.mark.slow
 def test_sharded_streaming_determinism():
     cfg = AvalancheConfig(byzantine_fraction=0.25)
     mesh = _mesh()

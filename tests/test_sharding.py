@@ -29,6 +29,7 @@ def test_pack_unpack_roundtrip():
                                       np.asarray(x))
 
 
+@pytest.mark.slow
 def test_sharded_network_converges(mesh):
     cfg = AvalancheConfig()
     state = sharded.shard_state(av.init(jax.random.key(0), 32, 16, cfg), mesh)
@@ -51,6 +52,7 @@ def test_sharded_first_round_telemetry(mesh):
     assert int(tel.admissions) == 0
 
 
+@pytest.mark.slow
 def test_sharded_gossip_crosses_shards(mesh):
     # Seed only global node 0 (living on the first shard); gossip must
     # propagate across node shards via the psum_scatter path.
@@ -70,6 +72,7 @@ def test_sharded_gossip_crosses_shards(mesh):
     assert fin[added_final].all()
 
 
+@pytest.mark.slow
 def test_sharded_determinism(mesh):
     cfg = AvalancheConfig(byzantine_fraction=0.1, drop_probability=0.05)
     make = lambda: sharded.shard_state(
@@ -81,6 +84,7 @@ def test_sharded_determinism(mesh):
     assert int(a.round) == int(b.round)
 
 
+@pytest.mark.slow
 def test_sharded_scan_matches_while_loop_settled_state():
     mesh = make_mesh(n_node_shards=4, n_tx_shards=2)
     cfg = AvalancheConfig()

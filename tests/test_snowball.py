@@ -58,6 +58,7 @@ def test_finalized_at_is_recorded():
     assert (fat < int(final.round)).all()
 
 
+@pytest.mark.slow
 def test_neutral_drops_slow_convergence():
     cfg_fast = AvalancheConfig()
     cfg_slow = AvalancheConfig(drop_probability=0.3)

@@ -85,6 +85,7 @@ def test_window_bound_holds_throughout():
     assert bool(sd.drained(state, cfg))
 
 
+@pytest.mark.slow
 def test_streaming_dag_matches_dense():
     """Outcome parity: with the window sized to hold the WHOLE backlog and
     an identical PRNG key, streaming reduces to the dense DAG — the same
@@ -169,6 +170,42 @@ def test_streaming_dag_under_byzantine_flip():
     summary = sd.resolution_summary(final)
     assert summary["sets_settled_fraction"] == 1.0
     assert summary["sets_one_winner_fraction"] > 0.9
+
+
+@pytest.mark.slow
+def test_run_chunked_matches_run():
+    """Host-chunked execution is bit-identical to the single-dispatch
+    while_loop — same round counter, records, and outputs — for a chunk
+    size that does NOT divide the total round count."""
+    n, n_sets, c, w_sets = 16, 10, 2, 3
+    cfg = AvalancheConfig()
+    backlog = make_backlog(n_sets, c)
+    state = sd.init(jax.random.key(7), n, w_sets, backlog, cfg)
+
+    ref = jax.device_get(jax.jit(
+        sd.run, static_argnames=("cfg", "max_rounds"))(state, cfg, 5000))
+    chunked = jax.device_get(
+        sd.run_chunked(state, cfg, max_rounds=5000, chunk=17))
+
+    assert int(ref.dag.base.round) == int(chunked.dag.base.round)
+    for a, b in zip(jax.tree_util.tree_leaves(ref),
+                    jax.tree_util.tree_leaves(chunked)):
+        if jnp.issubdtype(jnp.asarray(a).dtype, jax.dtypes.prng_key):
+            a, b = jax.random.key_data(a), jax.random.key_data(b)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow
+def test_run_chunked_checkpoints(tmp_path):
+    ckpt = str(tmp_path / "stream.npz")
+    cfg = AvalancheConfig()
+    state = sd.init(jax.random.key(0), 12, 2, make_backlog(6, 2), cfg)
+    final = sd.run_chunked(state, cfg, max_rounds=5000, chunk=5,
+                           checkpoint_path=ckpt, checkpoint_every_chunks=1)
+    assert np.asarray(final.outputs.settled).all()
+    from go_avalanche_tpu.utils.checkpoint import restore_checkpoint
+    restored = restore_checkpoint(ckpt, state)
+    assert int(jax.device_get(restored.dag.base.round)) > 0
 
 
 def test_run_scan_telemetry_shapes():

@@ -20,6 +20,7 @@ def _state(seed: int = 0):
     return av.init(jax.random.key(seed), 16, 8, CFG)
 
 
+@pytest.mark.slow
 def test_profiler_trace_writes_artifacts(tmp_path):
     log_dir = str(tmp_path / "trace")
     with tracing.trace(log_dir):
@@ -42,6 +43,7 @@ def test_annotate_works_inside_jit():
     assert int(fn(jnp.int32(3))) == 7
 
 
+@pytest.mark.slow
 def test_telemetry_recorder_accumulates_and_derives_rates():
     rec = tracing.TelemetryRecorder()
     state = _state()
