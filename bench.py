@@ -171,7 +171,7 @@ def _run_attempt(argv: list[str], timeout_s: float) -> tuple[dict | None, str]:
     return None, f"rc={proc.returncode}: " + " | ".join(tail[-3:])
 
 
-def _attach_prev_delta(parsed: dict) -> dict:
+def _attach_prev_delta(parsed: dict, search_dir: str | None = None) -> dict:
     """Annotate the result with the previous round's recorded number.
 
     The driver archives each round's line in `BENCH_r{N}.json`; comparing
@@ -183,9 +183,10 @@ def _attach_prev_delta(parsed: dict) -> dict:
     import glob
     import re
     try:
+        if search_dir is None:
+            search_dir = os.path.dirname(os.path.abspath(__file__))
         rounds = []
-        for path in glob.glob(os.path.join(
-                os.path.dirname(os.path.abspath(__file__)), "BENCH_r*.json")):
+        for path in glob.glob(os.path.join(search_dir, "BENCH_r*.json")):
             m = re.search(r"BENCH_r(\d+)\.json$", path)
             if m:  # numeric sort: r100 must not sort before r99
                 rounds.append((int(m.group(1)), path))

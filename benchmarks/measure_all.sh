@@ -11,6 +11,7 @@
 #   examples/out/window_scaling.json         (scheduler scaling grid)
 #   examples/out/equivocation_threshold.json (liveness threshold sweep)
 #   bench JSON line on stdout                (throughput north star)
+#   benchmarks/streaming_votes.json          (votes/sec, streaming path)
 set -euo pipefail
 
 QUICK="${1:-}"
@@ -44,6 +45,14 @@ fi
 
 echo "== bench =="
 python bench.py
+
+echo "== streaming bench (votes/sec through the north-star path) =="
+if [ "$QUICK" = "quick" ]; then
+  python benchmarks/bench_streaming.py --nodes 256 --window-sets 64 \
+      --backlog-sets 4096 --rounds 16
+else
+  python benchmarks/bench_streaming.py --out benchmarks/streaming_votes.json
+fi
 
 if [ "$QUICK" = "quick" ]; then
   echo "quick mode: skipping RESULTS.md re-render (nothing fresh to fold in)"

@@ -1,0 +1,157 @@
+"""One-command hardware evidence capture: TPU correctness, on the record.
+
+The bench artifacts prove TPU *speed*; this proves TPU *correctness* each
+round (VERDICT r3 weak item 5) by running the hardware-only test lanes and
+writing a single committed artifact:
+
+  0. a device probe that must see a real TPU platform — without it the
+     whole capture is recorded as not-hardware and `all_pass` stays false
+     (a CPU box must never be able to mint TPU evidence);
+  1. `tests/test_cross_backend_parity.py` under `GO_AVALANCHE_TPU_TESTS=1`
+     (CPU and TPU runs bit-identical through 40 faulted rounds) — a lane
+     that SKIPS (single backend visible) is recorded as "skipped", which
+     is not a pass;
+  2. `tests/test_pallas.py` with the accelerator visible — the Pallas
+     kernel COMPILED by Mosaic (`ops/pallas_vote.py` picks compiled mode
+     when the default backend is TPU; the probe lane above is what
+     guarantees that's the mode being tested);
+  3. a small streaming conflict-DAG run pinned to the chip, asserting its
+     invariants (every set settles, one winner, settle-latency median
+     ~17); its measured summary + device identity are embedded in the
+     artifact.
+
+Each lane runs in its own subprocess with a timeout, so a wedged tunnel
+records `"timeout"` (with the partial output tail) instead of hanging the
+capture.  Output: `benchmarks/tpu_evidence.json` (committed) and full
+lane tails in `benchmarks/tpu_evidence_logs/` (gitignored scratch).
+
+    python benchmarks/tpu_evidence.py [--timeout 600]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+LOGS = REPO / "benchmarks" / "tpu_evidence_logs"
+
+_PROBE = r"""
+import json
+import jax
+d = jax.devices()[0]
+print(json.dumps({"platform": d.platform, "device": str(d),
+                  "device_kind": getattr(d, "device_kind", "?"),
+                  "backend": jax.default_backend()}))
+assert d.platform == "tpu", f"not a TPU: {d.platform}"
+"""
+
+_STREAM_CHECK = r"""
+import sys; sys.path.insert(0, "@ROOT@")
+import json
+import jax
+from benchmarks.workload import northstar_state
+from go_avalanche_tpu.models import streaming_dag as sdg
+
+dev = jax.devices()[0]
+assert dev.platform == "tpu", f"not a TPU: {dev.platform}"
+state, cfg = northstar_state(nodes=256, backlog_sets=2048, set_cap=2,
+                             window_sets=64)
+final = sdg.run_chunked(state, cfg, max_rounds=20000, chunk=128)
+summary = sdg.resolution_summary(jax.device_get(final))
+assert summary["sets_settled_fraction"] == 1.0, summary
+assert summary["sets_one_winner_fraction"] == 1.0, summary
+assert 15 <= summary["settle_latency_median"] <= 20, summary
+print(json.dumps({"platform": dev.platform, "device": str(dev),
+                  **summary}))
+"""
+
+
+def _last_json_line(text: str) -> dict | None:
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                return None
+    return None
+
+
+def _run(name: str, argv: list, env: dict, timeout: float,
+         pytest_lane: bool = False) -> dict:
+    LOGS.mkdir(parents=True, exist_ok=True)
+    t0 = time.time()
+    try:
+        proc = subprocess.run(argv, capture_output=True, text=True,
+                              timeout=timeout, env=env, cwd=str(REPO))
+        out = (proc.stdout or "") + (proc.stderr or "")
+        if proc.returncode != 0:
+            status = "fail"
+        elif pytest_lane and "skipped" in out:
+            # A skipped hardware test (e.g. parity with one backend
+            # visible) exits 0 but proves nothing.
+            status = "skipped"
+        else:
+            status = "pass"
+    except subprocess.TimeoutExpired as exc:
+        # Keep the partial output: it shows WHICH test/phase wedged.
+        status = "timeout"
+        out = ""
+        for stream in (exc.stdout, exc.stderr):
+            if isinstance(stream, bytes):
+                stream = stream.decode(errors="replace")
+            out += stream or ""
+        out += f"\n[no result within {timeout:.0f}s]"
+    (LOGS / f"{name}.txt").write_text(out + "\n")
+    result = {"lane": name, "status": status,
+              "wall_s": round(time.time() - t0, 1)}
+    detail = _last_json_line(out)
+    if detail is not None:
+        result["detail"] = detail
+    return result
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--timeout", type=float, default=600.0)
+    args = parser.parse_args()
+
+    base = {k: v for k, v in os.environ.items()
+            if k not in ("XLA_FLAGS",)}  # no virtual-device flag: real chip
+    hw = dict(base, GO_AVALANCHE_TPU_TESTS="1")
+
+    probe = _run("device_probe", [sys.executable, "-c", _PROBE], base,
+                 min(args.timeout, 300.0))
+    lanes = [probe]
+    if probe["status"] == "pass":
+        lanes += [
+            _run("cross_backend_parity",
+                 [sys.executable, "-m", "pytest",
+                  "tests/test_cross_backend_parity.py", "-v",
+                  "--no-header"], hw, args.timeout, pytest_lane=True),
+            _run("pallas_compiled",
+                 [sys.executable, "-m", "pytest", "tests/test_pallas.py",
+                  "-v", "--no-header"], hw, args.timeout,
+                 pytest_lane=True),
+            _run("streaming_on_chip",
+                 [sys.executable, "-c",
+                  _STREAM_CHECK.replace("@ROOT@", str(REPO))],
+                 base, args.timeout),
+        ]
+    out = {"captured_unix_s": int(time.time()), "lanes": lanes,
+           "all_pass": (probe["status"] == "pass"
+                        and all(r["status"] == "pass" for r in lanes))}
+    (REPO / "benchmarks" / "tpu_evidence.json").write_text(
+        json.dumps(out, indent=1) + "\n")
+    print(json.dumps(out))
+    sys.exit(0 if out["all_pass"] else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -51,9 +51,12 @@ class DagSimState:
     ``arange(T) // c`` (detected in `init`; true by construction for the
     streaming window, `models/streaming_dag`), set reductions collapse to
     ``[N, S, c]`` reshapes — no ``[T, N]`` transposes, no segment ops, no
-    index planes — which is what keeps the DAG round inside HBM at
-    100k-node x 1M-tx scale.  ``None`` means "arbitrary partition": the
-    general segment path.
+    index planes — which is what fits the DAG round in HBM at the
+    north-star window shape (verified on a v5e chip: the 100k-node x
+    2048-tx-window round executes and sustains thousands of rounds; the
+    round-3 "worker crashed" failure was dispatch length through the
+    tunnel, not memory — see `streaming_dag.run_chunked`).  ``None``
+    means "arbitrary partition": the general segment path.
     """
 
     base: av.AvalancheSimState

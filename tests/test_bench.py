@@ -1,0 +1,57 @@
+"""bench.py contract tests: JSON-line parsing and round-over-round delta.
+
+The measurement itself needs hardware (`BENCH_r{N}.json` captures it); what
+is testable everywhere is the machinery the driver relies on: the
+last-line-JSON contract with nonce verification, and the prev-round delta
+annotation that makes bench regressions visible in the artifact itself.
+"""
+
+import json
+
+import bench
+
+
+def _write(tmp_path, name, metric, value):
+    (tmp_path / name).write_text(json.dumps(
+        {"parsed": {"metric": metric, "value": value}}))
+
+
+def test_delta_against_latest_round_numeric_sort(tmp_path):
+    # r100 must beat r99 (lexicographic sort would pick r99 forever).
+    m = "sustained vote ingest (x)"
+    _write(tmp_path, "BENCH_r99.json", m, 50.0)
+    _write(tmp_path, "BENCH_r100.json", m, 100.0)
+    out = bench._attach_prev_delta({"metric": m, "value": 110.0},
+                                   search_dir=str(tmp_path))
+    assert out["prev_round"] == 100
+    assert out["prev_value"] == 100.0
+    assert out["delta_vs_prev_pct"] == 10.0
+
+
+def test_delta_skipped_on_metric_mismatch(tmp_path):
+    _write(tmp_path, "BENCH_r03.json", "old shape", 50.0)
+    out = bench._attach_prev_delta({"metric": "new shape", "value": 60.0},
+                                   search_dir=str(tmp_path))
+    assert "delta_vs_prev_pct" not in out
+    assert "prev_round" not in out
+
+
+def test_delta_no_previous_rounds(tmp_path):
+    out = bench._attach_prev_delta({"metric": "m", "value": 1.0},
+                                   search_dir=str(tmp_path))
+    assert out == {"metric": "m", "value": 1.0}
+
+
+def test_delta_never_raises_on_corrupt_artifact(tmp_path):
+    (tmp_path / "BENCH_r07.json").write_text("{not json")
+    out = bench._attach_prev_delta({"metric": "m", "value": 1.0},
+                                   search_dir=str(tmp_path))
+    assert out["value"] == 1.0  # best-effort: annotation silently skipped
+
+
+def test_parse_result_contract():
+    good = json.dumps({"metric": "m", "value": 2.0, "nonce": "abc"})
+    assert bench._parse_result(f"noise\n{good}\n", "abc") == {
+        "metric": "m", "value": 2.0}
+    assert bench._parse_result(f"{good}\n", "wrong-nonce") is None
+    assert bench._parse_result("not json\n") is None

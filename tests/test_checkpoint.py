@@ -114,6 +114,7 @@ def test_orbax_roundtrip(tmp_path, make):
     assert_states_equal(state, restored)
 
 
+@pytest.mark.slow
 def test_orbax_roundtrip_sharded(tmp_path):
     """Mesh-placed state round-trips with shardings preserved."""
     pytest.importorskip("orbax.checkpoint")

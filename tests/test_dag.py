@@ -130,6 +130,7 @@ def test_dag_weighted_sampling_and_churn_converge():
     assert (winners == 1).mean() > 0.95
 
 
+@pytest.mark.slow
 def test_dag_churn_toggles_membership():
     cfg = AvalancheConfig(churn_probability=0.5)
     cs = jnp.arange(4, dtype=jnp.int32) // 2

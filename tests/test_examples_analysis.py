@@ -26,6 +26,7 @@ def test_fit_log_n_flags_linear_growth():
     assert fit["r2_linear_in_n"] > fit["r2_log"]
 
 
+@pytest.mark.slow
 def test_equivocation_sweep_cell_runs_small():
     from examples.equivocation_threshold import sweep_cell
     from go_avalanche_tpu.config import AdversaryStrategy

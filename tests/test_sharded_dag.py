@@ -83,6 +83,7 @@ def test_sharded_dag_determinism():
 
 
 @pytest.mark.parametrize("strat", list(AdversaryStrategy))
+@pytest.mark.slow
 def test_sharded_dag_runs_under_every_strategy(strat):
     cfg = AvalancheConfig(byzantine_fraction=0.25, flip_probability=1.0,
                           adversary_strategy=strat)
@@ -143,6 +144,7 @@ def test_sharded_dag_churn_toggles_membership_matches_flat():
                           np.asarray(flat_new.base.alive))
 
 
+@pytest.mark.slow
 def test_sharded_dag_weighted_sampling_matches_flat_deterministic_limit():
     """weighted_sampling must act in the sharded DAG (round-1 advisor: the
     knob was silently dropped).  With ALL latency weight on node 0 every

@@ -97,6 +97,7 @@ def test_sharded_scan_matches_while_loop_settled_state():
     assert int(np.asarray(tel.finalizations).sum()) == 16 * 8
 
 
+@pytest.mark.slow
 def test_output_shardings_preserved():
     mesh = make_mesh(n_node_shards=4, n_tx_shards=2)
     cfg = AvalancheConfig()
