@@ -87,6 +87,16 @@ def _parse_mesh(mesh_arg: str):
     return make_mesh(n_node_shards=n_shards, n_tx_shards=t_shards)
 
 
+def _maybe_restore(path, state):
+    """Resume `--chunk` runs: restore from `--checkpoint` if it exists."""
+    import os
+
+    if path and os.path.exists(path):
+        from go_avalanche_tpu.utils.checkpoint import restore_checkpoint
+        return restore_checkpoint(path, state)
+    return state
+
+
 def run_avalanche(args, cfg: AvalancheConfig) -> Dict:
     from go_avalanche_tpu.models import avalanche as av
     from go_avalanche_tpu.ops import voterecord as vr
@@ -209,6 +219,14 @@ def run_streaming_dag(args, cfg: AvalancheConfig) -> Dict:
         state = ssd.shard_streaming_dag_state(state, mesh)
         final = ssd.run_sharded_streaming_dag(mesh, state, cfg,
                                               max_rounds=args.max_rounds)
+    elif args.chunk:
+        # Host-chunked dispatch (bit-identical to the single dispatch):
+        # long runs survive runtime dispatch watchdogs, and --checkpoint
+        # resumes a killed run from the last saved chunk boundary.
+        state = _maybe_restore(args.checkpoint, state)
+        final = sdg.run_chunked(state, cfg, max_rounds=args.max_rounds,
+                                chunk=args.chunk,
+                                checkpoint_path=args.checkpoint)
     else:
         final = jax.jit(sdg.run, static_argnames=("cfg", "max_rounds"))(
             state, cfg, args.max_rounds)
@@ -315,6 +333,16 @@ def main(argv=None) -> Dict:
                         help="run the sharded backend over an "
                              "(n node shards, t tx shards) device mesh "
                              "(models: avalanche, dag, backlog)")
+    parser.add_argument("--chunk", type=int, default=0, metavar="ROUNDS",
+                        help="streaming_dag: dispatch the run in host-driven "
+                             "chunks of this many rounds (0 = one device "
+                             "dispatch). Bit-identical results; long runs "
+                             "survive runtime dispatch watchdogs")
+    parser.add_argument("--checkpoint", type=str, default=None,
+                        metavar="PATH",
+                        help="streaming_dag with --chunk: save state here "
+                             "at chunk boundaries and resume from it if it "
+                             "exists")
     # output / tooling
     parser.add_argument("--json", action="store_true",
                         help="emit one JSON line instead of key=value text")
@@ -326,6 +354,15 @@ def main(argv=None) -> Dict:
                                         "streaming_dag"):
         parser.error(f"--mesh supports models avalanche/dag/backlog/"
                      f"streaming_dag, not {args.model}")
+    if args.chunk and args.model != "streaming_dag":
+        parser.error("--chunk is a streaming_dag option")
+    if args.chunk < 0:
+        parser.error("--chunk must be positive")
+    if args.chunk and args.mesh:
+        parser.error("--chunk and --mesh are mutually exclusive (the "
+                     "sharded backend has its own dispatch loop)")
+    if args.checkpoint and not args.chunk:
+        parser.error("--checkpoint requires --chunk")
     cfg = build_config(args)
     runner = {"slush": run_slush, "snowflake": run_snowflake,
               "snowball": run_snowball, "avalanche": run_avalanche,

@@ -144,6 +144,34 @@ def test_cli_streaming_dag_rejects_indivisible_txs():
 
 
 @pytest.mark.slow
+def test_cli_streaming_dag_chunked_matches_single_dispatch(capsys, tmp_path):
+    """`--chunk` (with checkpointing) produces the same resolution as the
+    single-dispatch run, and the checkpoint file appears."""
+    args = ["--model", "streaming_dag", "--nodes", "24", "--txs", "32",
+            "--conflict-size", "2", "--slots", "4",
+            "--finalization-score", "16", "--json"]
+    ref = main(args)
+    ckpt = str(tmp_path / "cli_stream.npz")
+    # chunk=1 so the run spans enough chunks to cross run_chunked's
+    # every-8-chunks checkpoint cadence.
+    chunked = main(args + ["--chunk", "1", "--checkpoint", ckpt])
+    ref.pop("elapsed_s"), chunked.pop("elapsed_s")   # wall-clock differs
+    assert chunked == ref
+    assert (tmp_path / "cli_stream.npz").exists()
+
+
+def test_cli_chunk_flag_validation():
+    import pytest
+
+    with pytest.raises(SystemExit):   # --chunk is streaming_dag-only
+        main(["--model", "avalanche", "--chunk", "8"])
+    with pytest.raises(SystemExit):   # --checkpoint requires --chunk
+        main(["--model", "streaming_dag", "--checkpoint", "/tmp/x.npz"])
+    with pytest.raises(SystemExit):   # negative chunk must error, not hang
+        main(["--model", "streaming_dag", "--chunk", "-5"])
+
+
+@pytest.mark.slow
 def test_cli_distinct_peers(capsys):
     result = main(["--model", "avalanche", "--nodes", "32", "--txs", "8",
                    "--finalization-score", "16", "--distinct-peers",
