@@ -44,7 +44,8 @@ def bench(n_nodes: int, window_sets: int, set_cap: int, backlog_sets: int,
     from go_avalanche_tpu.models import streaming_dag as sdg
 
     state, cfg = northstar_state(nodes=n_nodes, backlog_sets=backlog_sets,
-                                 set_cap=set_cap, window_sets=window_sets)
+                                 set_cap=set_cap, window_sets=window_sets,
+                                 track_finality=False)
 
     @jax.jit
     def run(s):

@@ -59,7 +59,15 @@ class AvalancheSimState(NamedTuple):
     byzantine: jax.Array         # bool [N]
     alive: jax.Array             # bool [N]
     latency_weight: jax.Array    # float32 [N] — peer sampling propensity
-    finalized_at: jax.Array      # int32 [N, T]; -1 until finalized
+    finalized_at: Optional[jax.Array]  # int32 [N, T]; -1 until finalized.
+                                 # None = tracking off (init
+                                 # track_finality=False): the plane is pure
+                                 # telemetry for per-(node,tx) latency
+                                 # stats, and maintaining it costs an int32
+                                 # [N, T] read+write every round — callers
+                                 # that record latency elsewhere (the
+                                 # streaming scheduler's per-set
+                                 # `SetOutputs`) can drop it
     round: jax.Array             # int32 scalar
     key: jax.Array               # PRNG key
 
@@ -85,6 +93,25 @@ def contested_init_pref(seed: int, n_nodes: int, n_txs: int) -> jax.Array:
     """
     return jax.random.bernoulli(jax.random.key(seed + 1), 0.5,
                                 (n_nodes, n_txs))
+
+
+def stamp_finality(finalized_at, newly_final, round_):
+    """Record first-finalization rounds; None (tracking off) passes through.
+
+    The shared telemetry stamp for every round implementation (dense and
+    sharded) — semantics changes belong here, not per-model.
+    """
+    if finalized_at is None:   # static: tracking disabled at init
+        return None
+    return jnp.where(newly_final & (finalized_at < 0), round_, finalized_at)
+
+
+def reset_finality(finalized_at, take_cols):
+    """Clear stamps for window columns being re-admitted (streaming
+    schedulers); None (tracking off) passes through."""
+    if finalized_at is None:
+        return None
+    return jnp.where(take_cols[None, :], -1, finalized_at)
 
 
 def score_ranks(scores: jax.Array) -> jax.Array:
@@ -115,6 +142,8 @@ def init(
     added: Optional[jax.Array] = None,       # bool [N, T]; default all
     valid: Optional[jax.Array] = None,       # bool [T]; default all
     latency_weights: Optional[jax.Array] = None,  # f32 [N]; default uniform
+    track_finality: bool = True,             # False: skip the finalized_at
+                                             #   plane (see AvalancheSimState)
 ) -> AvalancheSimState:
     """Fresh network.
 
@@ -148,7 +177,8 @@ def init(
         byzantine=jnp.arange(n_nodes) < n_byz,
         alive=jnp.ones((n_nodes,), jnp.bool_),
         latency_weight=jnp.asarray(latency_weights, jnp.float32),
-        finalized_at=jnp.full((n_nodes, n_txs), -1, jnp.int32),
+        finalized_at=(jnp.full((n_nodes, n_txs), -1, jnp.int32)
+                      if track_finality else None),
         round=jnp.int32(0),
         key=key,
     )
@@ -262,8 +292,8 @@ def round_step(
     # --- lifecycle + telemetry.
     fin_after = vr.has_finalized(records.confidence, cfg)
     newly_final = fin_after & jnp.logical_not(fin)
-    finalized_at = jnp.where(newly_final & (state.finalized_at < 0),
-                             state.round, state.finalized_at)
+    finalized_at = stamp_finality(state.finalized_at, newly_final,
+                                  state.round)
 
     alive = state.alive
     if cfg.churn_probability > 0.0:

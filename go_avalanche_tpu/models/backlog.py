@@ -98,12 +98,19 @@ def init(
     window: int,
     backlog: Backlog,
     cfg: AvalancheConfig = DEFAULT_CONFIG,
+    track_finality: bool = True,
 ) -> BacklogSimState:
-    """Empty window over a fresh backlog; first `refill` happens in step 0."""
+    """Empty window over a fresh backlog; first `refill` happens in step 0.
+
+    `track_finality=False` drops the per-(node, tx) finalized_at plane
+    (`models/avalanche.AvalancheSimState`) — latency here is recorded per
+    tx in `BacklogOutputs`, so the plane is pure overhead.
+    """
     b = backlog.score.shape[0]
     sim = av.init(key, n_nodes, window, cfg,
                   added=jnp.zeros((n_nodes, window), jnp.bool_),
-                  valid=jnp.zeros((window,), jnp.bool_))
+                  valid=jnp.zeros((window,), jnp.bool_),
+                  track_finality=track_finality)
     return BacklogSimState(
         sim=sim,
         slot_tx=jnp.full((window,), NO_TX, jnp.int32),
@@ -213,7 +220,7 @@ def _retire_and_refill(
     score = jnp.where(occupied_after,
                       state.backlog.score[jnp.clip(new_tx, 0, b - 1)],
                       jnp.int32(-2**31 + 1))
-    finalized_at = jnp.where(take[None, :], -1, sim.finalized_at)
+    finalized_at = av.reset_finality(sim.finalized_at, take)
 
     new_sim = sim._replace(
         records=records,

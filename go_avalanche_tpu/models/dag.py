@@ -79,6 +79,7 @@ def init(
     cfg: AvalancheConfig = DEFAULT_CONFIG,
     init_pref: Optional[jax.Array] = None,
     scores: Optional[jax.Array] = None,
+    track_finality: bool = True,
 ) -> DagSimState:
     """Fresh conflicted network.
 
@@ -103,7 +104,7 @@ def init(
                                                dtype=jnp.int32))
         init_pref = jnp.zeros((n_txs,), jnp.bool_).at[first_of_set].set(True)
     base = av.init(key, n_nodes, n_txs, cfg, init_pref=init_pref,
-                   scores=scores)
+                   scores=scores, track_finality=track_finality)
     return DagSimState(base=base, conflict_set=conflict_set, n_sets=n_sets,
                        set_size=set_size)
 
@@ -233,8 +234,8 @@ def round_step(
 
     fin_after = vr.has_finalized(records.confidence, cfg)
     newly_final = fin_after & jnp.logical_not(fin)
-    finalized_at = jnp.where(newly_final & (base.finalized_at < 0),
-                             base.round, base.finalized_at)
+    finalized_at = av.stamp_finality(base.finalized_at, newly_final,
+                                     base.round)
 
     alive = base.alive
     if cfg.churn_probability > 0.0:

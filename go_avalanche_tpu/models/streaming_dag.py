@@ -127,13 +127,21 @@ def init(
     window_sets: int,
     backlog: SetBacklog,
     cfg: AvalancheConfig = DEFAULT_CONFIG,
+    track_finality: bool = True,
 ) -> StreamingDagState:
-    """Empty window over a fresh set backlog; first refill is in step 0."""
+    """Empty window over a fresh set backlog; first refill is in step 0.
+
+    `track_finality=False` drops the per-(node, tx) `finalized_at` plane
+    (`models/avalanche.AvalancheSimState`): streaming latency metrics come
+    from the per-set `SetOutputs` rounds, so the plane is pure overhead
+    here — an int32 [N, W] read+write per round at north-star shape.
+    """
     s_b, c = backlog.score.shape
     w = window_sets * c
     base = av.init(key, n_nodes, w, cfg,
                    added=jnp.zeros((n_nodes, w), jnp.bool_),
-                   valid=jnp.zeros((w,), jnp.bool_))
+                   valid=jnp.zeros((w,), jnp.bool_),
+                   track_finality=track_finality)
     window_dag = dag_model.DagSimState(
         base=base,
         conflict_set=jnp.arange(w, dtype=jnp.int32) // c,
@@ -261,7 +269,7 @@ def _retire_and_refill(
     score = jnp.where(occupied_after_w,
                       state.backlog.score[safe_rows].reshape(w),
                       jnp.int32(-2**31 + 1))
-    finalized_at = jnp.where(take_w[None, :], -1, base.finalized_at)
+    finalized_at = av.reset_finality(base.finalized_at, take_w)
 
     new_base = base._replace(
         records=records,

@@ -50,6 +50,7 @@ from go_avalanche_tpu.models.avalanche import (
     SimTelemetry,
     capped_poll_mask,
     popcnt_plane,
+    stamp_finality,
 )
 from go_avalanche_tpu.ops import adversary, voterecord as vr
 from go_avalanche_tpu.ops.bitops import pack_bool_plane, unpack_bool_plane
@@ -57,8 +58,15 @@ from go_avalanche_tpu.ops.sampling import draw_peers
 from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS
 
 
-def state_specs() -> AvalancheSimState:
-    """PartitionSpecs for every leaf of `AvalancheSimState`."""
+def state_specs(track_finality: bool = True) -> AvalancheSimState:
+    """PartitionSpecs for every leaf of `AvalancheSimState`.
+
+    `track_finality=False` mirrors a state whose `finalized_at` leaf is
+    None (see `models/avalanche.init`): the spec tree must carry None in
+    the same slot or tree-structure checks fail.
+    """
+    if not track_finality:
+        return state_specs()._replace(finalized_at=None)
     return AvalancheSimState(
         records=vr.VoteRecordState(
             votes=P(NODES_AXIS, TXS_AXIS),
@@ -81,7 +89,7 @@ def shard_state(state: AvalancheSimState, mesh) -> AvalancheSimState:
     """Place a host-built state onto the mesh with the canonical shardings."""
     return jax.tree.map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
-        state, state_specs())
+        state, state_specs(state.finalized_at is not None))
 
 
 def _global_minority_plane(prefs_local: jax.Array,
@@ -287,8 +295,8 @@ def _local_round(
     # --- lifecycle.
     fin_after = vr.has_finalized(records.confidence, cfg)
     newly_final = fin_after & jnp.logical_not(fin)
-    finalized_at = jnp.where(newly_final & (state.finalized_at < 0),
-                             state.round, state.finalized_at)
+    finalized_at = stamp_finality(state.finalized_at, newly_final,
+                                  state.round)
 
     alive = state.alive
     if cfg.churn_probability > 0.0:
@@ -324,8 +332,8 @@ def _local_round(
     return new_state, telemetry
 
 
-def _shard_mapped(mesh, fn):
-    specs = state_specs()
+def _shard_mapped(mesh, fn, track_finality: bool = True):
+    specs = state_specs(track_finality)
     tel_specs = SimTelemetry(*([P()] * len(SimTelemetry._fields)))
     return jax.shard_map(fn, mesh=mesh, in_specs=(specs,),
                          out_specs=(specs, tel_specs), check_vma=False)
@@ -339,10 +347,12 @@ def make_sharded_round_step(mesh, cfg: AvalancheConfig = DEFAULT_CONFIG):
 
     def step(state: AvalancheSimState):
         n_global = state.records.votes.shape[0]
-        if n_global not in cache:
-            cache[n_global] = jax.jit(_shard_mapped(
-                mesh, lambda s: _local_round(s, cfg, n_global, n_tx)))
-        return cache[n_global](state)
+        track = state.finalized_at is not None
+        if (n_global, track) not in cache:
+            cache[(n_global, track)] = jax.jit(_shard_mapped(
+                mesh, lambda s: _local_round(s, cfg, n_global, n_tx),
+                track_finality=track))
+        return cache[(n_global, track)](state)
 
     return step
 
@@ -363,7 +373,9 @@ def run_scan_sharded(
             return new_s, tel
         return lax.scan(body, s, None, length=n_rounds)
 
-    return jax.jit(_shard_mapped(mesh, local_scan))(state)
+    return jax.jit(_shard_mapped(
+        mesh, local_scan,
+        track_finality=state.finalized_at is not None))(state)
 
 
 def run_sharded(
@@ -400,7 +412,7 @@ def run_sharded(
         final, _ = lax.while_loop(cond, body, (s, unsettled(s)))
         return final
 
-    specs = state_specs()
+    specs = state_specs(state.finalized_at is not None)
     fn = jax.shard_map(local_run, mesh=mesh, in_specs=(specs,),
                        out_specs=specs, check_vma=False)
     return jax.jit(fn)(state)

@@ -49,10 +49,10 @@ from go_avalanche_tpu.parallel import sharded
 from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS
 
 
-def backlog_state_specs() -> BacklogSimState:
+def backlog_state_specs(track_finality: bool = True) -> BacklogSimState:
     """PartitionSpecs for every leaf of `BacklogSimState`."""
     return BacklogSimState(
-        sim=sharded.state_specs(),
+        sim=sharded.state_specs(track_finality),
         slot_tx=P(TXS_AXIS),
         slot_admit_round=P(TXS_AXIS),
         backlog=Backlog(score=P(), init_pref=P(), valid=P()),
@@ -66,7 +66,7 @@ def shard_backlog_state(state: BacklogSimState, mesh) -> BacklogSimState:
     """Place a host-built backlog state onto the mesh."""
     return jax.tree.map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
-        state, backlog_state_specs())
+        state, backlog_state_specs(state.sim.finalized_at is not None))
 
 
 def _merge_write(old, idx, value, b):
@@ -174,7 +174,7 @@ def _local_retire_and_refill(
     score = jnp.where(occupied_after,
                       state.backlog.score[jnp.clip(new_tx, 0, b - 1)],
                       jnp.int32(-2**31 + 1))
-    finalized_at = jnp.where(take[None, :], -1, sim.finalized_at)
+    finalized_at = av.reset_finality(sim.finalized_at, take)
 
     new_sim = sim._replace(
         records=records,
@@ -214,8 +214,8 @@ def _local_step(
     return state._replace(sim=new_sim), tel
 
 
-def _shard_mapped(mesh, fn, with_tel=True):
-    specs = backlog_state_specs()
+def _shard_mapped(mesh, fn, with_tel=True, track_finality: bool = True):
+    specs = backlog_state_specs(track_finality)
     if with_tel:
         tel_specs = BacklogTelemetry(
             round=av.SimTelemetry(
@@ -235,10 +235,12 @@ def make_sharded_backlog_step(mesh, cfg: AvalancheConfig = DEFAULT_CONFIG):
 
     def step(state: BacklogSimState):
         n_global = state.sim.records.votes.shape[0]
-        if n_global not in cache:
-            cache[n_global] = jax.jit(_shard_mapped(
-                mesh, lambda s: _local_step(s, cfg, n_global, n_tx)))
-        return cache[n_global](state)
+        track = state.sim.finalized_at is not None
+        if (n_global, track) not in cache:
+            cache[(n_global, track)] = jax.jit(_shard_mapped(
+                mesh, lambda s: _local_step(s, cfg, n_global, n_tx),
+                track_finality=track))
+        return cache[(n_global, track)](state)
 
     return step
 
@@ -259,7 +261,9 @@ def run_scan_sharded_backlog(
             return new_s, tel
         return lax.scan(body, s, None, length=n_rounds)
 
-    return jax.jit(_shard_mapped(mesh, local_scan))(state)
+    return jax.jit(_shard_mapped(
+        mesh, local_scan,
+        track_finality=state.sim.finalized_at is not None))(state)
 
 
 def run_sharded_backlog(
@@ -297,4 +301,6 @@ def run_sharded_backlog(
         final, _ = _local_retire_and_refill(final, cfg, refill=False)
         return final
 
-    return jax.jit(_shard_mapped(mesh, local_run, with_tel=False))(state)
+    return jax.jit(_shard_mapped(
+        mesh, local_run, with_tel=False,
+        track_finality=state.sim.finalized_at is not None))(state)

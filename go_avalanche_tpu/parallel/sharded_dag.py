@@ -46,13 +46,16 @@ from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS
 
 
 def dag_state_specs(n_sets: int,
-                    set_size: Optional[int] = None) -> DagSimState:
+                    set_size: Optional[int] = None,
+                    track_finality: bool = True) -> DagSimState:
     """PartitionSpecs for every leaf of `DagSimState`.
 
     `n_sets` and `set_size` ride along as the pytree's static aux data so
-    the spec tree and the value tree unflatten identically.
+    the spec tree and the value tree unflatten identically;
+    `track_finality=False` mirrors a base state whose `finalized_at` leaf
+    is None (`models/avalanche.init`).
     """
-    return DagSimState(base=sharded.state_specs(),
+    return DagSimState(base=sharded.state_specs(track_finality),
                        conflict_set=P(TXS_AXIS), n_sets=n_sets,
                        set_size=set_size)
 
@@ -82,7 +85,8 @@ def shard_dag_state(state: DagSimState, mesh) -> DagSimState:
                 f"between tx shards {i} and {i + 1}")
     return jax.tree.map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
-        state, dag_state_specs(state.n_sets, state.set_size))
+        state, dag_state_specs(state.n_sets, state.set_size,
+                               state.base.finalized_at is not None))
 
 
 def _local_sets(conflict_set_local: jax.Array) -> jax.Array:
@@ -182,8 +186,8 @@ def _local_round(
 
     fin_after = vr.has_finalized(records.confidence, cfg)
     newly_final = fin_after & jnp.logical_not(fin)
-    finalized_at = jnp.where(newly_final & (base.finalized_at < 0),
-                             base.round, base.finalized_at)
+    finalized_at = av.stamp_finality(base.finalized_at, newly_final,
+                                     base.round)
 
     # Dynamic membership: each node-shard toggles its own rows, then the
     # replicated [N] plane is rebuilt with one all-gather (the
@@ -217,8 +221,9 @@ def _local_round(
 
 
 def _shard_mapped(mesh, n_sets: int, fn, tel: bool = True,
-                  set_size: Optional[int] = None):
-    specs = dag_state_specs(n_sets, set_size)
+                  set_size: Optional[int] = None,
+                  track_finality: bool = True):
+    specs = dag_state_specs(n_sets, set_size, track_finality)
     if tel:
         tel_specs = av.SimTelemetry(*([P()] * len(av.SimTelemetry._fields)))
         out_specs = (specs, tel_specs)
@@ -237,13 +242,13 @@ def make_sharded_dag_round_step(mesh, cfg: AvalancheConfig = DEFAULT_CONFIG):
 
     def step(state: DagSimState):
         key = (state.base.records.votes.shape[0], state.n_sets,
-               state.set_size)
+               state.set_size, state.base.finalized_at is not None)
         if key not in cache:
             n_global = key[0]
             cache[key] = jax.jit(_shard_mapped(
                 mesh, state.n_sets,
                 lambda s: _local_round(s, cfg, n_global, n_tx),
-                set_size=state.set_size))
+                set_size=state.set_size, track_finality=key[3]))
         return cache[key](state)
 
     return step
@@ -295,5 +300,6 @@ def run_sharded_dag(
         return final
 
     fn = _shard_mapped(mesh, state.n_sets, local_run, tel=False,
-                       set_size=state.set_size)
+                       set_size=state.set_size,
+                       track_finality=state.base.finalized_at is not None)
     return jax.jit(fn)(state)

@@ -56,10 +56,12 @@ from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS
 
 
 def streaming_dag_state_specs(n_sets: int,
-                              set_size=None) -> StreamingDagState:
+                              set_size=None,
+                              track_finality: bool = True,
+                              ) -> StreamingDagState:
     """PartitionSpecs for every leaf of `StreamingDagState`."""
     return StreamingDagState(
-        dag=sharded_dag.dag_state_specs(n_sets, set_size),
+        dag=sharded_dag.dag_state_specs(n_sets, set_size, track_finality),
         slot_set=P(TXS_AXIS),
         slot_admit_round=P(TXS_AXIS),
         backlog=SetBacklog(score=P(), init_pref=P(), valid=P()),
@@ -88,8 +90,9 @@ def shard_streaming_dag_state(state: StreamingDagState,
             f"the set capacity ({c}) so sets do not straddle tx shards")
     return jax.tree.map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
-        state, streaming_dag_state_specs(state.dag.n_sets,
-                                         state.dag.set_size))
+        state, streaming_dag_state_specs(
+            state.dag.n_sets, state.dag.set_size,
+            state.dag.base.finalized_at is not None))
 
 
 def _merge_rows(old, row_idx, rows, s_b):
@@ -219,7 +222,7 @@ def _local_retire_and_refill(
     score = jnp.where(occupied_after_w,
                       state.backlog.score[safe_rows].reshape(w_local),
                       jnp.int32(-2**31 + 1))
-    finalized_at = jnp.where(take_w[None, :], -1, base.finalized_at)
+    finalized_at = av.reset_finality(base.finalized_at, take_w)
 
     new_base = base._replace(
         records=records,
@@ -262,8 +265,9 @@ def _local_step(
     return state._replace(dag=new_dag), tel
 
 
-def _shard_mapped(mesh, n_sets: int, fn, with_tel=True, set_size=None):
-    specs = streaming_dag_state_specs(n_sets, set_size)
+def _shard_mapped(mesh, n_sets: int, fn, with_tel=True, set_size=None,
+                  track_finality: bool = True):
+    specs = streaming_dag_state_specs(n_sets, set_size, track_finality)
     if with_tel:
         tel_specs = StreamingDagTelemetry(
             round=av.SimTelemetry(*([P()] * len(av.SimTelemetry._fields))),
@@ -284,13 +288,14 @@ def make_sharded_streaming_dag_step(mesh,
     def step(state: StreamingDagState):
         c = state.backlog.score.shape[1]
         key = (state.dag.base.records.votes.shape[0], state.dag.n_sets, c,
-               state.dag.set_size)
+               state.dag.set_size,
+               state.dag.base.finalized_at is not None)
         if key not in cache:
             n_global = key[0]
             cache[key] = jax.jit(_shard_mapped(
                 mesh, state.dag.n_sets,
                 lambda s: _local_step(s, cfg, c, n_global, n_tx),
-                set_size=state.dag.set_size))
+                set_size=state.dag.set_size, track_finality=key[4]))
         return cache[key](state)
 
     return step
@@ -333,7 +338,9 @@ def run_sharded_streaming_dag(
         return final
 
     fn = _shard_mapped(mesh, state.dag.n_sets, local_run, with_tel=False,
-                       set_size=state.dag.set_size)
+                       set_size=state.dag.set_size,
+                       track_finality=state.dag.base.finalized_at
+                       is not None)
     return jax.jit(fn)(state)
 
 
@@ -354,5 +361,6 @@ def run_scan_sharded_streaming_dag(
             return new_s, tel
         return lax.scan(body, s, None, length=n_rounds)
 
-    return jax.jit(_shard_mapped(mesh, state.dag.n_sets, local_scan,
-                                 set_size=state.dag.set_size))(state)
+    return jax.jit(_shard_mapped(
+        mesh, state.dag.n_sets, local_scan, set_size=state.dag.set_size,
+        track_finality=state.dag.base.finalized_at is not None))(state)

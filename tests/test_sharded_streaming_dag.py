@@ -22,12 +22,13 @@ def _mesh(nodes=4, txs=2):
 
 
 def _state(n_nodes=16, n_sets=12, c=2, window_sets=4, cfg=None, seed=0,
-           backlog=None):
+           backlog=None, track_finality=True):
     cfg = cfg or AvalancheConfig()
     if backlog is None:
         backlog = sd.make_set_backlog(
             jnp.arange(n_sets * c, dtype=jnp.int32).reshape(n_sets, c))
-    return sd.init(jax.random.key(seed), n_nodes, window_sets, backlog, cfg)
+    return sd.init(jax.random.key(seed), n_nodes, window_sets, backlog, cfg,
+                   track_finality=track_finality)
 
 
 def test_placement_validates_set_granularity():
@@ -149,3 +150,24 @@ def test_sharded_streaming_determinism():
     assert np.array_equal(np.asarray(a.dag.base.records.confidence),
                           np.asarray(b.dag.base.records.confidence))
     assert np.array_equal(np.asarray(a.slot_set), np.asarray(b.slot_set))
+
+
+def test_sharded_streaming_track_finality_off():
+    """The reviewed failure mode: a track_finality=False state (None
+    finalized_at leaf) must place, step, and drain on the mesh — the spec
+    trees carry None in the same slot — with consensus outcomes identical
+    to the tracking run."""
+    cfg = AvalancheConfig()
+    mesh = _mesh()
+    backlog = sd.make_set_backlog(jnp.full((6, 2), 5, jnp.int32))
+
+    def run(track):
+        state = ssd.shard_streaming_dag_state(
+            _state(n_nodes=16, n_sets=6, c=2, window_sets=2,
+                   backlog=backlog, cfg=cfg, track_finality=track), mesh)
+        assert (state.dag.base.finalized_at is None) == (not track)
+        return sd.resolution_summary(jax.device_get(
+            ssd.run_sharded_streaming_dag(mesh, state, cfg,
+                                          max_rounds=5000)))
+
+    assert run(True) == run(False)

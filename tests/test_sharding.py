@@ -161,3 +161,24 @@ def test_gossip_heard_packed_matches_unpacked_oracle(mesh):
                        out_specs=P("nodes", "txs"), check_vma=False)
     out = jax.jit(fn)(peers, polled)
     np.testing.assert_array_equal(np.asarray(out), expected)
+
+
+def test_sharded_track_finality_off():
+    """A state built with track_finality=False (no finalized_at plane)
+    shards, steps, and converges on the mesh; consensus leaves match the
+    tracking run exactly."""
+    cfg = AvalancheConfig()
+    mesh = make_mesh(n_node_shards=4, n_tx_shards=2)
+    on = sharded.shard_state(av.init(jax.random.key(0), 32, 16, cfg), mesh)
+    off = sharded.shard_state(
+        av.init(jax.random.key(0), 32, 16, cfg, track_finality=False), mesh)
+    assert off.finalized_at is None
+    fin_on = sharded.run_sharded(mesh, on, cfg, max_rounds=100)
+    fin_off = sharded.run_sharded(mesh, off, cfg, max_rounds=100)
+    assert fin_off.finalized_at is None
+    nulled = fin_on._replace(finalized_at=None)
+    for a, b in zip(jax.tree_util.tree_leaves(nulled),
+                    jax.tree_util.tree_leaves(fin_off)):
+        if jnp.issubdtype(jnp.asarray(a).dtype, jax.dtypes.prng_key):
+            continue
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

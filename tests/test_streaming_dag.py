@@ -238,3 +238,33 @@ def test_run_scan_telemetry_shapes():
     assert tel.retired_sets.shape == (10,)
     assert tel.round.polls.shape == (10,)
     assert int(tel.occupied_sets[0]) == 2
+
+
+def test_track_finality_off_same_consensus():
+    """`init(track_finality=False)` drops the per-(node,tx) finalized_at
+    plane (pure telemetry on this path — SetOutputs carries latency) and
+    must not change ANY other leaf of the run, under a faulted config that
+    exercises every PRNG consumer."""
+    cfg = AvalancheConfig(byzantine_fraction=0.2, drop_probability=0.05,
+                          adversary_strategy=AdversaryStrategy.EQUIVOCATE)
+    backlog = make_backlog(8, 2)
+    on = sd.init(jax.random.key(5), 16, 3, backlog, cfg)
+    off = sd.init(jax.random.key(5), 16, 3, backlog, cfg,
+                  track_finality=False)
+    assert off.dag.base.finalized_at is None
+    run = jax.jit(sd.run, static_argnames=("cfg", "max_rounds"))
+    fin_on = jax.device_get(run(on, cfg, 3000))
+    fin_off = jax.device_get(run(off, cfg, 3000))
+    assert fin_off.dag.base.finalized_at is None
+
+    # Null the tracked run's plane; every remaining leaf must be identical.
+    nulled = fin_on._replace(dag=dataclasses.replace(
+        fin_on.dag, base=fin_on.dag.base._replace(finalized_at=None)))
+    la, lb = (jax.tree_util.tree_leaves(nulled),
+              jax.tree_util.tree_leaves(fin_off))
+    assert len(la) == len(lb)
+    for a, b in zip(la, lb):
+        if jnp.issubdtype(jnp.asarray(a).dtype, jax.dtypes.prng_key):
+            a, b = jax.random.key_data(a), jax.random.key_data(b)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert sd.resolution_summary(fin_on) == sd.resolution_summary(fin_off)
