@@ -159,7 +159,6 @@ def _retire_and_refill(
     txs, so `next_idx` never counts txs that were admitted but not polled.
     """
     sim = state.sim
-    n, w = sim.records.votes.shape
     settled = _settled_slots(state, cfg)
 
     # --- retire: scatter outcomes at the retiring slots' tx indices.
@@ -200,7 +199,10 @@ def _retire_and_refill(
 
     cand_safe = jnp.clip(cand, 0, b - 1)
     pref = state.backlog.init_pref[cand_safe]             # bool [W]
-    fresh = vr.init_state(jnp.broadcast_to(pref[None, :], (n, w)))
+    # Row-constant fresh values at [1, W]; the fill `where` broadcasts.
+    # (Cost analysis shows XLA fused the explicit [N, W] broadcast this
+    # replaces, so this is clarity, not traffic — PERF_NOTES.md.)
+    fresh = vr.init_state(pref[None, :])
 
     def fill(plane, fresh_plane):
         return jnp.where(take[None, :], fresh_plane, plane)

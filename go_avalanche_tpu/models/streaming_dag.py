@@ -204,7 +204,7 @@ def _retire_and_refill(
     Returns (new_state, sets retired).
     """
     base = state.dag.base
-    n, w = base.records.votes.shape
+    w = base.records.votes.shape[1]
     c = set_capacity(state)
     s_w = w // c
     s_b = state.backlog.score.shape[0]
@@ -248,7 +248,12 @@ def _retire_and_refill(
     cand_safe = jnp.clip(cand, 0, s_b - 1)
     pref_w = state.backlog.init_pref[cand_safe].reshape(w)      # [W]
     take_w = jnp.repeat(take, c)                                # [W]
-    fresh = vr.init_state(jnp.broadcast_to(pref_w[None, :], (n, w)))
+    # Fresh record values are row-constant (every node seeds a re-admitted
+    # column identically): build them at [1, W] and let the fill `where`
+    # broadcast.  (Cost analysis shows XLA fused the explicit [N, W]
+    # broadcast this replaces, so this is clarity, not traffic —
+    # PERF_NOTES.md.)
+    fresh = vr.init_state(pref_w[None, :])
 
     def fill(plane, fresh_plane):
         return jnp.where(take_w[None, :], fresh_plane, plane)

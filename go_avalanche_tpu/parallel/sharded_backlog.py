@@ -110,7 +110,7 @@ def _local_retire_and_refill(
     """The scheduler pass on one shard; see `models/backlog`. Returns
     (new_state, globally-retired count)."""
     sim = state.sim
-    n_local, w_local = sim.records.votes.shape
+    w_local = sim.records.votes.shape[1]
     b = state.backlog.score.shape[0]
     settled = _local_settled(state, cfg)
 
@@ -155,8 +155,10 @@ def _local_retire_and_refill(
 
     cand_safe = jnp.clip(cand, 0, b - 1)
     pref = state.backlog.init_pref[cand_safe]
-    fresh = vr.init_state(jnp.broadcast_to(pref[None, :],
-                                           (n_local, w_local)))
+    # Row-constant fresh values at [1, W]; the fill `where` broadcasts.
+    # (Cost analysis shows XLA fused the explicit [N, W] broadcast this
+    # replaces, so this is clarity, not traffic — PERF_NOTES.md.)
+    fresh = vr.init_state(pref[None, :])
 
     def fill(plane, fresh_plane):
         return jnp.where(take[None, :], fresh_plane, plane)

@@ -149,7 +149,7 @@ def _local_retire_and_refill(
     """The set-granular scheduler pass on one shard; see
     `models/streaming_dag`.  Returns (new_state, globally-retired sets)."""
     base = state.dag.base
-    n_local, w_local = base.records.votes.shape
+    w_local = base.records.votes.shape[1]
     s_w_local = w_local // c
     s_b = state.backlog.score.shape[0]
     settled = _local_settled_sets(state, cfg, c)
@@ -201,8 +201,10 @@ def _local_retire_and_refill(
     cand_safe = jnp.clip(cand, 0, s_b - 1)
     pref_w = state.backlog.init_pref[cand_safe].reshape(w_local)
     take_w = jnp.repeat(take, c)
-    fresh = vr.init_state(jnp.broadcast_to(pref_w[None, :],
-                                           (n_local, w_local)))
+    # Row-constant fresh values at [1, W]; the fill `where` broadcasts.
+    # (Cost analysis shows XLA fused the explicit [N, W] broadcast this
+    # replaces, so this is clarity, not traffic — PERF_NOTES.md.)
+    fresh = vr.init_state(pref_w[None, :])
 
     def fill(plane, fresh_plane):
         return jnp.where(take_w[None, :], fresh_plane, plane)
