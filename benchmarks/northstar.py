@@ -72,7 +72,8 @@ def worker(args: argparse.Namespace) -> None:
 
     beat("init")
     shape = QUICK if args.quick else FULL
-    state, cfg = northstar_state(**shape)
+    state, cfg = northstar_state(**shape,
+                                 track_finality=not args.no_track_finality)
     beat("state built")
     if os.path.exists(args.ckpt):
         state = restore_checkpoint(args.ckpt, state)
@@ -119,6 +120,11 @@ def worker(args: argparse.Namespace) -> None:
                   f"{shape['backlog_sets'] * shape['set_cap']} txs in "
                   f"{shape['backlog_sets']} conflict sets, "
                   f"{shape['window_sets']}-set window")
+    if args.no_track_finality:
+        # The mode changes measured wall-clock (~17% less step traffic):
+        # a row produced under it must say so, not silently replace the
+        # default-mode number (`_update_results` rewrites config6 in place).
+        shape_name += ", finalized_at plane off"
     Path(args.result).write_text(json.dumps({
         "name": f"streaming conflict-DAG ({shape_name})",
         "rounds": int(jax.device_get(final.dag.base.round)),
@@ -162,6 +168,8 @@ def parent(args: argparse.Namespace) -> None:
             child_args.append("--quick")
         if args.force_cpu:
             child_args.append("--force-cpu")
+        if args.no_track_finality:
+            child_args.append("--no-track-finality")
         proc = subprocess.Popen(child_args, stderr=sys.stderr)
         # Heartbeat watchdog: a chunk takes ~25s healthy (first one
         # ~45s with compile); no heartbeat for stall_timeout => the device
@@ -226,6 +234,12 @@ def _update_results(row: dict) -> None:
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--quick", action="store_true")
+    parser.add_argument("--no-track-finality", action="store_true",
+                        help="build the state without the per-(node,tx) "
+                             "finalized_at plane (-17%% step memory "
+                             "traffic; see PERF_NOTES.md). Checkpoints are "
+                             "structure-incompatible across this flag — "
+                             "use a fresh --workdir")
     parser.add_argument("--force-cpu", action="store_true",
                         help="pin the CPU backend (smoke-testing the "
                              "driver on boxes without the accelerator)")
