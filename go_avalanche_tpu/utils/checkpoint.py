@@ -39,7 +39,7 @@ def _is_key(leaf: Any) -> bool:
 def save_checkpoint(path: str, state: Any) -> None:
     """Save any simulator state pytree to `path` (.npz)."""
     leaves, _ = jax.tree_util.tree_flatten(state)
-    payload = {}
+    payload = {"__leaf_count__": np.asarray(len(leaves))}
     for i, leaf in enumerate(leaves):
         if _is_key(leaf):
             payload[f"{_KEY_PREFIX}{i}"] = np.asarray(
@@ -61,6 +61,15 @@ def restore_checkpoint(path: str, template: Any) -> Any:
     """
     leaves, treedef = jax.tree_util.tree_flatten(template)
     with np.load(path) as data:
+        if "__leaf_count__" in data:   # absent in pre-marker checkpoints
+            saved = int(data["__leaf_count__"])
+            if saved != len(leaves):
+                raise ValueError(
+                    f"checkpoint has {saved} leaves, template has "
+                    f"{len(leaves)} — saved from a structurally different "
+                    f"state (e.g. the opposite `track_finality` mode, or "
+                    f"another model/config); rebuild the template to match "
+                    f"how the checkpoint was produced")
         restored = []
         for i, leaf in enumerate(leaves):
             key_name, plain_name = f"{_KEY_PREFIX}{i}", f"leaf_{i}"

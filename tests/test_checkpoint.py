@@ -167,3 +167,22 @@ def test_streaming_dag_state_roundtrips(tmp_path):
     np.testing.assert_array_equal(np.asarray(fin_a.outputs.accepted),
                                   np.asarray(fin_b.outputs.accepted))
     assert np.asarray(fin_a.outputs.settled).all()
+
+
+def test_cross_mode_restore_fails_with_clear_message(tmp_path):
+    """A checkpoint saved with the finalized_at plane must refuse to
+    restore into a track_finality=False template (and vice versa) with a
+    message naming the mode, not a cryptic per-leaf shape error."""
+    cfg = AvalancheConfig()
+    on = av.init(jax.random.key(0), 8, 4, cfg)
+    off = av.init(jax.random.key(0), 8, 4, cfg, track_finality=False)
+    p = str(tmp_path / "mode.npz")
+    save_checkpoint(p, on)
+    with pytest.raises(ValueError, match="track_finality"):
+        restore_checkpoint(p, off)
+    save_checkpoint(p, off)
+    with pytest.raises(ValueError, match="track_finality"):
+        restore_checkpoint(p, on)
+    # And the matching direction still round-trips.
+    restored = restore_checkpoint(p, off)
+    assert restored.finalized_at is None
