@@ -220,3 +220,63 @@ def test_sim_init_invalid_model_byte_is_protocol_error(server):
             c._call(proto_mod.MsgType.SIM_INIT, payload,
                     [proto_mod.MsgType.OK])
         assert c.ping()
+
+
+def test_fuzz_malformed_frames_never_crash_server(server):
+    """Robustness: random garbage on the wire must never crash or wedge the
+    server — every connection gets an error frame or a clean close, and the
+    server still serves a well-formed client afterwards.
+
+    Deterministic seed; three garbage classes: raw noise (no framing),
+    valid frames with unknown types, and valid-type frames with truncated
+    payloads.
+    """
+    import socket
+
+    rng = random.Random(0xFA22)
+    host, port = server.address
+
+    def connect():
+        s = socket.create_connection((host, port), timeout=5)
+        s.settimeout(5)
+        return s
+
+    def drain(s):
+        try:
+            while s.recv(4096):
+                pass
+        except TimeoutError:
+            pytest.fail("server wedged on malformed input: no reply and "
+                        "no close within 5s")
+        except (ConnectionError, OSError):
+            pass
+
+    for trial in range(25):
+        with connect() as s:
+            kind = trial % 3
+            if kind == 0:     # unframed noise
+                s.sendall(rng.randbytes(rng.randint(1, 64)))
+            elif kind == 1:   # framed, unknown message type
+                s.sendall(proto.pack_frame(250,
+                                           rng.randbytes(rng.randint(0, 32))))
+            else:             # known type, garbage/truncated payload
+                # SHUTDOWN excluded: an empty payload makes it a VALID
+                # advisory request (it would set the fixture server's
+                # shutdown flag, not exercise malformed-input handling).
+                types = [t for t in proto.MsgType
+                         if t is not proto.MsgType.SHUTDOWN]
+                msg_type = rng.choice(types).value
+                s.sendall(proto.pack_frame(msg_type,
+                                           rng.randbytes(rng.randint(0, 8))))
+            try:
+                s.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass          # server already closed on us — acceptable
+            drain(s)          # server may answer with an error frame; fine
+
+    # The server must still be fully functional for a real client.
+    with _client(server) as c:
+        assert c.ping()
+        assert c.create_node(7)
+        assert c.add_target(7, 99, accepted=True, score=1)
+        assert c.get_invs(7) == [99]
