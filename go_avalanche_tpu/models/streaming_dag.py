@@ -411,6 +411,11 @@ def run_chunked(
     ``(rounds_done, state)`` — the hook the baseline suite uses to log
     drain rate.
     """
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    if checkpoint_path and checkpoint_every_chunks < 1:
+        raise ValueError("checkpoint_every_chunks must be >= 1, got "
+                         f"{checkpoint_every_chunks}")
     chunks_done = 0
     while True:
         state, done = _run_chunk_jit(state, cfg, chunk, max_rounds)

@@ -268,3 +268,17 @@ def test_track_finality_off_same_consensus():
             a, b = jax.random.key_data(a), jax.random.key_data(b)
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     assert sd.resolution_summary(fin_on) == sd.resolution_summary(fin_off)
+
+
+def test_run_chunked_rejects_bad_knobs():
+    """chunk < 1 would loop forever dispatching no-ops; a zero checkpoint
+    cadence would divide by zero at the first boundary — both must raise
+    up front."""
+    cfg = AvalancheConfig()
+    state = sd.init(jax.random.key(0), 8, 2, make_backlog(4, 2), cfg)
+    with pytest.raises(ValueError, match="chunk"):
+        sd.run_chunked(state, cfg, max_rounds=10, chunk=0)
+    with pytest.raises(ValueError, match="checkpoint_every_chunks"):
+        sd.run_chunked(state, cfg, max_rounds=10, chunk=2,
+                       checkpoint_path="/tmp/x.npz",
+                       checkpoint_every_chunks=0)
