@@ -25,7 +25,16 @@ def rounds_to_finality(finalized_at) -> Dict[str, float]:
 
     The paper-curve metric (BASELINE.json): min / mean / median / p90 / max
     rounds until finalization, plus the unfinalized fraction.
+
+    A state built with `track_finality=False` has no plane; raise a
+    directed error rather than a bare TypeError deep in numpy.
     """
+    if finalized_at is None:
+        raise ValueError(
+            "finalized_at is None: the state was built with "
+            "track_finality=False; per-(node,tx) finality stats need "
+            "init(track_finality=True) (streaming paths record latency "
+            "per set/tx in their output planes instead)")
     fat = np.asarray(jax.device_get(finalized_at)).ravel()
     done = fat[fat >= 0]
     out = {"unfinalized_fraction": float((fat < 0).mean())}

@@ -74,3 +74,8 @@ def test_family_curves_runners_smoke():
         out = runner(jax.random.key(0), 64, cfg, 200)
         assert 0.0 <= out["decided_fraction"] <= 1.0
         assert out["safety_failure"] is False
+
+
+def test_rounds_to_finality_rejects_untracked_state():
+    with pytest.raises(ValueError, match="track_finality"):
+        metrics.rounds_to_finality(None)
