@@ -1,0 +1,75 @@
+"""Analytic memory-traffic report for the streaming step (XLA cost model).
+
+Reproduces the PERF_NOTES.md numbers: lowers the streaming conflict-DAG
+step (and its two halves — the DAG round and the retire/refill scheduler)
+through XLA and prints each program's `bytes accessed` / flops from
+`compiled.cost_analysis()`.  Runs on the CPU backend — no accelerator
+needed — so traffic regressions in the hot path are measurable anywhere,
+including CI boxes and wedged-tunnel sessions.  The absolute numbers are
+the CPU backend's cost model; treat them as comparable BETWEEN revisions
+and configurations, not as TPU ground truth.
+
+    python benchmarks/cost_analysis.py [--nodes 4096] [--window-sets 1024]
+
+Prints one JSON line per (program, track_finality) pair.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--nodes", type=int, default=4096)
+    parser.add_argument("--window-sets", type=int, default=1024)
+    parser.add_argument("--set-cap", type=int, default=2)
+    parser.add_argument("--backlog-sets", type=int, default=20000)
+    args = parser.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # env var is overridden by
+    # the accelerator sitecustomize; see tests/conftest.py
+
+    from benchmarks.workload import northstar_state
+    from go_avalanche_tpu.models import dag as dag_model
+    from go_avalanche_tpu.models import streaming_dag as sdg
+
+    for track in (True, False):
+        state, cfg = northstar_state(
+            nodes=args.nodes, backlog_sets=args.backlog_sets,
+            set_cap=args.set_cap, window_sets=args.window_sets,
+            track_finality=track)
+
+        def full_step(s):
+            return sdg.step(s, cfg)[0]
+
+        def round_only(s):
+            return dag_model.round_step(s.dag, cfg)[0]
+
+        def retire_refill(s):
+            return sdg._retire_and_refill(s, cfg)[0]
+
+        for name, fn in (("full_step", full_step),
+                         ("dag_round", round_only),
+                         ("retire_refill", retire_refill)):
+            ca = jax.jit(fn).lower(state).compile().cost_analysis()
+            if isinstance(ca, list):
+                ca = ca[0]
+            print(json.dumps({
+                "program": name,
+                "track_finality": track,
+                "bytes_accessed_mb": round(
+                    ca.get("bytes accessed", 0) / 1e6, 1),
+                "gflops": round(ca.get("flops", 0) / 1e9, 2),
+            }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
