@@ -30,6 +30,19 @@ def main() -> None:
     parser.add_argument("--window-sets", type=int, default=1024)
     parser.add_argument("--set-cap", type=int, default=2)
     parser.add_argument("--backlog-sets", type=int, default=20000)
+    parser.add_argument("--check", type=str, default=None, metavar="PATH",
+                        help="compare against a recorded baseline JSON "
+                             "(one row per line, as this script prints); "
+                             "exit 1 if any program's bytes accessed grew "
+                             "more than --tolerance")
+    parser.add_argument("--tolerance", type=float, default=0.05,
+                        help="allowed fractional growth vs the baseline "
+                             "(default 5%%; the cost model is "
+                             "deterministic, so slack only absorbs "
+                             "XLA-version drift)")
+    parser.add_argument("--out", type=str, default=None,
+                        help="also write the rows to this path (how the "
+                             "baseline file is refreshed)")
     args = parser.parse_args()
 
     import jax
@@ -41,6 +54,7 @@ def main() -> None:
     from go_avalanche_tpu.models import dag as dag_model
     from go_avalanche_tpu.models import streaming_dag as sdg
 
+    rows = []
     for track in (True, False):
         state, cfg = northstar_state(
             nodes=args.nodes, backlog_sets=args.backlog_sets,
@@ -62,13 +76,58 @@ def main() -> None:
             ca = jax.jit(fn).lower(state).compile().cost_analysis()
             if isinstance(ca, list):
                 ca = ca[0]
-            print(json.dumps({
+            row = {
                 "program": name,
                 "track_finality": track,
                 "bytes_accessed_mb": round(
                     ca.get("bytes accessed", 0) / 1e6, 1),
                 "gflops": round(ca.get("flops", 0) / 1e9, 2),
-            }), flush=True)
+            }
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+
+    shape = {"nodes": args.nodes, "window_sets": args.window_sets,
+             "set_cap": args.set_cap, "backlog_sets": args.backlog_sets}
+    if args.out:
+        Path(args.out).write_text(
+            json.dumps({"config": shape}) + "\n"
+            + "".join(json.dumps(r) + "\n" for r in rows))
+    if args.check:
+        lines = [json.loads(line) for line
+                 in Path(args.check).read_text().splitlines()
+                 if line.strip()]
+        base_cfg = (lines[0].get("config")
+                    if lines and "config" in lines[0] else None)
+        if base_cfg is not None and base_cfg != shape:
+            print(f"BASELINE CONFIG MISMATCH: {args.check} was recorded at "
+                  f"{base_cfg}, this run measured {shape} — the comparison "
+                  f"would be meaningless; re-record the baseline with "
+                  f"--out at the checked shape", file=sys.stderr)
+            sys.exit(1)
+        base = {(r["program"], r["track_finality"]): r
+                for r in lines if "program" in r}
+        failures = []
+        for r in rows:
+            b = base.get((r["program"], r["track_finality"]))
+            if b is None:   # fail closed: an unguarded program is a gap
+                failures.append(
+                    f"{r['program']} (track_finality="
+                    f"{r['track_finality']}): no baseline row — refresh "
+                    f"{args.check} with --out")
+                continue
+            limit = b["bytes_accessed_mb"] * (1.0 + args.tolerance)
+            if r["bytes_accessed_mb"] > limit:
+                failures.append(
+                    f"{r['program']} (track_finality="
+                    f"{r['track_finality']}): {r['bytes_accessed_mb']}MB > "
+                    f"baseline {b['bytes_accessed_mb']}MB "
+                    f"+{args.tolerance:.0%}")
+        if failures:
+            print("TRAFFIC REGRESSION vs " + args.check + ":\n  "
+                  + "\n  ".join(failures), file=sys.stderr)
+            sys.exit(1)
+        print(f"traffic within {args.tolerance:.0%} of {args.check}",
+              file=sys.stderr)
 
 
 if __name__ == "__main__":
