@@ -47,8 +47,6 @@ from benchmarks.workload import (  # noqa: E402 — after the sys.path insert
 
 
 def worker(args: argparse.Namespace) -> None:
-    import threading
-
     import jax
 
     if args.force_cpu:
@@ -59,10 +57,7 @@ def worker(args: argparse.Namespace) -> None:
         jax.config.update("jax_platforms", "cpu")
 
     from go_avalanche_tpu.models import streaming_dag as sdg
-    from go_avalanche_tpu.utils.checkpoint import (
-        restore_checkpoint,
-        save_checkpoint,
-    )
+    from go_avalanche_tpu.utils.checkpoint import restore_checkpoint
 
     def beat(note: str) -> None:
         """Startup heartbeats: checkpoint restore is itself a ~100s
@@ -83,16 +78,6 @@ def worker(args: argparse.Namespace) -> None:
         beat("checkpoint restored")
 
     t0 = time.time()
-    # Checkpoints are written from a BACKGROUND thread: the ~1.9GB
-    # device->host fetch runs at ~19MB/s through the axon tunnel (~100s,
-    # measured r4 — 4x a chunk's compute), so a synchronous save would
-    # double the run.  Device arrays are immutable, so snapshotting the
-    # chunk-boundary state while later chunks compute is race-free; the
-    # write itself is atomic (tmp + rename) so a mid-save kill can't tear
-    # the file.  One save at a time; boundaries are skipped while a save
-    # is in flight.
-    ckpt_thread: list = [None]
-    chunk_i = [0]
 
     def progress(rounds, s):
         Path(args.progress).write_text(json.dumps({
@@ -100,20 +85,14 @@ def worker(args: argparse.Namespace) -> None:
             "admitted": int(jax.device_get(s.next_idx)),
             "attempt_wall_s": round(time.time() - t0, 1),
         }) + "\n")
-        chunk_i[0] += 1
-        th = ckpt_thread[0]
-        if chunk_i[0] % args.ckpt_every == 0 and (th is None
-                                                  or not th.is_alive()):
-            th = threading.Thread(target=save_checkpoint,
-                                  args=(args.ckpt, s), daemon=True)
-            th.start()
-            ckpt_thread[0] = th
 
+    # Checkpointing (async, atomic, one save in flight) lives inside
+    # run_chunked — the same mechanism every caller gets.
     final = sdg.run_chunked(
         state, cfg, max_rounds=500_000, chunk=args.chunk,
+        checkpoint_path=args.ckpt,
+        checkpoint_every_chunks=args.ckpt_every,
         progress=progress)
-    if ckpt_thread[0] is not None:
-        ckpt_thread[0].join()
 
     summary = sdg.resolution_summary(final)
     shape_name = (f"{shape['nodes']} nodes, "
@@ -156,8 +135,18 @@ def parent(args: argparse.Namespace) -> None:
     accum = 0.0
     if args.resume and wall_file.exists():
         accum = json.loads(wall_file.read_text()).get("accum_s", 0.0)
+    def _progress_round() -> int:
+        """Latest round the worker reported; -1 before any chunk."""
+        try:
+            return int(json.loads(Path(progress).read_text()).get("round",
+                                                                  -1))
+        except (OSError, ValueError, json.JSONDecodeError):
+            return -1
+
     t_start = time.time()
     attempts = 0
+    best_round = -1
+    no_progress_strikes = 0
     while attempts < args.max_attempts:
         attempts += 1
         child_args = [sys.executable, os.path.abspath(__file__), "--worker",
@@ -174,6 +163,7 @@ def parent(args: argparse.Namespace) -> None:
         # Heartbeat watchdog: a chunk takes ~25s healthy (first one
         # ~45s with compile); no heartbeat for stall_timeout => the device
         # call wedged => kill and resume from checkpoint in a new process.
+        killed_by_watchdog = False
         last_beat = time.time()
         while proc.poll() is None:
             time.sleep(5)
@@ -187,6 +177,7 @@ def parent(args: argparse.Namespace) -> None:
                 print(f"attempt {attempts}: no heartbeat for "
                       f"{args.stall_timeout:.0f}s — killing worker",
                       file=sys.stderr, flush=True)
+                killed_by_watchdog = True
                 proc.send_signal(signal.SIGKILL)
                 proc.wait()
                 break
@@ -200,6 +191,29 @@ def parent(args: argparse.Namespace) -> None:
             if args.update_results:
                 _update_results(out)
             return
+        # Fast-fail on DETERMINISTIC failures: a worker that exits ON ITS
+        # OWN without ever advancing a round (e.g. a checkpoint/template
+        # structure mismatch raising at restore) will fail identically
+        # forever — don't burn max_attempts x minutes of full-scale state
+        # construction on it.  Watchdog kills never count: a transient
+        # wedge can strike during the ~100s restore or before the resumed
+        # attempt re-passes the previous best round, and retrying is
+        # exactly what those cases need.
+        reached = _progress_round()
+        if reached > best_round:
+            best_round = reached
+            no_progress_strikes = 0
+        elif not killed_by_watchdog:
+            no_progress_strikes += 1
+        if no_progress_strikes >= 2:
+            print(json.dumps({
+                "error": f"aborting after {attempts} attempts: two "
+                         f"consecutive attempts made no round progress "
+                         f"(stuck at round {best_round}) — a deterministic "
+                         f"failure (e.g. checkpoint/template mismatch) or "
+                         f"a dead accelerator; retrying further would only "
+                         f"repeat it. See the worker stderr above"}))
+            sys.exit(1)
         print(f"attempt {attempts} ended (rc={proc.returncode}); resuming "
               f"from checkpoint", file=sys.stderr, flush=True)
     print(json.dumps({"error": f"no result after {attempts} attempts"}))

@@ -407,29 +407,65 @@ def run_chunked(
     `checkpoint_path` (optional) saves the state every
     `checkpoint_every_chunks` chunks via `utils/checkpoint` (atomic
     replace), so a killed run resumes from the last checkpoint instead of
-    round 0.  `progress`, if given, is called after every chunk with
-    ``(rounds_done, state)`` — the hook the baseline suite uses to log
-    drain rate.
+    round 0.  Saves run in a BACKGROUND thread: at north-star shape the
+    ~1.9 GB device→host fetch takes ~4x a chunk's compute through the
+    tunnel (measured; see `benchmarks/PERF_NOTES.md`), so a synchronous
+    save would roughly halve throughput.  Device arrays are immutable, so
+    snapshotting a chunk-boundary state while later chunks compute is
+    race-free; one save runs at a time (boundaries are skipped while one
+    is in flight), and the last save is joined before returning, so the
+    file exists when this function does.  `progress`, if given, is called
+    after every chunk with ``(rounds_done, state)`` — the hook the
+    baseline suite uses to log drain rate.
     """
     if chunk < 1:
         raise ValueError(f"chunk must be >= 1, got {chunk}")
     if checkpoint_path and checkpoint_every_chunks < 1:
         raise ValueError("checkpoint_every_chunks must be >= 1, got "
                          f"{checkpoint_every_chunks}")
-    chunks_done = 0
-    while True:
-        state, done = _run_chunk_jit(state, cfg, chunk, max_rounds)
-        # Scalar fetch doubles as the device sync (see bench.py `_sync`).
-        done = bool(jax.device_get(done))
-        rounds = int(jax.device_get(state.dag.base.round))
-        chunks_done += 1
-        if progress is not None:
-            progress(rounds, state)
-        if checkpoint_path and chunks_done % checkpoint_every_chunks == 0:
-            from go_avalanche_tpu.utils.checkpoint import save_checkpoint
-            save_checkpoint(checkpoint_path, state)
-        if done or rounds >= max_rounds:
-            break
+    import threading
+
+    from go_avalanche_tpu.utils.checkpoint import save_checkpoint
+
+    saver: Optional[threading.Thread] = None
+    save_error: list = []
+
+    def _save(snapshot):
+        # Capture failures: a daemon thread's exception otherwise only
+        # prints to stderr, and the run would return claiming a checkpoint
+        # it never wrote.
+        try:
+            save_checkpoint(checkpoint_path, snapshot)
+        except Exception as e:  # noqa: BLE001 — re-raised at join below
+            save_error.append(e)
+
+    try:
+        chunks_done = 0
+        while True:
+            state, done = _run_chunk_jit(state, cfg, chunk, max_rounds)
+            # Scalar fetch doubles as the device sync (see bench.py _sync).
+            done = bool(jax.device_get(done))
+            rounds = int(jax.device_get(state.dag.base.round))
+            chunks_done += 1
+            if progress is not None:
+                progress(rounds, state)
+            if (checkpoint_path
+                    and chunks_done % checkpoint_every_chunks == 0
+                    and (saver is None or not saver.is_alive())):
+                if save_error:
+                    raise save_error[0]
+                saver = threading.Thread(target=_save, args=(state,),
+                                         daemon=True)
+                saver.start()
+            if done or rounds >= max_rounds:
+                break
+    finally:
+        # Always join: an orphaned in-flight save would race a later
+        # save_checkpoint to the same tmp path.
+        if saver is not None:
+            saver.join()
+    if save_error:
+        raise save_error[0]
     final, _ = _retire_and_refill(state, cfg, refill=False)
     return final
 

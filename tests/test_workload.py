@@ -1,0 +1,55 @@
+"""The shared north-star workload builder (`benchmarks/workload.py`).
+
+Three benchmark surfaces (baseline_suite config6, northstar.py,
+bench_streaming.py) claim to measure the same program because they build
+state through this one helper — pin that the construction is
+deterministic and that the tracking flag changes nothing but the plane.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from benchmarks.workload import NORTH_STAR, QUICK, northstar_state
+
+
+def _leaves(state):
+    out = []
+    for leaf in jax.tree_util.tree_leaves(state):
+        if jnp.issubdtype(jnp.asarray(leaf).dtype, jax.dtypes.prng_key):
+            leaf = jax.random.key_data(leaf)
+        out.append(np.asarray(jax.device_get(leaf)))
+    return out
+
+
+def test_builder_is_deterministic():
+    a, cfg_a = northstar_state(**QUICK)
+    b, cfg_b = northstar_state(**QUICK)
+    assert cfg_a == cfg_b
+    for x, y in zip(_leaves(a), _leaves(b)):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_shapes_match_declared_config():
+    state, cfg = northstar_state(**QUICK)
+    n, w = state.dag.base.records.votes.shape
+    assert n == QUICK["nodes"]
+    assert w == QUICK["window_sets"] * QUICK["set_cap"]
+    assert state.backlog.score.shape == (QUICK["backlog_sets"],
+                                         QUICK["set_cap"])
+    assert cfg.max_element_poll == w
+    assert not cfg.gossip
+    assert NORTH_STAR["backlog_sets"] * NORTH_STAR["set_cap"] == 1_000_000
+
+
+def test_tracking_flag_only_changes_the_plane():
+    on, _ = northstar_state(**QUICK)
+    off, _ = northstar_state(**QUICK, track_finality=False)
+    assert off.dag.base.finalized_at is None
+    import dataclasses
+    nulled = on._replace(dag=dataclasses.replace(
+        on.dag, base=on.dag.base._replace(finalized_at=None)))
+    la, lb = _leaves(nulled), _leaves(off)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(x, y)
