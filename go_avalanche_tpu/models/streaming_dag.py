@@ -202,13 +202,36 @@ def _retire_and_refill(
     The `models/backlog` scheduler at set granularity: one cumsum for the
     slot->backlog-set assignment, one row-scatter per output plane.
     Returns (new_state, sets retired).
+
+    With `cfg.stream_retire_cap` set, at most that many set-slots
+    retire+refill per round, and only THEIR window columns are rewritten
+    (gather + scatter over <= cap*c columns) instead of a full-plane
+    `where` per record plane — the scheduler's [N, W] traffic drops from
+    every-element-every-round to proportional-to-churn (PERF_NOTES.md
+    "Streaming step traffic split").  Over-cap slots simply stay settled
+    and retire on a later round, so any cap is live; when a round's
+    settled+empty slots fit the cap, the trajectory is bit-identical to
+    the dense path.  The end-of-run harvest (`refill=False`) always runs
+    dense so no settled slot is left unrecorded.
     """
     base = state.dag.base
-    w = base.records.votes.shape[1]
+    n, w = base.records.votes.shape
     c = set_capacity(state)
     s_w = w // c
     s_b = state.backlog.score.shape[0]
     settled = _settled_set_slots(state, cfg)
+    empty = state.slot_set == NO_SET
+    cap = cfg.stream_retire_cap
+    sparse = refill and cap is not None
+    if sparse:
+        k_slots = min(cap, s_w)
+        pool = settled | empty   # slots that could retire or admit
+        participate = pool & (jnp.cumsum(pool.astype(jnp.int32)) - 1
+                              < k_slots)
+        settled = settled & participate
+        free = participate
+    else:
+        free = settled | empty
 
     # --- retire: member outcomes at the retiring sets' backlog rows.
     conf = base.records.confidence
@@ -235,7 +258,6 @@ def _retire_and_refill(
     )
 
     # --- refill: free set-slots take the next backlog sets in order.
-    free = settled | (state.slot_set == NO_SET)
     rank = jnp.cumsum(free.astype(jnp.int32)) - 1
     cand = state.next_idx + rank
     take = free & (cand < s_b)
@@ -246,35 +268,83 @@ def _retire_and_refill(
     n_taken = take.sum().astype(jnp.int32)
 
     cand_safe = jnp.clip(cand, 0, s_b - 1)
-    pref_w = state.backlog.init_pref[cand_safe].reshape(w)      # [W]
-    take_w = jnp.repeat(take, c)                                # [W]
-    # Fresh record values are row-constant (every node seeds a re-admitted
-    # column identically): build them at [1, W] and let the fill `where`
-    # broadcast.  (Cost analysis shows XLA fused the explicit [N, W]
-    # broadcast this replaces, so this is clarity, not traffic —
-    # PERF_NOTES.md.)
-    fresh = vr.init_state(pref_w[None, :])
-
-    def fill(plane, fresh_plane):
-        return jnp.where(take_w[None, :], fresh_plane, plane)
-
-    records = vr.VoteRecordState(
-        votes=fill(base.records.votes, fresh.votes),
-        consider=fill(base.records.consider, fresh.consider),
-        confidence=fill(base.records.confidence, fresh.confidence),
-    )
+    pref_rows = state.backlog.init_pref[cand_safe]               # [S_w, c]
+    take_w = jnp.repeat(take, c)                                 # [W]
     occupied_after_w = jnp.repeat(new_set != NO_SET, c)
-    # Admission seeds every node (the reference example feeds every tx to
-    # every node up front, `main.go:49-53`); retired slots clear.
-    added = jnp.where(take_w[None, :], True,
-                      base.added & occupied_after_w[None, :])
+
+    if sparse:
+        # Columns of slots that actually change: retiring (clear) or
+        # admitting (fresh seed).  take ⊆ free and settled ⊆ free, so the
+        # static bound k_slots holds; fill rows land at slot id s_w =>
+        # column >= W => scatter mode="drop".
+        changed = settled | take
+        slot_ids = jnp.nonzero(changed, size=k_slots,
+                               fill_value=s_w)[0]                # [K]
+        sid_safe = jnp.minimum(slot_ids, s_w - 1)
+        cols = (slot_ids[:, None].astype(jnp.int32) * c
+                + jnp.arange(c, dtype=jnp.int32)[None, :]).reshape(-1)
+        cols_safe = jnp.minimum(cols, w - 1)
+        take_cols = jnp.repeat(take[sid_safe], c)                # [K*c]
+        fresh = vr.init_state(pref_rows[sid_safe].reshape(-1)[None, :])
+
+        def fill_cols(plane, fresh_plane):
+            # Admitted columns seed fresh (row-constant); retiring-only
+            # columns write their old values back (records of cleared
+            # slots are dead: added/valid mask them out of every poll).
+            upd = jnp.where(take_cols[None, :], fresh_plane,
+                            plane[:, cols_safe])
+            return plane.at[:, cols].set(upd.astype(plane.dtype),
+                                         mode="drop")
+
+        records = vr.VoteRecordState(
+            votes=fill_cols(base.records.votes, fresh.votes),
+            consider=fill_cols(base.records.consider, fresh.consider),
+            confidence=fill_cols(base.records.confidence, fresh.confidence),
+        )
+        # Admission seeds every node (the reference example feeds every tx
+        # to every node up front, `main.go:49-53`); retired slots clear.
+        # Unchanged empty slots are already False (cleared when retired),
+        # so touching only changed columns preserves the dense result.
+        added = base.added.at[:, cols].set(
+            jnp.broadcast_to(take_cols[None, :], (n, k_slots * c)),
+            mode="drop")
+        if base.finalized_at is None:
+            finalized_at = None
+        else:   # dense resets stamps only at re-admitted columns
+            fa_upd = jnp.where(take_cols[None, :], jnp.int32(-1),
+                               base.finalized_at[:, cols_safe])
+            finalized_at = base.finalized_at.at[:, cols].set(fa_upd,
+                                                             mode="drop")
+    else:
+        pref_w = pref_rows.reshape(w)                            # [W]
+        # Fresh record values are row-constant (every node seeds a
+        # re-admitted column identically): build them at [1, W] and let
+        # the fill `where` broadcast.  (Cost analysis shows XLA fused the
+        # explicit [N, W] broadcast this replaces, so this is clarity,
+        # not traffic — PERF_NOTES.md.)
+        fresh = vr.init_state(pref_w[None, :])
+
+        def fill(plane, fresh_plane):
+            return jnp.where(take_w[None, :], fresh_plane, plane)
+
+        records = vr.VoteRecordState(
+            votes=fill(base.records.votes, fresh.votes),
+            consider=fill(base.records.consider, fresh.consider),
+            confidence=fill(base.records.confidence, fresh.confidence),
+        )
+        # Admission seeds every node (the reference example feeds every tx
+        # to every node up front, `main.go:49-53`); retired slots clear.
+        added = jnp.where(take_w[None, :], True,
+                          base.added & occupied_after_w[None, :])
+        finalized_at = av.reset_finality(base.finalized_at, take_w)
+
     safe_rows = jnp.clip(new_set, 0, s_b - 1)
-    valid = jnp.where(take_w, state.backlog.valid[cand_safe].reshape(w),
+    valid = jnp.where(take_w,
+                      state.backlog.valid[cand_safe].reshape(w),
                       base.valid & occupied_after_w)
     score = jnp.where(occupied_after_w,
                       state.backlog.score[safe_rows].reshape(w),
                       jnp.int32(-2**31 + 1))
-    finalized_at = av.reset_finality(base.finalized_at, take_w)
 
     new_base = base._replace(
         records=records,
@@ -390,6 +460,8 @@ def run_chunked(
     chunk: int = 256,
     checkpoint_path: Optional[str] = None,
     checkpoint_every_chunks: int = 8,
+    checkpoint_fetch_bytes: Optional[int] = 64 << 20,
+    checkpoint_fetch_timeout_s: Optional[float] = 120.0,
     progress=None,
 ) -> StreamingDagState:
     """`run`, dispatched from the host in `chunk`-round device calls.
@@ -417,6 +489,18 @@ def run_chunked(
     file exists when this function does.  `progress`, if given, is called
     after every chunk with ``(rounds_done, state)`` — the hook the
     baseline suite uses to log drain rate.
+
+    Each save streams the state in `checkpoint_fetch_bytes`-sized
+    transfers with a `checkpoint_fetch_timeout_s` deadline per transfer
+    (`save_checkpoint`'s bounded-fetch mode): the round-4 outage was a
+    process killed mid-way through one monolithic 1.9 GB fetch, which
+    wedged the tunnel for >10 h.  A timed-out or otherwise failed save is
+    logged and *dropped* — the run keeps its previous checkpoint and keeps
+    computing.  A save failure only surfaces as an exception if the run
+    finishes with NO checkpoint successfully written at all and a final
+    synchronous retry also fails; otherwise it is reported as a warning so
+    a completed computation is never thrown away over a stale-by-one
+    checkpoint (the finished state is in the caller's hands anyway).
     """
     if chunk < 1:
         raise ValueError(f"chunk must be >= 1, got {chunk}")
@@ -424,20 +508,32 @@ def run_chunked(
         raise ValueError("checkpoint_every_chunks must be >= 1, got "
                          f"{checkpoint_every_chunks}")
     import threading
+    import warnings
 
     from go_avalanche_tpu.utils.checkpoint import save_checkpoint
 
     saver: Optional[threading.Thread] = None
-    save_error: list = []
+    save_errors: list = []
+    saves_ok = [0]
+
+    def _do_save(snapshot):
+        save_checkpoint(checkpoint_path, snapshot,
+                        max_fetch_bytes=checkpoint_fetch_bytes,
+                        fetch_timeout_s=checkpoint_fetch_timeout_s)
+        saves_ok[0] += 1
 
     def _save(snapshot):
         # Capture failures: a daemon thread's exception otherwise only
-        # prints to stderr, and the run would return claiming a checkpoint
-        # it never wrote.
+        # prints to stderr.  A failed save costs a checkpoint, not the run
+        # — the next boundary just tries again with fresher state.
         try:
-            save_checkpoint(checkpoint_path, snapshot)
-        except Exception as e:  # noqa: BLE001 — re-raised at join below
-            save_error.append(e)
+            _do_save(snapshot)
+        except Exception as e:  # noqa: BLE001 — surfaced at completion
+            save_errors.append(e)
+            if len(save_errors) == 1:  # first failure: say so now, in-run
+                warnings.warn(f"checkpoint save failed (run continues, "
+                              f"will retry next boundary): {e!r}",
+                              RuntimeWarning, stacklevel=2)
 
     try:
         chunks_done = 0
@@ -452,8 +548,6 @@ def run_chunked(
             if (checkpoint_path
                     and chunks_done % checkpoint_every_chunks == 0
                     and (saver is None or not saver.is_alive())):
-                if save_error:
-                    raise save_error[0]
                 saver = threading.Thread(target=_save, args=(state,),
                                          daemon=True)
                 saver.start()
@@ -464,8 +558,21 @@ def run_chunked(
         # save_checkpoint to the same tmp path.
         if saver is not None:
             saver.join()
-    if save_error:
-        raise save_error[0]
+    if checkpoint_path and save_errors:
+        if saves_ok[0] == 0:
+            # Nothing on disk from this run: one synchronous retry, and
+            # only if that also fails does the failure become fatal —
+            # the caller asked for resumability it never got.
+            try:
+                _do_save(state)
+            except Exception as e:  # noqa: BLE001
+                raise e from save_errors[0]
+        if saves_ok[0] > 0 and save_errors:
+            warnings.warn(
+                f"run completed; {len(save_errors)} checkpoint save(s) "
+                f"failed and were dropped (last: {save_errors[-1]!r}); "
+                f"latest successful checkpoint kept at {checkpoint_path}",
+                RuntimeWarning, stacklevel=2)
     final, _ = _retire_and_refill(state, cfg, refill=False)
     return final
 

@@ -23,7 +23,7 @@ Two interchangeable backends, same pytree/template contract:
 from __future__ import annotations
 
 import os
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import numpy as np
@@ -31,13 +31,102 @@ import numpy as np
 _KEY_PREFIX = "__prngkey__"
 
 
+class CheckpointFetchTimeout(TimeoutError):
+    """A bounded device→host fetch missed its deadline.
+
+    Raised by `save_checkpoint(..., fetch_timeout_s=...)` so the caller can
+    abort the *save* and keep the run alive — a wedged tunnel must cost a
+    checkpoint, never the simulation (the round-4 outage was triggered by a
+    process killed mid-way through a 1.9 GB monolithic fetch;
+    `benchmarks/PERF_NOTES.md`).
+    """
+
+
 def _is_key(leaf: Any) -> bool:
     return isinstance(leaf, jax.Array) and jax.dtypes.issubdtype(
         leaf.dtype, jax.dtypes.prng_key)
 
 
-def save_checkpoint(path: str, state: Any) -> None:
-    """Save any simulator state pytree to `path` (.npz)."""
+def _fetch(arr: Any, timeout_s: Optional[float]) -> np.ndarray:
+    """`jax.device_get` with an optional deadline.
+
+    The fetch runs on a throwaway *daemon* thread (not a pool:
+    `ThreadPoolExecutor` workers are non-daemon and joined at interpreter
+    exit, so one wedged transfer would hang process shutdown — the exact
+    failure mode this exists to contain).  On timeout the worker stays
+    blocked on the dead transfer (it cannot be cancelled) and is simply
+    orphaned; the caller's thread is never the one stuck.
+    """
+    if timeout_s is None:
+        return np.asarray(jax.device_get(arr))
+    import threading
+
+    box: list = []
+
+    def work() -> None:
+        try:
+            box.append(("ok", np.asarray(jax.device_get(arr))))
+        except Exception as e:  # noqa: BLE001 — re-raised in the caller
+            box.append(("err", e))
+
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if not box:
+        raise CheckpointFetchTimeout(
+            f"device→host fetch of {getattr(arr, 'nbytes', '?')} bytes "
+            f"exceeded {timeout_s}s — aborting this save (run continues)")
+    kind, val = box[0]
+    if kind == "err":
+        raise val
+    return val
+
+
+def _fetch_leaf(
+    leaf: Any,
+    max_fetch_bytes: Optional[int],
+    fetch_timeout_s: Optional[float],
+) -> np.ndarray:
+    """Materialize one leaf on host, never moving more than
+    `max_fetch_bytes` per transfer.
+
+    Oversized leaves are sliced on-device along axis 0 in row blocks, so the
+    tunnel sees a sequence of bounded transfers instead of one monolithic
+    fetch, and each block independently gets the `fetch_timeout_s` deadline.
+    """
+    if not isinstance(leaf, jax.Array):
+        return np.asarray(leaf)
+    nbytes = leaf.size * leaf.dtype.itemsize
+    if (max_fetch_bytes is None or nbytes <= max_fetch_bytes
+            or leaf.ndim == 0 or leaf.shape[0] <= 1):
+        return _fetch(leaf, fetch_timeout_s)
+    row_bytes = max(1, nbytes // leaf.shape[0])
+    rows_per_block = max(1, max_fetch_bytes // row_bytes)
+    out = np.empty(leaf.shape, dtype=leaf.dtype)
+    for lo in range(0, leaf.shape[0], rows_per_block):
+        hi = min(lo + rows_per_block, leaf.shape[0])
+        out[lo:hi] = _fetch(leaf[lo:hi], fetch_timeout_s)
+    return out
+
+
+def save_checkpoint(
+    path: str,
+    state: Any,
+    *,
+    max_fetch_bytes: Optional[int] = None,
+    fetch_timeout_s: Optional[float] = None,
+) -> None:
+    """Save any simulator state pytree to `path` (.npz).
+
+    `max_fetch_bytes` bounds every single device→host transfer: leaves
+    bigger than the cap are pulled in row blocks sliced on-device, so a
+    north-star-scale state (~1.9 GB of `[N, W]` planes) streams through the
+    tunnel as e.g. 64 MB pieces instead of one monolithic fetch — the
+    documented round-4 outage trigger.  `fetch_timeout_s` puts a deadline on
+    each transfer; a miss raises `CheckpointFetchTimeout` *before* anything
+    is written, so the partial save is simply discarded and the caller's run
+    continues.  Defaults (`None`) keep the original unbounded behavior.
+    """
     leaves, _ = jax.tree_util.tree_flatten(state)
     payload = {"__leaf_count__": np.asarray(len(leaves))}
     for i, leaf in enumerate(leaves):
@@ -45,7 +134,8 @@ def save_checkpoint(path: str, state: Any) -> None:
             payload[f"{_KEY_PREFIX}{i}"] = np.asarray(
                 jax.random.key_data(leaf))
         else:
-            payload[f"leaf_{i}"] = np.asarray(jax.device_get(leaf))
+            payload[f"leaf_{i}"] = _fetch_leaf(
+                leaf, max_fetch_bytes, fetch_timeout_s)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         np.savez(f, **payload)

@@ -169,6 +169,109 @@ def test_streaming_dag_state_roundtrips(tmp_path):
     assert np.asarray(fin_a.outputs.settled).all()
 
 
+# ---------------------------------------------------------------------------
+# Bounded-fetch save path (the round-4 outage was a process killed mid-way
+# through one monolithic 1.9 GB device->host checkpoint fetch; saves now
+# stream in capped transfers with a per-transfer deadline)
+
+
+def test_bounded_fetch_save_bit_identical(tmp_path):
+    """Streaming the state out in tiny row blocks must produce the exact
+    same checkpoint as the monolithic fetch."""
+    cfg = AvalancheConfig()
+    state = av.init(jax.random.key(2), 64, 32, cfg)
+    a, b = str(tmp_path / "a.npz"), str(tmp_path / "b.npz")
+    save_checkpoint(a, state)
+    # 256-byte cap => the [64, W] planes stream in ~2-row blocks.
+    save_checkpoint(b, state, max_fetch_bytes=256, fetch_timeout_s=30.0)
+    tmpl = lambda: av.init(jax.random.key(0), 64, 32, cfg)  # noqa: E731
+    assert_states_equal(restore_checkpoint(a, tmpl()),
+                        restore_checkpoint(b, tmpl()))
+    assert_states_equal(state, restore_checkpoint(b, tmpl()))
+
+
+def test_fetch_timeout_aborts_save_before_any_write(tmp_path, monkeypatch):
+    """A transfer missing its deadline raises CheckpointFetchTimeout and
+    leaves no file (not even a .tmp) — the save is dropped, the caller's
+    state and run are untouched."""
+    import os as _os
+    import time
+
+    from go_avalanche_tpu.utils import checkpoint as ckpt
+
+    cfg = AvalancheConfig()
+    state = av.init(jax.random.key(0), 16, 8, cfg)
+    real = jax.device_get
+    monkeypatch.setattr(jax, "device_get",
+                        lambda x: (time.sleep(0.5), real(x))[1])
+    p = str(tmp_path / "t.npz")
+    with pytest.raises(ckpt.CheckpointFetchTimeout):
+        ckpt.save_checkpoint(p, state, fetch_timeout_s=0.05)
+    assert not _os.path.exists(p)
+    assert not _os.path.exists(p + ".tmp")
+
+
+def test_run_chunked_late_save_failure_warns_not_raises(tmp_path,
+                                                       monkeypatch):
+    """Once one checkpoint landed, later save failures must cost only the
+    checkpoint: the run completes, returns the final state, and reports
+    the drops as a RuntimeWarning (ADVICE r4: never discard a finished
+    computation over a stale-by-one checkpoint)."""
+    from go_avalanche_tpu.models import streaming_dag as sd
+    from go_avalanche_tpu.utils import checkpoint as ckpt
+
+    cfg = AvalancheConfig()
+    backlog = sd.make_set_backlog(
+        jnp.arange(16, dtype=jnp.int32).reshape(8, 2))
+    state = sd.init(jax.random.key(0), 12, 3, backlog, cfg)
+    calls = [0]
+    real = ckpt.save_checkpoint
+
+    def flaky(path, st, **kw):
+        calls[0] += 1
+        if calls[0] > 1:
+            raise OSError("disk full")
+        real(path, st, **kw)
+
+    monkeypatch.setattr(ckpt, "save_checkpoint", flaky)
+    path = str(tmp_path / "c.npz")
+    with pytest.warns(RuntimeWarning, match="checkpoint save"):
+        final = sd.run_chunked(state, cfg, max_rounds=2000, chunk=4,
+                               checkpoint_path=path,
+                               checkpoint_every_chunks=1)
+    assert calls[0] > 1, "test premise: at least one save failed"
+    assert np.asarray(jax.device_get(final.outputs.settled)).all()
+    assert _file_exists(path)
+
+
+def test_run_chunked_no_save_ever_lands_raises(tmp_path, monkeypatch):
+    """If *no* checkpoint ever lands and the final synchronous retry also
+    fails, the caller asked for resumability it never got — that is an
+    error, not a warning."""
+    from go_avalanche_tpu.models import streaming_dag as sd
+    from go_avalanche_tpu.utils import checkpoint as ckpt
+
+    cfg = AvalancheConfig()
+    backlog = sd.make_set_backlog(
+        jnp.arange(16, dtype=jnp.int32).reshape(8, 2))
+    state = sd.init(jax.random.key(0), 12, 3, backlog, cfg)
+
+    def broken(path, st, **kw):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(ckpt, "save_checkpoint", broken)
+    with pytest.warns(RuntimeWarning):
+        with pytest.raises(OSError, match="disk full"):
+            sd.run_chunked(state, cfg, max_rounds=2000, chunk=4,
+                           checkpoint_path=str(tmp_path / "never.npz"),
+                           checkpoint_every_chunks=1)
+
+
+def _file_exists(p):
+    import os as _os
+    return _os.path.exists(p)
+
+
 def test_cross_mode_restore_fails_with_clear_message(tmp_path):
     """A checkpoint saved with the finalized_at plane must refuse to
     restore into a track_finality=False template (and vice versa) with a
