@@ -46,6 +46,31 @@ from benchmarks.workload import (  # noqa: E402 — after the sys.path insert
 )
 
 
+def _merge_progress(path: str, **update) -> None:
+    """Atomically merge `update` into the progress JSON — monotonic.
+
+    `round` only ever increases: a resume attempt's startup beats (or its
+    early chunks, which restart from the checkpoint round, behind the last
+    pre-kill heartbeat) must never replace the best known round with less
+    information — round 4's wedged resume left `{"startup": "init"}` where
+    round 2048 used to be.  The current attempt's true position is
+    reported separately as `attempt_round`.
+    """
+    p = Path(path)
+    try:
+        prev = json.loads(p.read_text())
+    except (OSError, ValueError):
+        prev = {}
+    merged = {**prev, **update}
+    if "round" in prev:
+        merged["round"] = max(int(prev.get("round", -1)),
+                              int(update.get("round", -1)))
+    merged["ts"] = round(time.time(), 1)
+    tmp = Path(path + ".tmp")
+    tmp.write_text(json.dumps(merged) + "\n")
+    os.replace(tmp, p)  # atomic: a SIGKILL mid-write can't tear the file
+
+
 def worker(args: argparse.Namespace) -> None:
     import jax
 
@@ -63,7 +88,7 @@ def worker(args: argparse.Namespace) -> None:
         """Startup heartbeats: checkpoint restore is itself a ~100s
         device transfer, so the worker must prove liveness to the parent
         watchdog before the first chunk completes."""
-        Path(args.progress).write_text(json.dumps({"startup": note}) + "\n")
+        _merge_progress(args.progress, phase=note)
 
     beat("init")
     shape = QUICK if args.quick else FULL
@@ -80,11 +105,13 @@ def worker(args: argparse.Namespace) -> None:
     t0 = time.time()
 
     def progress(rounds, s):
-        Path(args.progress).write_text(json.dumps({
-            "round": rounds,
-            "admitted": int(jax.device_get(s.next_idx)),
-            "attempt_wall_s": round(time.time() - t0, 1),
-        }) + "\n")
+        _merge_progress(
+            args.progress,
+            round=rounds,
+            attempt_round=rounds,
+            admitted=int(jax.device_get(s.next_idx)),
+            attempt_wall_s=round(time.time() - t0, 1),
+            phase="running")
 
     # Checkpointing (async, atomic, one save in flight) lives inside
     # run_chunked — the same mechanism every caller gets.
@@ -122,11 +149,15 @@ def parent(args: argparse.Namespace) -> None:
     ckpt = str(workdir / "northstar.npz")
     progress = str(workdir / "progress.json")
     result = str(workdir / "result.json")
-    for p in (progress, result):
-        if os.path.exists(p):
-            os.unlink(p)
-    if not args.resume and os.path.exists(ckpt):
-        os.unlink(ckpt)
+    if os.path.exists(result):
+        os.unlink(result)
+    if not args.resume:
+        # A fresh run starts with a clean slate; a --resume keeps
+        # progress.json — its monotonic `round` is the best-known
+        # position and must survive however many wedged attempts.
+        for p in (progress, ckpt):
+            if os.path.exists(p):
+                os.unlink(p)
 
     # Honest wall-clock across parent restarts: a --resume continuation
     # adds to the accumulated time of the attempts that produced the
@@ -135,20 +166,28 @@ def parent(args: argparse.Namespace) -> None:
     accum = 0.0
     if args.resume and wall_file.exists():
         accum = json.loads(wall_file.read_text()).get("accum_s", 0.0)
-    def _progress_round() -> int:
-        """Latest round the worker reported; -1 before any chunk."""
+    def _progress_pos() -> tuple:
+        """(monotonic round, current-attempt round) from the heartbeat;
+        (-1, -1) before any chunk."""
         try:
-            return int(json.loads(Path(progress).read_text()).get("round",
-                                                                  -1))
-        except (OSError, ValueError, json.JSONDecodeError):
-            return -1
+            rec = json.loads(Path(progress).read_text())
+            return (int(rec.get("round", -1)),
+                    int(rec.get("attempt_round", rec.get("round", -1))))
+        except (OSError, ValueError):
+            return (-1, -1)
 
     t_start = time.time()
     attempts = 0
-    best_round = -1
     no_progress_strikes = 0
     while attempts < args.max_attempts:
         attempts += 1
+        # Progress for the strike logic is attempt-relative: `round` is
+        # monotonic across attempts (never regresses, by design), so a
+        # resumed attempt advancing BELOW the prior high-water mark —
+        # restored from an older checkpoint, genuinely moving — must be
+        # recognized by its `attempt_round` changing, not punished for
+        # failing to beat a record it hasn't reached yet.
+        pos_at_launch = _progress_pos()
         child_args = [sys.executable, os.path.abspath(__file__), "--worker",
                       f"--ckpt={ckpt}", f"--progress={progress}",
                       f"--result={result}", f"--chunk={args.chunk}",
@@ -192,16 +231,17 @@ def parent(args: argparse.Namespace) -> None:
                 _update_results(out)
             return
         # Fast-fail on DETERMINISTIC failures: a worker that exits ON ITS
-        # OWN without ever advancing a round (e.g. a checkpoint/template
+        # OWN without advancing anything (e.g. a checkpoint/template
         # structure mismatch raising at restore) will fail identically
         # forever — don't burn max_attempts x minutes of full-scale state
-        # construction on it.  Watchdog kills never count: a transient
-        # wedge can strike during the ~100s restore or before the resumed
-        # attempt re-passes the previous best round, and retrying is
-        # exactly what those cases need.
-        reached = _progress_round()
-        if reached > best_round:
-            best_round = reached
+        # construction on it.  "Advancing" means the heartbeat's position
+        # moved at all (monotonic `round` OR this attempt's
+        # `attempt_round`) — a resumed attempt working its way back up
+        # from an older checkpoint counts.  Watchdog kills never count: a
+        # transient wedge can strike during the ~100s restore, and
+        # retrying is exactly what that case needs.
+        pos_now = _progress_pos()
+        if pos_now != pos_at_launch:
             no_progress_strikes = 0
         elif not killed_by_watchdog:
             no_progress_strikes += 1
@@ -209,7 +249,7 @@ def parent(args: argparse.Namespace) -> None:
             print(json.dumps({
                 "error": f"aborting after {attempts} attempts: two "
                          f"consecutive attempts made no round progress "
-                         f"(stuck at round {best_round}) — a deterministic "
+                         f"(stuck at {pos_now}) — a deterministic "
                          f"failure (e.g. checkpoint/template mismatch) or "
                          f"a dead accelerator; retrying further would only "
                          f"repeat it. See the worker stderr above"}))
