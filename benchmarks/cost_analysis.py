@@ -54,25 +54,41 @@ def main() -> None:
     from go_avalanche_tpu.models import dag as dag_model
     from go_avalanche_tpu.models import streaming_dag as sdg
 
+    import dataclasses
+
     rows = []
     for track in (True, False):
-        state, cfg = northstar_state(
+        state, base_cfg_obj = northstar_state(
             nodes=args.nodes, backlog_sets=args.backlog_sets,
             set_cap=args.set_cap, window_sets=args.window_sets,
             track_finality=track)
+        # Capped sparse scheduler (cfg.stream_retire_cap): measured at the
+        # north-star operating point of ~6% of window slots churning per
+        # round (PERF_NOTES "Streaming step traffic split").
+        cap = max(1, args.window_sets // 16)
+        cap_cfg_obj = dataclasses.replace(base_cfg_obj,
+                                          stream_retire_cap=cap)
 
-        def full_step(s):
+        def full_step(s, cfg=base_cfg_obj):
             return sdg.step(s, cfg)[0]
 
-        def round_only(s):
+        def round_only(s, cfg=base_cfg_obj):
             return dag_model.round_step(s.dag, cfg)[0]
 
-        def retire_refill(s):
+        def retire_refill(s, cfg=base_cfg_obj):
             return sdg._retire_and_refill(s, cfg)[0]
+
+        def retire_refill_capped(s, cfg=cap_cfg_obj):
+            return sdg._retire_and_refill(s, cfg)[0]
+
+        def full_step_capped(s, cfg=cap_cfg_obj):
+            return sdg.step(s, cfg)[0]
 
         for name, fn in (("full_step", full_step),
                          ("dag_round", round_only),
-                         ("retire_refill", retire_refill)):
+                         ("retire_refill", retire_refill),
+                         ("retire_refill_capped", retire_refill_capped),
+                         ("full_step_capped", full_step_capped)):
             ca = jax.jit(fn).lower(state).compile().cost_analysis()
             if isinstance(ca, list):
                 ca = ca[0]
@@ -90,12 +106,19 @@ def main() -> None:
              "set_cap": args.set_cap, "backlog_sets": args.backlog_sets}
     if args.out:
         Path(args.out).write_text(
-            json.dumps({"config": shape}) + "\n"
+            json.dumps({"config": shape, "jax": jax.__version__}) + "\n"
             + "".join(json.dumps(r) + "\n" for r in rows))
     if args.check:
         lines = [json.loads(line) for line
                  in Path(args.check).read_text().splitlines()
                  if line.strip()]
+        header = lines[0] if lines and "config" in lines[0] else {}
+        base_jax = header.get("jax")
+        # Version drift softens ENFORCEMENT only (ADVICE r4: an upstream
+        # jax release must not fail CI here) — the shape check and the
+        # per-program delta report still run either way, so a regression
+        # stays visible in the log even when not enforced.
+        enforce = base_jax is None or base_jax == jax.__version__
         base_cfg = (lines[0].get("config")
                     if lines and "config" in lines[0] else None)
         if base_cfg is not None and base_cfg != shape:
@@ -125,9 +148,18 @@ def main() -> None:
         if failures:
             print("TRAFFIC REGRESSION vs " + args.check + ":\n  "
                   + "\n  ".join(failures), file=sys.stderr)
-            sys.exit(1)
-        print(f"traffic within {args.tolerance:.0%} of {args.check}",
-              file=sys.stderr)
+            if enforce:
+                sys.exit(1)
+            print(f"NOT ENFORCED: baseline recorded with jax {base_jax}, "
+                  f"running {jax.__version__} — cost-model drift expected; "
+                  f"refresh the baseline with --out on the new version.",
+                  file=sys.stderr)
+        else:
+            print(f"traffic within {args.tolerance:.0%} of {args.check}"
+                  + ("" if enforce else
+                     f" (jax {base_jax} baseline vs {jax.__version__} — "
+                     f"informational only)"),
+                  file=sys.stderr)
 
 
 if __name__ == "__main__":

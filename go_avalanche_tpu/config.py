@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+from typing import Optional
 
 
 class VoteMode(enum.Enum):
@@ -110,6 +111,16 @@ class AvalancheConfig:
                                       #   equal-size clusters / uniform base
     gossip: bool = True
     strict_validation: bool = False
+    stream_retire_cap: Optional[int] = None
+                                      # streaming_dag scheduler: cap the
+                                      #   set-slots retired+refilled per
+                                      #   round and update only their
+                                      #   window columns (scatter) instead
+                                      #   of rewriting every [N, W] record
+                                      #   plane; over-cap slots defer one
+                                      #   round (they stay settled).  None
+                                      #   = dense rewrite (exact legacy
+                                      #   trajectory).  See PERF_NOTES.md.
 
     # --- fault / adversary model (SURVEY.md section 2.4 item 5) ---
     byzantine_fraction: float = 0.0   # nodes that vote adversarially
@@ -146,6 +157,9 @@ class AvalancheConfig:
             raise ValueError("cluster_locality must be in [0, 1]")
         if not (0.5 < self.alpha <= 1.0):
             raise ValueError("alpha must be in (0.5, 1.0]")
+        if self.stream_retire_cap is not None and self.stream_retire_cap < 1:
+            raise ValueError("stream_retire_cap must be >= 1 (None "
+                             "disables the cap)")
 
 
 DEFAULT_CONFIG = AvalancheConfig()

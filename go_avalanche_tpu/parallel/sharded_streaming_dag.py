@@ -149,10 +149,31 @@ def _local_retire_and_refill(
     """The set-granular scheduler pass on one shard; see
     `models/streaming_dag`.  Returns (new_state, globally-retired sets)."""
     base = state.dag.base
-    w_local = base.records.votes.shape[1]
+    n_local, w_local = base.records.votes.shape
     s_w_local = w_local // c
     s_b = state.backlog.score.shape[0]
     settled = _local_settled_sets(state, cfg, c)
+    empty = state.slot_set == NO_SET
+    cap = cfg.stream_retire_cap
+    sparse = refill and cap is not None
+    tshard = lax.axis_index(TXS_AXIS)
+    if sparse:
+        # Same capped/column-scatter scheduler as the unsharded model
+        # (`models/streaming_dag._retire_and_refill`), with the
+        # participation rank made global: shards hold contiguous slot
+        # ranges, so an exclusive prefix of pool counts over the txs axis
+        # reproduces the unsharded cumsum order bit-for-bit.
+        k_local = min(cap, s_w_local)
+        pool = settled | empty
+        pcounts = lax.all_gather(pool.sum().astype(jnp.int32), TXS_AXIS)
+        pprefix = jnp.where(jnp.arange(pcounts.shape[0]) < tshard,
+                            pcounts, 0).sum()
+        grank = pprefix + jnp.cumsum(pool.astype(jnp.int32)) - 1
+        participate = pool & (grank < cap)
+        settled = settled & participate
+        free = participate
+    else:
+        free = settled | empty
 
     # --- retire: member outcomes; node-axis sums via psum so every node
     # shard computes identical [w_local] planes.
@@ -183,10 +204,8 @@ def _local_retire_and_refill(
     )
 
     # --- refill: global admission rank = exclusive prefix over tx shards.
-    free = settled | (state.slot_set == NO_SET)
     count_local = free.sum().astype(jnp.int32)
     counts = lax.all_gather(count_local, TXS_AXIS)
-    tshard = lax.axis_index(TXS_AXIS)
     prefix = jnp.where(jnp.arange(counts.shape[0]) < tshard,
                        counts, 0).sum()
     rank = prefix + jnp.cumsum(free.astype(jnp.int32)) - 1
@@ -199,24 +218,65 @@ def _local_retire_and_refill(
     n_taken = lax.psum(take.sum().astype(jnp.int32), TXS_AXIS)
 
     cand_safe = jnp.clip(cand, 0, s_b - 1)
-    pref_w = state.backlog.init_pref[cand_safe].reshape(w_local)
+    pref_rows = state.backlog.init_pref[cand_safe]        # [s_w_local, c]
     take_w = jnp.repeat(take, c)
-    # Row-constant fresh values at [1, W]; the fill `where` broadcasts.
-    # (Cost analysis shows XLA fused the explicit [N, W] broadcast this
-    # replaces, so this is clarity, not traffic — PERF_NOTES.md.)
-    fresh = vr.init_state(pref_w[None, :])
-
-    def fill(plane, fresh_plane):
-        return jnp.where(take_w[None, :], fresh_plane, plane)
-
-    records = vr.VoteRecordState(
-        votes=fill(base.records.votes, fresh.votes),
-        consider=fill(base.records.consider, fresh.consider),
-        confidence=fill(base.records.confidence, fresh.confidence),
-    )
     occupied_after_w = jnp.repeat(new_set != NO_SET, c)
-    added = jnp.where(take_w[None, :], True,
-                      base.added & occupied_after_w[None, :])
+
+    if sparse:
+        # Column-scatter plane updates; see the unsharded model for the
+        # invariant arguments (cleared slots keep dead records, unchanged
+        # empty slots are already added=False).
+        changed = settled | take
+        slot_ids = jnp.nonzero(changed, size=k_local,
+                               fill_value=s_w_local)[0]
+        sid_safe = jnp.minimum(slot_ids, s_w_local - 1)
+        cols = (slot_ids[:, None].astype(jnp.int32) * c
+                + jnp.arange(c, dtype=jnp.int32)[None, :]).reshape(-1)
+        cols_safe = jnp.minimum(cols, w_local - 1)
+        take_cols = jnp.repeat(take[sid_safe], c)
+        fresh = vr.init_state(pref_rows[sid_safe].reshape(-1)[None, :])
+
+        def fill_cols(plane, fresh_plane):
+            upd = jnp.where(take_cols[None, :], fresh_plane,
+                            plane[:, cols_safe])
+            return plane.at[:, cols].set(upd.astype(plane.dtype),
+                                         mode="drop")
+
+        records = vr.VoteRecordState(
+            votes=fill_cols(base.records.votes, fresh.votes),
+            consider=fill_cols(base.records.consider, fresh.consider),
+            confidence=fill_cols(base.records.confidence,
+                                 fresh.confidence),
+        )
+        added = base.added.at[:, cols].set(
+            jnp.broadcast_to(take_cols[None, :], (n_local, k_local * c)),
+            mode="drop")
+        if base.finalized_at is None:
+            finalized_at = None
+        else:
+            fa_upd = jnp.where(take_cols[None, :], jnp.int32(-1),
+                               base.finalized_at[:, cols_safe])
+            finalized_at = base.finalized_at.at[:, cols].set(fa_upd,
+                                                             mode="drop")
+    else:
+        pref_w = pref_rows.reshape(w_local)
+        # Row-constant fresh values at [1, W]; the fill `where` broadcasts.
+        # (Cost analysis shows XLA fused the explicit [N, W] broadcast this
+        # replaces, so this is clarity, not traffic — PERF_NOTES.md.)
+        fresh = vr.init_state(pref_w[None, :])
+
+        def fill(plane, fresh_plane):
+            return jnp.where(take_w[None, :], fresh_plane, plane)
+
+        records = vr.VoteRecordState(
+            votes=fill(base.records.votes, fresh.votes),
+            consider=fill(base.records.consider, fresh.consider),
+            confidence=fill(base.records.confidence, fresh.confidence),
+        )
+        added = jnp.where(take_w[None, :], True,
+                          base.added & occupied_after_w[None, :])
+        finalized_at = av.reset_finality(base.finalized_at, take_w)
+
     safe_rows = jnp.clip(new_set, 0, s_b - 1)
     valid = jnp.where(take_w,
                       state.backlog.valid[cand_safe].reshape(w_local),
@@ -224,7 +284,6 @@ def _local_retire_and_refill(
     score = jnp.where(occupied_after_w,
                       state.backlog.score[safe_rows].reshape(w_local),
                       jnp.int32(-2**31 + 1))
-    finalized_at = av.reset_finality(base.finalized_at, take_w)
 
     new_base = base._replace(
         records=records,

@@ -57,6 +57,7 @@ def build_config(args: argparse.Namespace) -> AvalancheConfig:
         adversary_strategy=AdversaryStrategy(args.adversary),
         drop_probability=args.drop,
         churn_probability=args.churn,
+        stream_retire_cap=getattr(args, "stream_retire_cap", None),
     )
 
 
@@ -227,6 +228,17 @@ def run_streaming_dag(args, cfg: AvalancheConfig) -> Dict:
         final = sdg.run_chunked(state, cfg, max_rounds=args.max_rounds,
                                 chunk=args.chunk,
                                 checkpoint_path=args.checkpoint)
+        if args.checkpoint and bool(jax.device_get(sdg.drained(final, cfg))):
+            # Drained: remove the checkpoint so rerunning the same command
+            # starts a fresh simulation instead of silently resuming (and
+            # instantly "finishing") the completed one.  A max_rounds-capped
+            # run keeps its checkpoint — resuming that is the point.
+            import os
+
+            try:
+                os.remove(args.checkpoint)
+            except FileNotFoundError:
+                pass
     else:
         final = jax.jit(sdg.run, static_argnames=("cfg", "max_rounds"))(
             state, cfg, args.max_rounds)
@@ -343,6 +355,12 @@ def main(argv=None) -> Dict:
                         help="streaming_dag with --chunk: save state here "
                              "at chunk boundaries and resume from it if it "
                              "exists")
+    parser.add_argument("--stream-retire-cap", type=int, default=None,
+                        metavar="SETS",
+                        help="streaming_dag: cap set-slots retired+refilled "
+                             "per round and rewrite only their window "
+                             "columns (experimental; default dense rewrite "
+                             "— see PERF_NOTES.md)")
     # output / tooling
     parser.add_argument("--json", action="store_true",
                         help="emit one JSON line instead of key=value text")
@@ -357,7 +375,8 @@ def main(argv=None) -> Dict:
     if args.chunk and args.model != "streaming_dag":
         parser.error("--chunk is a streaming_dag option")
     if args.chunk < 0:
-        parser.error("--chunk must be positive")
+        parser.error("--chunk must be >= 0 (0, the default, disables "
+                     "chunking)")
     if args.chunk and args.mesh:
         parser.error("--chunk and --mesh are mutually exclusive (the "
                      "sharded backend has its own dispatch loop)")

@@ -157,7 +157,13 @@ def test_cli_streaming_dag_chunked_matches_single_dispatch(capsys, tmp_path):
     chunked = main(args + ["--chunk", "1", "--checkpoint", ckpt])
     ref.pop("elapsed_s"), chunked.pop("elapsed_s")   # wall-clock differs
     assert chunked == ref
-    assert (tmp_path / "cli_stream.npz").exists()
+    # A drained run removes its checkpoint (ADVICE r4): rerunning the same
+    # command starts a fresh simulation instead of silently resuming the
+    # finished state and reporting a near-instant result.
+    assert not (tmp_path / "cli_stream.npz").exists()
+    rerun = main(args + ["--chunk", "1", "--checkpoint", ckpt])
+    rerun.pop("elapsed_s")
+    assert rerun == ref
 
 
 def test_cli_chunk_flag_validation():
