@@ -1,0 +1,98 @@
+"""Real multi-process `jax.distributed` smoke for `parallel/runtime.py`.
+
+Every mesh test in the suite runs single-process over virtual devices;
+this worker is the one place the ACTUAL multi-host branch of
+`initialize_runtime` (`jax.distributed.initialize` + cross-process
+coordination) executes: N processes, each with its own CPU devices, form
+one global device set, build the runtime mesh, run one sharded avalanche
+round, and cross-check the psum'd telemetry.  The reference has no
+distributed backend at all (SURVEY.md §5) — this is the scale-out path's
+minimal execution proof, runnable anywhere:
+
+    # terminal 1                       # terminal 2
+    python -m go_avalanche_tpu.parallel.distributed_smoke \
+        --coordinator 127.0.0.1:9911 --num-processes 2 --process-id 0
+    ...same with --process-id 1
+
+`tests/test_runtime.py::test_two_process_distributed_smoke` spawns both.
+
+Prints ONE JSON line per process; assertions raise (nonzero exit) on any
+cross-process disagreement.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--coordinator", required=True,
+                        help="host:port of process 0's coordination service")
+    parser.add_argument("--num-processes", type=int, required=True)
+    parser.add_argument("--process-id", type=int, required=True)
+    parser.add_argument("--local-devices", type=int, default=4)
+    args = parser.parse_args(argv)
+
+    # Per-process virtual CPU devices must be configured before the
+    # backend initializes (same mechanism as tests/conftest.py).
+    flags = os.environ.get("XLA_FLAGS", "")
+    os.environ["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count="
+        f"{args.local_devices}").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # the accelerator
+    # sitecustomize overrides the env var; pin via config after import.
+
+    from go_avalanche_tpu.parallel.runtime import (
+        build_on_mesh,
+        initialize_runtime,
+        make_runtime_mesh,
+    )
+
+    pid = initialize_runtime(args.coordinator, args.num_processes,
+                             args.process_id)
+    assert pid == args.process_id, (pid, args.process_id)
+    assert jax.process_count() == args.num_processes
+    n_dev = jax.device_count()
+    assert n_dev == args.num_processes * args.local_devices, n_dev
+    assert len(jax.local_devices()) == args.local_devices
+
+    from go_avalanche_tpu.config import AvalancheConfig
+    from go_avalanche_tpu.models import avalanche as av
+    from go_avalanche_tpu.parallel import sharded
+
+    mesh = make_runtime_mesh(n_tx_shards=2)
+    cfg = AvalancheConfig()
+    # Deterministic construction traced identically on every process and
+    # compiled INTO the global sharding (device_put onto non-addressable
+    # shardings is illegal multi-host; see runtime.build_on_mesh).
+    state = build_on_mesh(
+        lambda: av.init(jax.random.key(0), 16, 8, cfg), mesh,
+        sharded.state_specs(track_finality=True))
+    step = sharded.make_sharded_round_step(mesh, cfg)
+    state, tel = step(state)
+    state, tel = step(state)
+
+    # Telemetry scalars are psum-replicated across the whole mesh: every
+    # process must read the same values, or the collective layout is
+    # broken.
+    digest = {
+        "process": pid,
+        "processes": jax.process_count(),
+        "devices": n_dev,
+        "round": int(jax.device_get(state.round)),
+        "polls": int(jax.device_get(tel.polls)),
+        "votes_applied": int(jax.device_get(tel.votes_applied)),
+    }
+    assert digest["round"] == 2, digest
+    assert digest["polls"] > 0, digest
+    print(json.dumps(digest), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,6 +56,23 @@ def initialize_runtime(
     return jax.process_index()
 
 
+def build_on_mesh(make_fn, mesh: Mesh, specs):
+    """Construct a state pytree directly into its mesh placement.
+
+    Multi-host-safe replacement for the `shard_state` pattern
+    (`jax.device_put` onto a sharding that spans other processes is
+    illegal): `make_fn` is traced once and compiled with the target
+    shardings as `out_shardings`, so every process materializes exactly
+    its addressable shards — no host-global array ever exists.
+    `make_fn` must be deterministic (same trace on every process) and
+    `specs` a matching pytree of `PartitionSpec`s.
+    """
+    from jax.sharding import NamedSharding
+
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
+    return jax.jit(make_fn, out_shardings=shardings)()
+
+
 def _slice_index(d: jax.Device) -> int:
     """Slice id of a device; 0 when the platform has no slice concept."""
     return getattr(d, "slice_index", 0) or 0

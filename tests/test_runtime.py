@@ -120,3 +120,54 @@ def test_streaming_dag_runs_on_runtime_mesh(monkeypatch):
     jax.block_until_ready(new_state)
     assert int(new_state.dag.base.round) == 1
     assert int(tel.occupied_sets) == window_sets
+
+
+@pytest.mark.slow
+def test_two_process_distributed_smoke(tmp_path):
+    """The ONLY place `initialize_runtime`'s `jax.distributed.initialize`
+    branch actually executes (every other mesh test is single-process over
+    virtual devices): two real processes form one 8-device global set,
+    run two sharded rounds, and must report identical psum'd telemetry.
+    VERDICT r4 item 6."""
+    import json
+    import socket
+    import subprocess
+    import sys as _sys
+
+    with socket.socket() as s:   # free port for the coordination service
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    env = dict(**__import__("os").environ)
+    env.pop("XLA_FLAGS", None)   # the worker sets its own device count
+    procs = [
+        subprocess.Popen(
+            [_sys.executable, "-m",
+             "go_avalanche_tpu.parallel.distributed_smoke",
+             "--coordinator", f"127.0.0.1:{port}",
+             "--num-processes", "2", "--process-id", str(i),
+             "--local-devices", "4"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+            cwd=str(__import__("pathlib").Path(__file__).resolve()
+                    .parent.parent))
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+    assert {o["process"] for o in outs} == {0, 1}
+    for o in outs:
+        assert o["processes"] == 2
+        assert o["devices"] == 8
+        assert o["round"] == 2
+    # psum-replicated telemetry must agree across processes exactly.
+    assert outs[0]["polls"] == outs[1]["polls"] > 0
+    assert outs[0]["votes_applied"] == outs[1]["votes_applied"]
