@@ -62,16 +62,15 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
           repeats: int = 3) -> dict:
     import jax
 
-    from go_avalanche_tpu.config import AvalancheConfig
+    from benchmarks.workload import flagship_state
     from go_avalanche_tpu.models import avalanche as av
 
     # finalization_score 0x7FFE: unreachable within the timed window, so
     # every (node, tx) record keeps ingesting k votes per round.
     # max_element_poll >= n_txs so the poll cap never freezes records the
-    # vote count below assumes are live.
-    cfg = AvalancheConfig(finalization_score=0x7FFE, k=k, gossip=False,
-                          max_element_poll=max(4096, n_txs))
-    state = av.init(jax.random.key(0), n_nodes, n_txs, cfg)
+    # vote count below assumes are live.  Shared builder: roofline.py
+    # measures phase bandwidth on this exact construction.
+    state, cfg = flagship_state(n_nodes, n_txs, k)
 
     # The round loop runs ON DEVICE (lax.scan inside one jit): dispatching
     # rounds one by one from Python pays a fixed per-call latency (~6ms

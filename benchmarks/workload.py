@@ -26,6 +26,26 @@ QUICK = dict(nodes=64, backlog_sets=1024, set_cap=2, window_sets=32)
 _SCORE_SEED, _SIM_SEED, _SCORE_MAX = 1, 0, 1 << 20
 
 
+def flagship_state(nodes: int, txs: int, k: int = 8):
+    """The `bench.py` flagship workload: (state, cfg) for sustained vote
+    ingest on `models/avalanche.round_step`.
+
+    One construction shared by `bench.py` (the throughput number) and
+    `benchmarks/roofline.py` (the per-phase bandwidth anchor) so the two
+    always measure the same program: finalization unreachable within the
+    timed window (0x7FFE), gossip off (pre-seeded feed, matching the
+    reference example `main.go:49-53`), poll cap covering every tx.
+    """
+    import jax
+
+    from go_avalanche_tpu.config import AvalancheConfig
+    from go_avalanche_tpu.models import avalanche as av
+
+    cfg = AvalancheConfig(finalization_score=0x7FFE, k=k, gossip=False,
+                          max_element_poll=max(4096, txs))
+    return av.init(jax.random.key(0), nodes, txs, cfg), cfg
+
+
 def northstar_config(window_sets: int, set_cap: int):
     """The AvalancheConfig every north-star surface runs under: gossip off
     (every node pre-seeded, as in the reference example's feed) and a poll
