@@ -64,7 +64,13 @@ def run_host_api(args) -> None:
         for i, p in enumerate(processors):
             if finalized[i] >= t:
                 continue
-            peer = (i + 1 + rnd) % n          # round-robin, skip self
+            # Round-robin over the OTHER n-1 peers: the reference skips
+            # itself and immediately queries the next node
+            # (`main.go:113-116`), so a self-hit advances one further
+            # instead of idling the round.
+            peer = (i + 1 + rnd) % n
+            if peer == i:
+                peer = (peer + 1) % n
             invs = p.get_invs_for_next_poll()
             if not invs:
                 continue

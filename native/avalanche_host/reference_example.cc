@@ -1,0 +1,102 @@
+// The reference example's workload on the native host runtime.
+//
+// A compiled single-process twin of `examples/basic-preconcensus/main.go`
+// (and of `examples/basic_preconsensus.py --host-api`): N nodes, each an
+// avalanche_host::Processor, every node fed every tx up front in one
+// shuffled order (`main.go:49-53`), round-robin peer queries with
+// gossip-on-poll admission and honest own-acceptance votes
+// (`main.go:111-116`, `main.go:168-193`), converging when every node's
+// every tx has reported its FIRST Status::FINALIZED update
+// (`main.go:143-161`).  Prints the same two lines the Go binary does
+// (wall-clock + fully-finalized count), giving BASELINE.md's config-0 row
+// a real compiled-language datum on any box with g++ — this environment
+// has no Go toolchain and no CI egress, so the Go binary itself cannot
+// run here.
+//
+//   make -C native example && native/build/reference_example [N] [T]
+
+#include <algorithm>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <memory>
+#include <random>
+#include <vector>
+
+#include "processor.h"
+
+using avalanche_host::Processor;
+using avalanche_host::ProtocolConfig;
+using avalanche_host::StatusOut;
+using avalanche_host::VoteIn;
+
+namespace {
+constexpr int8_t kStatusFinalized = 3;
+}
+
+int main(int argc, char** argv) {
+  const int n = argc > 1 ? std::atoi(argv[1]) : 100;
+  const int t = argc > 2 ? std::atoi(argv[2]) : 100;
+  const int max_rounds = argc > 3 ? std::atoi(argv[3]) : 2000;
+
+  ProtocolConfig cfg;
+  std::vector<std::unique_ptr<Processor>> procs;
+  procs.reserve(n);
+  for (int i = 0; i < n; ++i) {
+    procs.push_back(std::make_unique<Processor>(
+        cfg, Processor::NodeSelection::kLowest, /*seed=*/i));
+    for (int j = 0; j < n; ++j)
+      if (j != i) procs.back()->AddNode(j);
+  }
+
+  const auto t0 = std::chrono::steady_clock::now();
+
+  // Shuffled feed, one order for the whole network (`main.go:49-53`).
+  std::vector<int64_t> order(t);
+  for (int h = 0; h < t; ++h) order[h] = h;
+  std::mt19937_64 rng(0);
+  std::shuffle(order.begin(), order.end(), rng);
+  for (int64_t h : order)
+    for (auto& p : procs)
+      p->AddTargetToReconcile(h, /*accepted=*/true, /*valid=*/true,
+                              /*score=*/1);
+
+  std::vector<int> finalized(n, 0);
+  int fully = 0;
+  int rounds = 0;
+  std::vector<StatusOut> updates;
+  std::vector<VoteIn> votes;
+  for (int rnd = 0; rnd < max_rounds && fully < n; ++rnd) {
+    rounds = rnd + 1;
+    for (int i = 0; i < n; ++i) {
+      if (finalized[i] >= t) continue;
+      // Round-robin over the OTHER n-1 peers: the reference skips self
+      // and immediately moves to the next node (`main.go:113-116`), so a
+      // self-hit advances to the following peer instead of idling.
+      int peer = (i + 1 + rnd) % n;
+      if (peer == i) peer = (peer + 1) % n;
+      Processor& p = *procs[i];
+      const std::vector<int64_t> invs = p.GetInvsForNextPoll();
+      if (invs.empty()) continue;
+      votes.clear();
+      for (int64_t h : invs) {  // the peer's synchronous `query`
+        procs[peer]->AddTargetToReconcile(h, true, true, 1);  // gossip
+        votes.push_back({h, procs[peer]->IsAccepted(h) ? 0 : 1});
+      }
+      updates.clear();
+      p.RegisterVotes(peer, p.GetRound(), votes, &updates);
+      for (const StatusOut& u : updates) {
+        if (u.status == kStatusFinalized && ++finalized[i] == t) ++fully;
+      }
+    }
+  }
+
+  const double dt = std::chrono::duration<double>(
+                        std::chrono::steady_clock::now() - t0)
+                        .count();
+  std::printf("Finished in %fs\n", dt);
+  std::printf("Nodes fully finalized: %d/%d in %d rounds (native C++)\n",
+              fully, n, rounds);
+  return fully == n ? 0 : 1;
+}

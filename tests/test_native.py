@@ -205,3 +205,27 @@ def test_native_ticker_thread():
         assert not p.stop()
         assert p.get_round() > 0
         assert p.outstanding_requests() >= 1
+
+
+@pytest.mark.slow
+def test_reference_example_twin_converges_like_the_go_example():
+    """The compiled config-0 twin (BASELINE.md): builds with the repo
+    Makefile, finalizes 100/100 nodes, and takes exactly the 134 rounds
+    the reference's unanimous-honest trajectory takes (same count as the
+    pure-Python host-API drive)."""
+    import re
+    import subprocess
+    from pathlib import Path
+
+    native_dir = Path(__file__).resolve().parent.parent / "native"
+    build = subprocess.run(["make", "-C", str(native_dir), "example"],
+                           capture_output=True, text=True, timeout=120)
+    assert build.returncode == 0, build.stderr[-2000:]
+    run = subprocess.run([str(native_dir / "build" / "reference_example")],
+                         capture_output=True, text=True, timeout=120)
+    assert run.returncode == 0, run.stdout + run.stderr
+    m = re.search(r"fully finalized: (\d+)/(\d+) in (\d+) rounds",
+                  run.stdout)
+    assert m, run.stdout
+    assert (m.group(1), m.group(2)) == ("100", "100")
+    assert int(m.group(3)) == 134
