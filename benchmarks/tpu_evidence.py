@@ -73,6 +73,53 @@ print(json.dumps({"platform": dev.platform, "device": str(dev),
 """
 
 
+_RETIRE_CAP_AB = r"""
+import sys; sys.path.insert(0, "@ROOT@")
+import dataclasses, json, time
+import jax
+import numpy as np
+from jax import lax
+from benchmarks.workload import northstar_state
+from go_avalanche_tpu.models import streaming_dag as sdg
+
+dev = jax.devices()[0]
+assert dev.platform == "tpu", f"not a TPU: {dev.platform}"
+# North-star window at N/24 nodes; warm 40 rounds so the window is full
+# and churning (the capped path's operating point), then time 20-round
+# scans.  Decides whether cfg.stream_retire_cap helps on real hardware
+# (on CPU the column scatter loses 4.8x -- PERF_NOTES r05).
+state, cfg = northstar_state(nodes=4096, backlog_sets=20000, set_cap=2,
+                             window_sets=1024, track_finality=False)
+cap_cfg = dataclasses.replace(cfg, stream_retire_cap=64)
+
+def scan20(s, c):
+    def body(st, _):
+        return sdg.step(st, c)[0], None
+    return lax.scan(body, s, None, length=20)[0]
+
+scan20_j = jax.jit(scan20, static_argnums=1)
+def sync(s):
+    np.asarray(jax.numpy.sum(s.dag.base.records.confidence.astype(
+        jax.numpy.int32)))
+
+state = scan20_j(state, cfg); sync(state)
+state = scan20_j(state, cfg); sync(state)   # 40 warm rounds, dense
+row = {"platform": dev.platform, "shape": "4096x(1024x2)"}
+for name, c in (("dense", cfg), ("capped64", cap_cfg)):
+    s = scan20_j(state, c); sync(s)         # compile + warm this variant
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        sync(scan20_j(s, c))
+        dt = (time.perf_counter() - t0) / 20
+        best = dt if best is None else min(best, dt)
+    row[f"{name}_ms_per_round"] = round(best * 1e3, 3)
+row["capped_speedup"] = round(
+    row["dense_ms_per_round"] / row["capped64_ms_per_round"], 3)
+print(json.dumps(row))
+"""
+
+
 def _last_json_line(text: str) -> dict | None:
     for line in reversed(text.strip().splitlines()):
         line = line.strip()
@@ -146,6 +193,17 @@ def main() -> None:
             _run("streaming_on_chip",
                  [sys.executable, "-c",
                   _STREAM_CHECK.replace("@ROOT@", str(REPO))],
+                 base, args.timeout),
+            # Perf-evidence lanes (VERDICT r4 items 4-5): the per-phase
+            # roofline refresh and the capped-scheduler hardware A/B.
+            _run("roofline",
+                 [sys.executable, str(REPO / "benchmarks" / "roofline.py"),
+                  "--out",
+                  str(REPO / "benchmarks" / "roofline_tpu.json")],
+                 base, args.timeout),
+            _run("retire_cap_ab",
+                 [sys.executable, "-c",
+                  _RETIRE_CAP_AB.replace("@ROOT@", str(REPO))],
                  base, args.timeout),
         ]
     out = {"captured_unix_s": int(time.time()), "lanes": lanes,

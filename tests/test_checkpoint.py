@@ -211,12 +211,16 @@ def test_fetch_timeout_aborts_save_before_any_write(tmp_path, monkeypatch):
     assert not _os.path.exists(p + ".tmp")
 
 
-def test_run_chunked_late_save_failure_warns_not_raises(tmp_path,
-                                                       monkeypatch):
-    """Once one checkpoint landed, later save failures must cost only the
+def test_run_chunked_save_failure_warns_not_raises(tmp_path, monkeypatch):
+    """A save failure alongside a save that landed must cost only the
     checkpoint: the run completes, returns the final state, and reports
-    the drops as a RuntimeWarning (ADVICE r4: never discard a finished
-    computation over a stale-by-one checkpoint)."""
+    the drop as a RuntimeWarning (ADVICE r4: never discard a finished
+    computation over a lost checkpoint).  The first attempt fails and
+    every later one succeeds — deterministic under any thread scheduling
+    (a failed save's thread dies instantly, so whether later boundaries
+    or only the completion retry reach the successful save, the outcome
+    is identical: >=1 failure, >=1 landed checkpoint, warning, no
+    raise)."""
     from go_avalanche_tpu.models import streaming_dag as sd
     from go_avalanche_tpu.utils import checkpoint as ckpt
 
@@ -229,7 +233,7 @@ def test_run_chunked_late_save_failure_warns_not_raises(tmp_path,
 
     def flaky(path, st, **kw):
         calls[0] += 1
-        if calls[0] > 1:
+        if calls[0] == 1:
             raise OSError("disk full")
         real(path, st, **kw)
 
@@ -239,7 +243,7 @@ def test_run_chunked_late_save_failure_warns_not_raises(tmp_path,
         final = sd.run_chunked(state, cfg, max_rounds=2000, chunk=4,
                                checkpoint_path=path,
                                checkpoint_every_chunks=1)
-    assert calls[0] > 1, "test premise: at least one save failed"
+    assert calls[0] >= 2, "test premise: a save failed and one landed"
     assert np.asarray(jax.device_get(final.outputs.settled)).all()
     assert _file_exists(path)
 
