@@ -22,8 +22,12 @@ writing a single committed artifact:
 
 Each lane runs in its own subprocess with a timeout, so a wedged tunnel
 records `"timeout"` (with the partial output tail) instead of hanging the
-capture.  Output: `benchmarks/tpu_evidence.json` (committed) and full
-lane tails in `benchmarks/tpu_evidence_logs/` (gitignored scratch).
+capture.  Two INFORMATIONAL perf lanes (a `roofline.py --out
+roofline_tpu.json` refresh and the capped-scheduler A/B) are captured
+alongside under `perf_lanes` but never gate `all_pass` — that flag is
+strictly the hardware-correctness contract.  Output:
+`benchmarks/tpu_evidence.json` (committed) and full lane tails in
+`benchmarks/tpu_evidence_logs/` (gitignored scratch).
 
     python benchmarks/tpu_evidence.py [--timeout 600]
 """
@@ -194,8 +198,15 @@ def main() -> None:
                  [sys.executable, "-c",
                   _STREAM_CHECK.replace("@ROOT@", str(REPO))],
                  base, args.timeout),
-            # Perf-evidence lanes (VERDICT r4 items 4-5): the per-phase
-            # roofline refresh and the capped-scheduler hardware A/B.
+        ]
+    # Perf-capture lanes (VERDICT r4 items 4-5): the per-phase roofline
+    # refresh and the capped-scheduler hardware A/B.  Informational —
+    # recorded in the artifact but NOT part of `all_pass`, which remains
+    # strictly the hardware-CORRECTNESS contract; a perf-capture hiccup
+    # must not record correctness as unproven.
+    perf_lanes = []
+    if probe["status"] == "pass":
+        perf_lanes = [
             _run("roofline",
                  [sys.executable, str(REPO / "benchmarks" / "roofline.py"),
                   "--out",
@@ -207,6 +218,7 @@ def main() -> None:
                  base, args.timeout),
         ]
     out = {"captured_unix_s": int(time.time()), "lanes": lanes,
+           "perf_lanes": perf_lanes,
            "all_pass": (probe["status"] == "pass"
                         and all(r["status"] == "pass" for r in lanes))}
     (REPO / "benchmarks" / "tpu_evidence.json").write_text(

@@ -335,3 +335,17 @@ def test_retire_cap_run_chunked_matches_run():
         state, cfg, 10_000)
     b = sd.run_chunked(state, cfg, max_rounds=10_000, chunk=7)
     _leaves_equal(a, b)
+
+
+def test_retire_cap_under_byzantine_flip_still_resolves():
+    """The capped scheduler composes with the adversary stack: deferral
+    changes admission timing, not the consensus dynamics, so a flipping
+    minority still loses every conflict set."""
+    cfg = AvalancheConfig(byzantine_fraction=0.15, flip_probability=1.0,
+                          adversary_strategy=AdversaryStrategy.FLIP,
+                          stream_retire_cap=2)
+    final = run_stream(n_nodes=32, n_sets=8, c=2, window_sets=4, cfg=cfg,
+                       max_rounds=12000)
+    summary = sd.resolution_summary(final)
+    assert summary["sets_settled_fraction"] == 1.0
+    assert summary["sets_one_winner_fraction"] > 0.9
