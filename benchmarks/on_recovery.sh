@@ -1,0 +1,51 @@
+#!/usr/bin/env bash
+# The full hardware-evidence capture, in dependency order, for the first
+# window in which the axon tunnel answers after the round-4/5 outage
+# (benchmarks/PERF_NOTES.md "Round-5 status").  Each stage appends to
+# benchmarks/recovery_log.txt and failures do not stop later stages —
+# partial evidence beats none if the tunnel wedges again mid-sequence.
+#
+#   bash benchmarks/on_recovery.sh
+#
+# Order rationale:
+#  1. north-star resume FIRST — the one unmet SURVEY §6 bar; resumes the
+#     round-2048 checkpoint (dense scheduler: the checkpoint predates the
+#     stream_retire_cap knob and the trajectory must stay comparable).
+#  2. bench.py — the headline votes/sec datum (graph pinned identical to
+#     r03's 56.8B measurement, so expect parity modulo tunnel variance).
+#  3. tpu_evidence.py — correctness lanes + roofline_tpu.json refresh +
+#     the capped-scheduler hardware A/B (perf lanes informational).
+#  4. bench_streaming.py — votes/sec on the north-star model family.
+#  5. fresh --no-track-finality labeled run in its own workdir, WITHOUT
+#     --update-results (the labeled row must not replace the config6
+#     default-mode row; its JSON lands in the workdir + log).
+
+set -u
+cd "$(dirname "$0")/.."
+# Single-instance guard: the tunnel watcher auto-starts this script on
+# recovery, and the operator may also start it by hand — never both.
+exec 9>/tmp/on_recovery.lock
+if ! flock -n 9; then
+  echo "another on_recovery.sh is already running; tail" \
+       "benchmarks/recovery_log.txt instead" >&2
+  exit 0
+fi
+LOG=benchmarks/recovery_log.txt
+stamp() { date -u +%FT%TZ; }
+run() {  # run <name> <timeout_s> <cmd...>
+  local name=$1 t=$2 rc; shift 2
+  echo "=== $(stamp) $name ===" | tee -a "$LOG"
+  timeout "$t" "$@" 2>&1 | tee -a "$LOG"
+  rc=${PIPESTATUS[0]}   # the command's rc, not tee's
+  echo "--- rc=$rc ---" | tee -a "$LOG"
+}
+
+run probe           90 python -c "import jax; print(jax.devices())" || true
+run northstar     3600 python benchmarks/northstar.py --resume --update-results
+run bench          900 python bench.py
+run tpu_evidence  2400 python benchmarks/tpu_evidence.py
+run bench_stream   900 python benchmarks/bench_streaming.py \
+                       --out benchmarks/streaming_votes.json
+run northstar_ntf 2400 python benchmarks/northstar.py --no-track-finality \
+                       --workdir benchmarks/northstar_work_ntf
+echo "=== $(stamp) capture complete ===" | tee -a "$LOG"
