@@ -96,7 +96,11 @@ def worker(args: argparse.Namespace) -> None:
                                  track_finality=not args.no_track_finality)
     beat("state built")
     if os.path.exists(args.ckpt):
-        state = restore_checkpoint(args.ckpt, state)
+        # Bounded host->device transfers: the watchdog can kill this
+        # worker mid-restore, and a kill inside one monolithic ~800 MB
+        # device_put is the same wedge pattern as the round-4 save kill.
+        state = restore_checkpoint(args.ckpt, state,
+                                   max_transfer_bytes=64 << 20)
         print(f"resumed from {args.ckpt} at round "
               f"{int(jax.device_get(state.dag.base.round))}",
               file=sys.stderr, flush=True)

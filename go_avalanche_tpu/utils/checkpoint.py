@@ -82,6 +82,23 @@ def _fetch(arr: Any, timeout_s: Optional[float]) -> np.ndarray:
     return val
 
 
+def _row_blocks(shape: tuple, nbytes: int,
+                cap: Optional[int]):
+    """Axis-0 slice ranges bounding each transfer to ~`cap` bytes.
+
+    The ONE place the block math lives — save (`_fetch_leaf`) and restore
+    (`_put_bounded`) must never disagree on transfer bounds.  Yields
+    nothing when the whole array fits (or can't be row-sliced): callers
+    then move it in one transfer.
+    """
+    if (cap is None or nbytes <= cap or len(shape) == 0 or shape[0] <= 1):
+        return
+    row_bytes = max(1, nbytes // shape[0])
+    rows = max(1, cap // row_bytes)
+    for lo in range(0, shape[0], rows):
+        yield lo, min(lo + rows, shape[0])
+
+
 def _fetch_leaf(
     leaf: Any,
     max_fetch_bytes: Optional[int],
@@ -96,15 +113,12 @@ def _fetch_leaf(
     """
     if not isinstance(leaf, jax.Array):
         return np.asarray(leaf)
-    nbytes = leaf.size * leaf.dtype.itemsize
-    if (max_fetch_bytes is None or nbytes <= max_fetch_bytes
-            or leaf.ndim == 0 or leaf.shape[0] <= 1):
+    blocks = list(_row_blocks(leaf.shape, leaf.size * leaf.dtype.itemsize,
+                              max_fetch_bytes))
+    if not blocks:
         return _fetch(leaf, fetch_timeout_s)
-    row_bytes = max(1, nbytes // leaf.shape[0])
-    rows_per_block = max(1, max_fetch_bytes // row_bytes)
     out = np.empty(leaf.shape, dtype=leaf.dtype)
-    for lo in range(0, leaf.shape[0], rows_per_block):
-        hi = min(lo + rows_per_block, leaf.shape[0])
+    for lo, hi in blocks:
         out[lo:hi] = _fetch(leaf[lo:hi], fetch_timeout_s)
     return out
 
@@ -142,12 +156,32 @@ def save_checkpoint(
     os.replace(tmp, path)  # atomic: no torn checkpoints on interruption
 
 
-def restore_checkpoint(path: str, template: Any) -> Any:
+def _put_bounded(arr: np.ndarray,
+                 max_transfer_bytes: Optional[int]) -> Any:
+    """Host→device placement, never moving more than `max_transfer_bytes`
+    per transfer (the restore-side mirror of `_fetch_leaf`: a process
+    killed mid-way through one monolithic transfer is the documented
+    tunnel-wedge trigger, and the north-star watchdog can legitimately
+    kill a worker mid-restore).  Oversized leaves go up in row blocks and
+    are concatenated on device (transiently 2x that leaf's bytes)."""
+    import jax.numpy as jnp
+
+    blocks = list(_row_blocks(arr.shape, arr.nbytes, max_transfer_bytes))
+    if not blocks:
+        return jnp.asarray(arr)
+    return jnp.concatenate([jnp.asarray(arr[lo:hi]) for lo, hi in blocks],
+                           axis=0)
+
+
+def restore_checkpoint(path: str, template: Any, *,
+                       max_transfer_bytes: Optional[int] = None) -> Any:
     """Restore a state saved by `save_checkpoint`.
 
     `template` is any state with the same pytree structure (e.g. a freshly
     `init()`-ed one); its structure and static aux data are reused, its array
     values are replaced.  Shape/dtype mismatches raise ValueError.
+    `max_transfer_bytes` bounds each host→device transfer (see
+    `_put_bounded`); `None` keeps whole-leaf placement.
     """
     leaves, treedef = jax.tree_util.tree_flatten(template)
     with np.load(path) as data:
@@ -174,12 +208,17 @@ def restore_checkpoint(path: str, template: Any) -> Any:
                 raise ValueError(f"checkpoint missing leaf {i} "
                                  f"(template/checkpoint structure mismatch)")
             arr = data[plain_name]
+            # Validate against what the leaf becomes ON DEVICE (the old
+            # behavior): under jax_enable_x64=False an int64/float64
+            # template leaf materializes as int32/float32, and a
+            # checkpoint that only matches the wider host dtype must
+            # still fail LOUDLY rather than silently downcast.
             want = jax.numpy.asarray(leaf)
             if arr.shape != want.shape or arr.dtype != want.dtype:
                 raise ValueError(
                     f"checkpoint leaf {i}: got {arr.dtype}{list(arr.shape)}, "
                     f"template has {want.dtype}{list(want.shape)}")
-            restored.append(jax.numpy.asarray(arr))
+            restored.append(_put_bounded(arr, max_transfer_bytes))
     return jax.tree_util.tree_unflatten(treedef, restored)
 
 

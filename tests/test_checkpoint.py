@@ -293,3 +293,17 @@ def test_cross_mode_restore_fails_with_clear_message(tmp_path):
     # And the matching direction still round-trips.
     restored = restore_checkpoint(p, off)
     assert restored.finalized_at is None
+
+
+def test_bounded_restore_bit_identical(tmp_path):
+    """Restoring in row-block transfers must reproduce the monolithic
+    restore exactly (the restore-side mirror of the bounded save)."""
+    cfg = AvalancheConfig()
+    state = av.init(jax.random.key(5), 64, 32, cfg)
+    p = str(tmp_path / "r.npz")
+    save_checkpoint(p, state)
+    tmpl = lambda: av.init(jax.random.key(0), 64, 32, cfg)  # noqa: E731
+    whole = restore_checkpoint(p, tmpl())
+    blocked = restore_checkpoint(p, tmpl(), max_transfer_bytes=256)
+    assert_states_equal(whole, blocked)
+    assert_states_equal(state, blocked)
