@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# The evidence still outstanding after the 2026-07-31 01:56 UTC re-wedge
+# (PERF_NOTES "Round-5 second wedge"): everything that landed before it
+# (bench.py 56.6B, north-star config6, 3/4 correctness lanes) is already
+# committed; this captures the rest in cheapest-first order so a third
+# wedge mid-sequence still maximizes what survives.
+#
+#   bash benchmarks/remaining_capture.sh
+#
+# External timeouts use TERM with --kill-after grace: both wedges began
+# with a process hard-killed inside a device call, so the backstop must
+# let the runtime disconnect cleanly whenever possible (the in-process
+# soft deadlines in roofline.py/tpu_evidence.py should fire first).
+set -u
+cd "$(dirname "$0")/.."
+exec 9>/tmp/remaining_capture.lock
+if ! flock -n 9; then
+  echo "another remaining_capture.sh is running" >&2
+  exit 0
+fi
+LOG=benchmarks/recovery_log.txt
+stamp() { date -u +%FT%TZ; }
+run() {  # run <name> <timeout_s> <cmd...>
+  local name=$1 t=$2 rc; shift 2
+  echo "=== $(stamp) $name ===" | tee -a "$LOG"
+  timeout --kill-after=30 "$t" "$@" 2>&1 | tee -a "$LOG"
+  rc=${PIPESTATUS[0]}
+  echo "--- rc=$rc ---" | tee -a "$LOG"
+}
+
+run probe          120 python -c "import jax; print(jax.devices())"
+run parity         600 env GO_AVALANCHE_TPU_TESTS=1 python -m pytest \
+                       tests/test_cross_backend_parity.py -v --no-header
+run bench_stream  1800 python benchmarks/bench_streaming.py \
+                       --out benchmarks/streaming_votes.json
+# 6600 > worst-case lane sum (4x600 correctness + 2x1800 perf):
+# the external backstop must never fire while a lane is mid-RPC.
+run tpu_evidence  6600 python benchmarks/tpu_evidence.py
+run northstar_ntf 2400 python benchmarks/northstar.py --no-track-finality \
+                       --workdir benchmarks/northstar_work_ntf
+echo "=== $(stamp) remaining capture complete ===" | tee -a "$LOG"

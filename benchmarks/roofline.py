@@ -104,7 +104,15 @@ def main() -> None:
                         help="tiny shapes + CPU pin (CI smoke)")
     parser.add_argument("--skip-streaming", action="store_true")
     parser.add_argument("--out", type=str, default=None)
+    parser.add_argument("--deadline", type=float, default=None,
+                        help="soft wall-clock budget (s): phases that "
+                        "have not STARTED by the deadline are skipped and "
+                        "the rows already measured are kept.  An external "
+                        "SIGKILL mid-device-call is what wedges the axon "
+                        "tunnel (PERF_NOTES round-4/5 outages), so the "
+                        "harness budgets inside the process instead")
     args = parser.parse_args()
+    t_start = time.time()
 
     import jax
 
@@ -128,6 +136,25 @@ def main() -> None:
     R = args.rounds
     rows = []
 
+    def measure(name, step_fn, scanned_fn, init_carry):
+        """Deadline-guarded `_measure` with incremental `--out`: a phase
+        only starts if budget remains, and every completed row hits the
+        file immediately — an external kill loses at most the in-flight
+        phase, never the measured ones."""
+        if (args.deadline is not None
+                and time.time() - t_start > args.deadline):
+            # Plain text, NOT a JSON line: tpu_evidence merges stderr
+            # into stdout and takes the LAST json line as the lane
+            # detail — a JSON skip marker would displace the last
+            # measured row there.
+            print(f"[roofline: skipped {name}: deadline]",
+                  file=sys.stderr, flush=True)
+            return
+        rows.append(_measure(name, step_fn, scanned_fn, init_carry, R))
+        if args.out:
+            Path(args.out).write_text(
+                "".join(json.dumps(r) + "\n" for r in rows))
+
     # --- phase: the full flagship round (the bench.py number's program).
     def one_round(s):
         return av.round_step(s, cfg)[0]
@@ -137,8 +164,7 @@ def main() -> None:
             return one_round(st), None
         return lax.scan(body, s, None, length=R)[0]
 
-    rows.append(_measure("round_step_full", one_round, full_round,
-                         state, R))
+    measure("round_step_full", one_round, full_round, state)
 
     # --- phase: vote-ingest kernel alone (k fused window updates on the
     # record planes — RegisterVotes, `processor.go:92-117`).  Carry the
@@ -157,8 +183,7 @@ def main() -> None:
             return ingest_step(r, i), None
         return lax.scan(body, recs, jnp.arange(R, dtype=jnp.int32))[0]
 
-    rows.append(_measure("ingest_kernel", ingest_step, ingest_only,
-                         state.records, R))
+    measure("ingest_kernel", ingest_step, ingest_only, state.records)
 
     # --- phase: preference pack + k row-gathers (the vote-exchange
     # collective's single-chip form).
@@ -183,8 +208,7 @@ def main() -> None:
             return gather_step(c, i), None
         return lax.scan(body, carry, jnp.arange(R, dtype=jnp.int32))[0]
 
-    rows.append(_measure("pref_gathers", gather_step, gathers,
-                         gather_carry, R))
+    measure("pref_gathers", gather_step, gathers, gather_carry)
 
     # --- phase: peer sampling alone.
     def sample_step(c, i=jnp.int32(1)):
@@ -198,8 +222,7 @@ def main() -> None:
             return sample_step(cc, i), None
         return lax.scan(body, c, jnp.arange(R, dtype=jnp.int32))[0]
 
-    rows.append(_measure("peer_sampling", sample_step, sampling,
-                         jnp.int32(0), R))
+    measure("peer_sampling", sample_step, sampling, jnp.int32(0))
 
     # --- north-star streaming scheduler (its own shape: N/4 nodes at the
     # same window as north-star, or tiny under --quick).
@@ -224,12 +247,10 @@ def main() -> None:
                 return stream_one(st), None
             return lax.scan(body, s, None, length=R)[0]
 
-        rows.append(_measure("streaming_step", stream_one, stream_scan,
-                             sstate, R))
+        measure("streaming_step", stream_one, stream_scan, sstate)
 
-    if args.out:
-        Path(args.out).write_text(
-            "".join(json.dumps(r) + "\n" for r in rows))
+    # No final write: rows hit --out incrementally, and a run that
+    # measured nothing must leave the previous capture's file intact.
 
 
 if __name__ == "__main__":

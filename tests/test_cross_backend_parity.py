@@ -40,18 +40,23 @@ def test_cpu_tpu_runs_bit_identical():
     cfg = AvalancheConfig(byzantine_fraction=0.2, drop_probability=0.05,
                           adversary_strategy=AdversaryStrategy.EQUIVOCATE)
 
+    def to_np(x):
+        # np.asarray refuses PRNG-key-dtype arrays outright; the raw
+        # counter words are the comparable (and deterministic) content.
+        if jax.dtypes.issubdtype(getattr(x, "dtype", None),
+                                 jax.dtypes.prng_key):
+            x = jax.random.key_data(x)
+        return np.asarray(x)
+
     def run(platform):
         with jax.default_device(jax.devices(platform)[0]):
             state = av.init(jax.random.key(7), 64, 32, cfg)
             s, _ = jax.jit(av.run_scan,
                            static_argnames=("cfg", "n_rounds"))(
                 state, cfg, 40)
-            return jax.tree.map(np.asarray, s)
+            return jax.tree.map(to_np, s)
 
     a, b = run("cpu"), run("tpu")
     for la, lb in zip(jax.tree_util.tree_leaves(a),
                       jax.tree_util.tree_leaves(b)):
-        if jax.dtypes.issubdtype(getattr(la, "dtype", None),
-                                 jax.dtypes.prng_key):
-            continue
         np.testing.assert_array_equal(la, lb)
