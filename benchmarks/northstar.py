@@ -91,6 +91,12 @@ def worker(args: argparse.Namespace) -> None:
         _merge_progress(args.progress, phase=note)
 
     beat("init")
+    if os.environ.get("GO_AV_NORTHSTAR_TEST_WEDGE"):
+        # Test hook (tests/test_workload.py): fake the round-4/5 failure
+        # mode — a worker that dials the device and never returns —
+        # without a device.  One beat has landed, so the watchdog sees a
+        # live-then-silent worker, exactly like the real wedge.
+        time.sleep(3600)
     shape = QUICK if args.quick else FULL
     state, cfg = northstar_state(**shape,
                                  track_finality=not args.no_track_finality)
@@ -183,6 +189,7 @@ def parent(args: argparse.Namespace) -> None:
     t_start = time.time()
     attempts = 0
     no_progress_strikes = 0
+    startup_wedge_strikes = 0
     while attempts < args.max_attempts:
         attempts += 1
         # Progress for the strike logic is attempt-relative: `round` is
@@ -221,8 +228,16 @@ def parent(args: argparse.Namespace) -> None:
                       f"{args.stall_timeout:.0f}s — killing worker",
                       file=sys.stderr, flush=True)
                 killed_by_watchdog = True
-                proc.send_signal(signal.SIGKILL)
-                proc.wait()
+                # TERM first: both recorded tunnel wedges (PERF_NOTES
+                # round-4/5) began with a process hard-killed inside a
+                # device call, and a TERM'd runtime can still disconnect
+                # cleanly if it is merely slow rather than wedged.
+                proc.terminate()
+                try:
+                    proc.wait(timeout=20)
+                except subprocess.TimeoutExpired:
+                    proc.send_signal(signal.SIGKILL)
+                    proc.wait()
                 break
         if proc.returncode == 0 and os.path.exists(result):
             out = json.loads(Path(result).read_text())
@@ -241,14 +256,35 @@ def parent(args: argparse.Namespace) -> None:
         # construction on it.  "Advancing" means the heartbeat's position
         # moved at all (monotonic `round` OR this attempt's
         # `attempt_round`) — a resumed attempt working its way back up
-        # from an older checkpoint counts.  Watchdog kills never count: a
-        # transient wedge can strike during the ~100s restore, and
-        # retrying is exactly what that case needs.
+        # from an older checkpoint counts.  Watchdog kills never count
+        # toward no_progress_strikes (a transient wedge can strike during
+        # the ~100s restore, and a retry is what that case needs) — but
+        # three in a row with ZERO movement are a wedged tunnel, handled
+        # by startup_wedge_strikes below.
         pos_now = _progress_pos()
         if pos_now != pos_at_launch:
             no_progress_strikes = 0
+            startup_wedge_strikes = 0
         elif not killed_by_watchdog:
             no_progress_strikes += 1
+            startup_wedge_strikes = 0   # a self-exit breaks the wedge run
+        else:
+            # Watchdog kill with ZERO position movement: the worker never
+            # completed a single chunk — it wedged during startup (backend
+            # dial / state build / restore).  Three of those in a row is a
+            # wedged tunnel, not a transient: stop hammering it with
+            # kill-mid-device-op cycles (each one is the documented wedge
+            # trigger) and hand the verdict to the caller.
+            startup_wedge_strikes += 1
+            if startup_wedge_strikes >= 3:
+                print(json.dumps({
+                    "error": f"aborting after {attempts} attempts: three "
+                             f"consecutive attempts wedged before their "
+                             f"first chunk (watchdog-killed at startup, "
+                             f"position stuck at {pos_now}) — the "
+                             f"accelerator tunnel is wedged; re-run when "
+                             f"a device probe answers"}))
+                sys.exit(2)
         if no_progress_strikes >= 2:
             print(json.dumps({
                 "error": f"aborting after {attempts} attempts: two "

@@ -100,3 +100,27 @@ def test_progress_merge_survives_torn_file(tmp_path):
     got = json.loads(p.read_text())
     assert got["phase"] == "init"
     assert "ts" in got
+
+
+def test_parent_stops_hammering_a_startup_wedged_tunnel(tmp_path):
+    """Three consecutive attempts watchdog-killed before their first chunk
+    must abort with the wedged-tunnel verdict (exit 2) instead of burning
+    max_attempts of kill-mid-device-op cycles (the documented wedge
+    trigger, PERF_NOTES round-4/5)."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    env = dict(os.environ, GO_AV_NORTHSTAR_TEST_WEDGE="1")
+    proc = subprocess.run(
+        [sys.executable, str(repo / "benchmarks" / "northstar.py"),
+         "--quick", "--force-cpu", "--stall-timeout", "2",
+         "--max-attempts", "10", "--workdir", str(tmp_path)],
+        capture_output=True, text=True, timeout=240, env=env,
+        cwd=str(repo))
+    assert proc.returncode == 2, (proc.returncode, proc.stderr[-2000:])
+    verdict = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert "wedged" in verdict["error"]
+    assert proc.stderr.count("killing worker") == 3
