@@ -189,17 +189,29 @@ def _attach_prev_delta(parsed: dict, search_dir: str | None = None) -> dict:
             m = re.search(r"BENCH_r(\d+)\.json$", path)
             if m:  # numeric sort: r100 must not sort before r99
                 rounds.append((int(m.group(1)), path))
-        if not rounds:
-            return parsed
-        prev_round, prev_path = max(rounds)
-        prev = json.loads(open(prev_path).read()).get("parsed", {})
-        if prev.get("metric") == parsed.get("metric") and prev.get("value"):
-            parsed["prev_round"] = prev_round
-            parsed["prev_value"] = prev["value"]
-            parsed["delta_vs_prev_pct"] = round(
-                100.0 * (parsed["value"] - prev["value"]) / prev["value"], 2)
-    except (OSError, ValueError, KeyError, json.JSONDecodeError):
-        pass  # the delta is best-effort; never break the one-line contract
+        # Walk back to the latest SAME-METRIC round: an availability
+        # round (e.g. r04's labeled CPU fallback during the outage)
+        # must not silence the comparison against the last real
+        # hardware measurement (r03 vs r05).
+        for prev_round, prev_path in sorted(rounds, reverse=True):
+            try:
+                prev = json.loads(open(prev_path).read())
+            except (OSError, ValueError):
+                continue  # one corrupt archive must not end the walk
+            prev = prev.get("parsed") if isinstance(prev, dict) else None
+            if not isinstance(prev, dict):
+                continue  # valid JSON but not an archive (null/list/str)
+            if (prev.get("metric") == parsed.get("metric")
+                    and isinstance(prev.get("value"), (int, float))
+                    and prev["value"]):
+                parsed["prev_round"] = prev_round
+                parsed["prev_value"] = prev["value"]
+                parsed["delta_vs_prev_pct"] = round(
+                    100.0 * (parsed["value"] - prev["value"])
+                    / prev["value"], 2)
+                break
+    except Exception:  # noqa: BLE001 — the delta is best-effort; never
+        pass           # break the one-line contract over an annotation
     return parsed
 
 

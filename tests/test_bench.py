@@ -144,3 +144,42 @@ def test_tpu_evidence_retire_cap_budget_substitution_is_valid_python():
     assert 'BUDGET_S = float("1234.5")' in src
     assert 'row["truncated"] = "soft budget"' in src
     assert "def over_budget" in src
+
+
+def test_delta_walks_past_mismatched_rounds_to_latest_same_metric(tmp_path):
+    """An availability round (metric-labeled CPU fallback) between two
+    hardware rounds must not silence the hardware-vs-hardware delta."""
+    m = "sustained vote ingest (tpu)"
+    _write(tmp_path, "BENCH_r03.json", m, 50.0)
+    _write(tmp_path, "BENCH_r04.json", "vote ingest [CPU FALLBACK]", 1.0)
+    out = bench._attach_prev_delta({"metric": m, "value": 55.0},
+                                   search_dir=str(tmp_path))
+    assert out["prev_round"] == 3
+    assert out["prev_value"] == 50.0
+    assert out["delta_vs_prev_pct"] == 10.0
+
+
+def test_delta_walk_survives_corrupt_intermediate_round(tmp_path):
+    m = "sustained vote ingest (tpu)"
+    _write(tmp_path, "BENCH_r03.json", m, 50.0)
+    (tmp_path / "BENCH_r04.json").write_text("{not json")
+    out = bench._attach_prev_delta({"metric": m, "value": 55.0},
+                                   search_dir=str(tmp_path))
+    assert out["prev_round"] == 3
+
+
+def test_delta_walk_survives_non_object_json_archive(tmp_path):
+    """`null`/list/string archives (truncated writes) must be skipped,
+    not crash the one-line contract."""
+    m = "sustained vote ingest (tpu)"
+    _write(tmp_path, "BENCH_r03.json", m, 50.0)
+    (tmp_path / "BENCH_r04.json").write_text("null")
+    (tmp_path / "BENCH_r05.json").write_text('["list"]')
+    out = bench._attach_prev_delta({"metric": m, "value": 55.0},
+                                   search_dir=str(tmp_path))
+    assert out["prev_round"] == 3
+    # Non-numeric stored value is skipped too (TypeError guard).
+    _write(tmp_path, "BENCH_r06.json", m, "50")
+    out = bench._attach_prev_delta({"metric": m, "value": 55.0},
+                                   search_dir=str(tmp_path))
+    assert out["prev_round"] == 3
