@@ -1,0 +1,47 @@
+# Shared plumbing for the hardware-capture scripts (remaining_capture,
+# full_refresh, on_recovery, recovery_watcher).  Source AFTER setting
+# LOG.  Conventions:
+#   exit 3 — tunnel dispatch-wedged (caller should retry later)
+#   exit 4 — another instance holds the lock (caller must NOT treat the
+#            stage as done; someone else is running it)
+
+stamp() { date -u +%FT%TZ; }
+
+# run <name> <timeout_s> <cmd...> — TERM-with-grace external backstop
+# (both 2026-07 wedges began with a process hard-killed inside a device
+# call; TERM first lets a merely-slow runtime disconnect cleanly) and
+# 9>&- on the WHOLE pipeline (tee included) so no lane child ever
+# inherits the caller's lock fd — an orphan would hold the lock after
+# the caller dies and block every retry.
+run() {
+  local name=$1 t=$2 rc; shift 2
+  echo "=== $(stamp) $name ===" | tee -a "$LOG"
+  # rc must be read INSIDE the group: after the group exits PIPESTATUS
+  # holds the group's own status (tee's), not the timed command's.
+  { timeout --kill-after=30 "$t" "$@" 2>&1 | tee -a "$LOG"
+    rc=${PIPESTATUS[0]}; } 9>&-
+  rc_last=$rc
+  echo "--- rc=$rc ---" | tee -a "$LOG"
+}
+
+# acquire_lock <path> — single-instance guard on fd 9.
+acquire_lock() {
+  exec 9>"$1"
+  if ! flock -n 9; then
+    echo "another $(basename "$0") is running" >&2
+    exit 4
+  fi
+}
+
+# dispatch_gate — a REAL device computation, not enumeration: the
+# 03:18 UTC Jul 31 wedge state answers jax.devices() in 0.1 s while any
+# compute hangs forever, so an enumeration probe "passes" and the
+# caller then burns every lane's full timeout against a dead tunnel.
+dispatch_gate() {
+  run probe 120 python benchmarks/dispatch_probe.py
+  if [ "${rc_last:-1}" -ne 0 ]; then
+    echo "=== $(stamp) dispatch probe failed: tunnel wedged, aborting" \
+         "$(basename "$0") (watcher will retry) ===" | tee -a "$LOG"
+    exit 3
+  fi
+}

@@ -1,0 +1,19 @@
+"""Tunnel liveness probe: a REAL device dispatch, not enumeration.
+
+The 2026-07-31 03:18 UTC wedge state answers ``jax.devices()`` in
+0.1 s while any actual computation hangs forever, so every capture
+script gates on this probe (under an external ``timeout`` — the hang
+is unbreakable from inside the process).  Exit 0 iff a small device
+computation round-trips.
+"""
+
+import jax
+import jax.numpy as jnp
+
+devs = jax.devices()
+print(devs)
+# A CPU-fallback session (TPU runtime failed outright instead of the
+# half-alive wedge) must NOT pass: the gated lanes record hardware
+# evidence.  Same check as tpu_evidence.py's device_probe lane.
+assert devs[0].platform == "tpu", f"not a TPU backend: {devs[0]}"
+print(float(jnp.ones((128, 128)).sum()))

@@ -4,6 +4,8 @@
 # (benchmarks/PERF_NOTES.md "Round-5 status").  Each stage appends to
 # benchmarks/recovery_log.txt and failures do not stop later stages —
 # partial evidence beats none if the tunnel wedges again mid-sequence.
+# (Historical entrypoint for the 00:59 UTC window; the still-outstanding
+# subset now lives in remaining_capture.sh, which the watcher drives.)
 #
 #   bash benchmarks/on_recovery.sh
 #
@@ -19,28 +21,15 @@
 #  5. fresh --no-track-finality labeled run in its own workdir, WITHOUT
 #     --update-results (the labeled row must not replace the config6
 #     default-mode row; its JSON lands in the workdir + log).
-
+#
+# Exit 3 = tunnel wedged at the gate (retry later); exit 4 = another
+# instance running.  Shared run()/lock/gate plumbing: capture_lib.sh.
 set -u
 cd "$(dirname "$0")/.."
-# Single-instance guard: the tunnel watcher auto-starts this script on
-# recovery, and the operator may also start it by hand — never both.
-exec 9>/tmp/on_recovery.lock
-if ! flock -n 9; then
-  echo "another on_recovery.sh is already running; tail" \
-       "benchmarks/recovery_log.txt instead" >&2
-  exit 0
-fi
 LOG=benchmarks/recovery_log.txt
-stamp() { date -u +%FT%TZ; }
-run() {  # run <name> <timeout_s> <cmd...>
-  local name=$1 t=$2 rc; shift 2
-  echo "=== $(stamp) $name ===" | tee -a "$LOG"
-  timeout "$t" "$@" 2>&1 | tee -a "$LOG"
-  rc=${PIPESTATUS[0]}   # the command's rc, not tee's
-  echo "--- rc=$rc ---" | tee -a "$LOG"
-}
-
-run probe           90 python -c "import jax; print(jax.devices())" || true
+. benchmarks/capture_lib.sh
+acquire_lock /tmp/on_recovery.lock
+dispatch_gate
 run northstar     3600 python benchmarks/northstar.py --resume --update-results
 run bench          900 python bench.py
 run tpu_evidence  2400 python benchmarks/tpu_evidence.py

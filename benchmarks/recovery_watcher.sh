@@ -1,0 +1,70 @@
+#!/usr/bin/env bash
+# Tunnel-recovery watcher for the round-5 second wedge (PERF_NOTES
+# "Round-5 second wedge").  Probes every 180 s with a REAL device
+# dispatch (capture_lib.sh dispatch_gate rationale: enumeration-only
+# probes lie in the half-alive wedge state) and, on the first pass,
+# fires the judge-facing capture (remaining_capture.sh) followed by the
+# RESULTS refresh (full_refresh.sh), then exits.
+#
+#   nohup bash benchmarks/recovery_watcher.sh &
+#
+# Each stage retries independently: child exit 3 means "bailed at its
+# own dispatch probe — never started" and exit 4 means "another
+# instance (e.g. operator-started) is already running it"; neither may
+# mark the stage done.  The refresh only runs once the capture has
+# actually completed, preserving the priority order.
+set -u
+cd "$(dirname "$0")/.."
+LOG=benchmarks/recovery_log.txt
+. benchmarks/capture_lib.sh
+acquire_lock /tmp/recovery_watcher.lock
+need_cap=1
+need_ref=1
+n=0
+# 9>&- everywhere below: children (probe, sleeps, stages) must not
+# inherit the lock fd — an orphan would hold the lock after the watcher
+# dies and silently block every restart.
+while true; do
+  if timeout --kill-after=20 120 \
+      python benchmarks/dispatch_probe.py >/dev/null 2>&1 9>&-; then
+    echo "=== $(stamp) watcher: dispatch probe PASS (after $n wedged" \
+         "probes) ===" | tee -a "$LOG"
+    n=0
+    if [ "$need_cap" -eq 1 ]; then
+      bash benchmarks/remaining_capture.sh 9>&-
+      rc_cap=$?
+      if [ "$rc_cap" -eq 3 ] || [ "$rc_cap" -eq 4 ]; then
+        echo "=== $(stamp) watcher: capture did not start (rc=$rc_cap:" \
+             "3=re-wedged, 4=other instance); resuming watch ===" \
+             | tee -a "$LOG"
+        sleep 180 9>&-
+        continue
+      fi
+      need_cap=0
+      echo "=== $(stamp) watcher: capture finished (rc=$rc_cap) ===" \
+           | tee -a "$LOG"
+    fi
+    if [ "$need_ref" -eq 1 ]; then
+      bash benchmarks/full_refresh.sh 9>&-
+      rc_ref=$?
+      if [ "$rc_ref" -eq 3 ] || [ "$rc_ref" -eq 4 ]; then
+        echo "=== $(stamp) watcher: refresh did not start (rc=$rc_ref:" \
+             "3=re-wedged, 4=other instance); resuming watch ===" \
+             | tee -a "$LOG"
+        sleep 180 9>&-
+        continue
+      fi
+      need_ref=0
+      echo "=== $(stamp) watcher: refresh finished (rc=$rc_ref) ===" \
+           | tee -a "$LOG"
+    fi
+    echo "=== $(stamp) watcher: all stages done ===" | tee -a "$LOG"
+    exit 0
+  fi
+  n=$((n + 1))
+  # One line per ~30 min keeps the committed log readable.
+  if [ $((n % 10)) -eq 1 ]; then
+    echo "$(stamp) watcher: dispatch probe wedged (probe $n)" >> "$LOG"
+  fi
+  sleep 180 9>&-
+done

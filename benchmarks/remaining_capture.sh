@@ -7,28 +7,14 @@
 #
 #   bash benchmarks/remaining_capture.sh
 #
-# External timeouts use TERM with --kill-after grace: both wedges began
-# with a process hard-killed inside a device call, so the backstop must
-# let the runtime disconnect cleanly whenever possible (the in-process
-# soft deadlines in roofline.py/tpu_evidence.py should fire first).
+# Exit 3 = tunnel wedged at the gate (retry later); exit 4 = another
+# instance running.  Shared run()/lock/gate plumbing: capture_lib.sh.
 set -u
 cd "$(dirname "$0")/.."
-exec 9>/tmp/remaining_capture.lock
-if ! flock -n 9; then
-  echo "another remaining_capture.sh is running" >&2
-  exit 0
-fi
 LOG=benchmarks/recovery_log.txt
-stamp() { date -u +%FT%TZ; }
-run() {  # run <name> <timeout_s> <cmd...>
-  local name=$1 t=$2 rc; shift 2
-  echo "=== $(stamp) $name ===" | tee -a "$LOG"
-  timeout --kill-after=30 "$t" "$@" 2>&1 | tee -a "$LOG"
-  rc=${PIPESTATUS[0]}
-  echo "--- rc=$rc ---" | tee -a "$LOG"
-}
-
-run probe          120 python -c "import jax; print(jax.devices())"
+. benchmarks/capture_lib.sh
+acquire_lock /tmp/remaining_capture.lock
+dispatch_gate
 run parity         600 env GO_AVALANCHE_TPU_TESTS=1 python -m pytest \
                        tests/test_cross_backend_parity.py -v --no-header
 run bench_stream  1800 python benchmarks/bench_streaming.py \
