@@ -281,7 +281,7 @@ def render_results_md(results, backend: str) -> str:
         fin = r.get("finality", {})
         outcome = "; ".join(
             f"{k}={v}" for k, v in r.items()
-            if k not in ("name", "rounds", "wall_s", "finality"))
+            if k not in ("name", "key", "rounds", "wall_s", "finality"))
         rounds = r["rounds"] if r["rounds"] is not None else "—"
         wall = r["wall_s"] if r["wall_s"] is not None else "—"
         lines.append(
@@ -417,6 +417,51 @@ def _render_analysis_sections() -> list:
     return lines
 
 
+def merge_preserving(fresh: list, results_path: Path,
+                     backend: str = "") -> list:
+    """Never replace a recorded measurement with an error row.
+
+    A transient failure in one config (tunnel wedge, OOM, driver kill)
+    must not clobber a previously captured numeric row for that config —
+    that is how round-3's config6 error row landed and round-4 nearly
+    lost the north-star number.  Rows are matched by their stable
+    ``key`` (the config function's name, written by every current
+    writer: this suite and northstar._update_results); for a legacy
+    file without keys, positionally when the row count still matches
+    CONFIGS.  Preservation applies only when the fresh row errored and
+    the old row is a real measurement.  Preserved rows are annotated,
+    and keep an explicit ``backend`` label when the old file was
+    measured on a different backend than this refresh (a TPU number
+    must not silently sit under a ``Backend: cpu`` heading).
+    """
+    try:
+        data = json.loads(results_path.read_text())
+        old = data["results"]
+    except (OSError, ValueError, KeyError):
+        return fresh
+    old_by_key = {r["key"]: r for r in old if "key" in r}
+    positional_ok = len(old) == len(fresh)
+    old_backend = data.get("backend", "")
+    merged = []
+    for i, new_row in enumerate(fresh):
+        old_row = old_by_key.get(new_row.get("key"))
+        if old_row is None and positional_ok and "key" not in old[i]:
+            old_row = old[i]
+        if (old_row is not None and "error" in new_row
+                and "error" not in old_row
+                and old_row.get("wall_s") is not None):
+            kept = dict(old_row)
+            kept.setdefault("key", new_row.get("key"))
+            kept["retained"] = (f"kept prior measurement; fresh attempt "
+                                f"failed: {new_row['error']}")
+            if old_backend and backend and old_backend != backend:
+                kept.setdefault("backend", old_backend)
+            merged.append(kept)
+        else:
+            merged.append(new_row)
+    return merged
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true",
@@ -439,10 +484,16 @@ def main() -> None:
             # the error lives in its own field.
             r = {"name": fn.__name__, "rounds": None, "wall_s": None,
                  "error": f"{type(e).__name__}: {e}"}
+        # Stable identity for row-level merges across refreshes: the
+        # descriptive "name" embeds shape parameters, the key does not.
+        r.setdefault("key", fn.__name__)
         results.append(r)
         print(json.dumps(r), flush=True)
 
     if not args.no_write and args.only is None and not args.quick:
+        results = merge_preserving(results,
+                                   REPO / "benchmarks" / "results.json",
+                                   backend)
         (REPO / "RESULTS.md").write_text(render_results_md(results, backend))
         (REPO / "benchmarks" / "results.json").write_text(
             json.dumps({"backend": backend, "results": results}, indent=1)

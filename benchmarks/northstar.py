@@ -311,6 +311,8 @@ def _update_results(row: dict) -> None:
                 if "streaming conflict-DAG" in str(r.get("name", ""))
                 or r.get("name") == "config6_streaming_conflict"), None)
     row = dict(row)
+    # Stable identity for baseline_suite.merge_preserving row matching.
+    row.setdefault("key", "config6_streaming_conflict")
     # The row keeps its own "backend" field: results.json's top-level
     # backend describes the suite refresh, and a north-star rerun on a
     # different backend must stay labeled rather than inherit it.

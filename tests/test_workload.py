@@ -124,3 +124,65 @@ def test_parent_stops_hammering_a_startup_wedged_tunnel(tmp_path):
     verdict = json.loads(proc.stdout.strip().splitlines()[-1])
     assert "wedged" in verdict["error"]
     assert proc.stderr.count("killing worker") == 3
+
+
+def test_results_merge_never_replaces_a_measurement_with_an_error(tmp_path):
+    """A refresh whose config errors must keep the previously recorded
+    numeric row (annotated), not clobber it — the monotonic-evidence rule
+    that northstar progress already follows, applied to results.json
+    (baseline_suite.merge_preserving)."""
+    from benchmarks.baseline_suite import merge_preserving
+
+    old = [{"name": "cfg a", "key": "config_a", "rounds": 17,
+            "wall_s": 6.3},
+           {"name": "cfg b", "key": "config6_streaming_conflict",
+            "rounds": 8313, "wall_s": 997.3, "txs_per_sec": 1002.7}]
+    path = tmp_path / "results.json"
+    path.write_text(json.dumps({"backend": "tpu", "results": old}))
+
+    fresh = [{"name": "cfg a", "key": "config_a", "rounds": 18,
+              "wall_s": 5.9},
+             {"name": "config6_streaming_conflict",
+              "key": "config6_streaming_conflict", "rounds": None,
+              "wall_s": None, "error": "RuntimeError: tunnel wedged"}]
+    merged = merge_preserving(fresh, path, "tpu")
+
+    assert merged[0] == fresh[0]                      # success replaces
+    assert merged[1]["rounds"] == 8313                # error preserves
+    assert merged[1]["wall_s"] == 997.3
+    assert "tunnel wedged" in merged[1]["retained"]
+    assert "error" not in merged[1]
+    assert "backend" not in merged[1]                 # same backend
+
+    # Preserving across a backend change keeps the provenance label.
+    merged = merge_preserving(fresh, path, "cpu")
+    assert merged[1]["backend"] == "tpu"
+
+    # Key match survives a row APPENDED out of CONFIGS order/length
+    # (northstar._update_results appends config6 when absent).
+    path.write_text(json.dumps({"backend": "tpu", "results": [
+        {"name": "other", "key": "config_x", "rounds": 1, "wall_s": 1.0},
+        old[0], old[1]]}))
+    merged = merge_preserving(fresh, path, "tpu")
+    assert merged[1]["rounds"] == 8313
+
+    # An old row that itself errored is NOT worth preserving.
+    path.write_text(json.dumps({"backend": "tpu", "results": [
+        old[0], {"key": "config6_streaming_conflict", "rounds": None,
+                 "wall_s": None, "error": "old failure"}]}))
+    merged = merge_preserving(fresh, path, "tpu")
+    assert merged[1] is fresh[1]
+
+    # Legacy keyless file: positional merge when lengths align ...
+    legacy = [dict(r) for r in old]
+    for r in legacy:
+        r.pop("key")
+    path.write_text(json.dumps({"backend": "tpu", "results": legacy}))
+    merged = merge_preserving(fresh, path, "tpu")
+    assert merged[1]["rounds"] == 8313
+    assert merged[1]["key"] == "config6_streaming_conflict"
+
+    # ... but not on length mismatch; unreadable file writes fresh as-is.
+    path.write_text(json.dumps({"backend": "tpu", "results": legacy[:1]}))
+    assert merge_preserving(fresh, path, "tpu") == fresh
+    assert merge_preserving(fresh, tmp_path / "absent.json", "tpu") == fresh
