@@ -37,8 +37,11 @@ acquire_lock() {
 # 03:18 UTC Jul 31 wedge state answers jax.devices() in 0.1 s while any
 # compute hangs forever, so an enumeration probe "passes" and the
 # caller then burns every lane's full timeout against a dead tunnel.
+# PROBE_TIMEOUT / CAPTURE_LOG env overrides exist for the test harness
+# (tests/test_workload.py fakes a wedged python and needs the gate to
+# fire in seconds, against a scratch log).
 dispatch_gate() {
-  run probe 120 python benchmarks/dispatch_probe.py
+  run probe "${PROBE_TIMEOUT:-120}" python benchmarks/dispatch_probe.py
   if [ "${rc_last:-1}" -ne 0 ]; then
     echo "=== $(stamp) dispatch probe failed: tunnel wedged, aborting" \
          "$(basename "$0") (watcher will retry) ===" | tee -a "$LOG"

@@ -15,7 +15,7 @@
 # actually completed, preserving the priority order.
 set -u
 cd "$(dirname "$0")/.."
-LOG=benchmarks/recovery_log.txt
+LOG=${CAPTURE_LOG:-benchmarks/recovery_log.txt}
 . benchmarks/capture_lib.sh
 acquire_lock /tmp/recovery_watcher.lock
 need_cap=1
@@ -25,7 +25,7 @@ n=0
 # inherit the lock fd — an orphan would hold the lock after the watcher
 # dies and silently block every restart.
 while true; do
-  if timeout --kill-after=20 120 \
+  if timeout --kill-after=20 "${PROBE_TIMEOUT:-120}" \
       python benchmarks/dispatch_probe.py >/dev/null 2>&1 9>&-; then
     echo "=== $(stamp) watcher: dispatch probe PASS (after $n wedged" \
          "probes) ===" | tee -a "$LOG"

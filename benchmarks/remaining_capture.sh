@@ -11,7 +11,7 @@
 # instance running.  Shared run()/lock/gate plumbing: capture_lib.sh.
 set -u
 cd "$(dirname "$0")/.."
-LOG=benchmarks/recovery_log.txt
+LOG=${CAPTURE_LOG:-benchmarks/recovery_log.txt}
 . benchmarks/capture_lib.sh
 acquire_lock /tmp/remaining_capture.lock
 dispatch_gate

@@ -186,3 +186,38 @@ def test_results_merge_never_replaces_a_measurement_with_an_error(tmp_path):
     path.write_text(json.dumps({"backend": "tpu", "results": legacy[:1]}))
     assert merge_preserving(fresh, path, "tpu") == fresh
     assert merge_preserving(fresh, tmp_path / "absent.json", "tpu") == fresh
+
+
+def test_capture_gate_aborts_fast_on_wedged_dispatch(tmp_path):
+    """The capture scripts' dispatch gate (capture_lib.sh) must abort with
+    exit 3 — running NO lanes — when the probe wedges, instead of burning
+    every lane's timeout against a dead tunnel (the 03:18 UTC Jul 31
+    half-alive wedge burned 10-12 min per lane exactly that way).  A
+    PATH-shimmed python fakes the wedge; PROBE_TIMEOUT/CAPTURE_LOG keep
+    the test fast and off the real recovery log."""
+    import os
+    import subprocess
+
+    import pytest
+
+    repo = Path(__file__).resolve().parent.parent
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    shim = bindir / "python"
+    shim.write_text("#!/bin/sh\nexec sleep 60\n")
+    shim.chmod(0o755)
+    log = tmp_path / "capture.log"
+    env = dict(os.environ,
+               PATH=f"{bindir}:{os.environ['PATH']}",
+               PROBE_TIMEOUT="2", CAPTURE_LOG=str(log))
+    proc = subprocess.run(
+        ["bash", str(repo / "benchmarks" / "remaining_capture.sh")],
+        capture_output=True, text=True, timeout=90, env=env,
+        cwd=str(repo))
+    if proc.returncode == 4:
+        pytest.skip("a real capture instance holds the lock")
+    assert proc.returncode == 3, (proc.returncode, proc.stdout[-1000:],
+                                  proc.stderr[-1000:])
+    text = log.read_text()
+    assert "dispatch probe failed" in text
+    assert "parity" not in text          # the gate ran; no lane did
