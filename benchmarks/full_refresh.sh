@@ -19,4 +19,5 @@ dispatch_gate
 run baseline_suite  3600 python benchmarks/baseline_suite.py
 run window_scaling  1800 python examples/window_scaling.py
 run equiv_threshold 1800 python examples/equivocation_threshold.py
+commit_evidence "RESULTS refresh at HEAD on recovered hardware"
 echo "=== $(stamp) full refresh complete ===" | tee -a "$LOG"

@@ -24,4 +24,11 @@ run bench_stream  1800 python benchmarks/bench_streaming.py \
 run tpu_evidence  6600 python benchmarks/tpu_evidence.py
 run northstar_ntf 2400 python benchmarks/northstar.py --no-track-finality \
                        --workdir benchmarks/northstar_work_ntf
+# The ntf run's result lands in its (gitignored) workdir; copy it to a
+# tracked path so commit_evidence can preserve it.
+if [ -f benchmarks/northstar_work_ntf/result.json ]; then
+  cp benchmarks/northstar_work_ntf/result.json \
+     benchmarks/northstar_ntf_result.json
+fi
+commit_evidence "Hardware evidence captured on tunnel recovery: parity/streaming/roofline lanes"
 echo "=== $(stamp) remaining capture complete ===" | tee -a "$LOG"
