@@ -371,7 +371,7 @@ def _render_analysis_sections() -> list:
             if all(c["resolved"] < 0.5 for c in eq_cells if c["q"] >= q):
                 stall_min = q
         if live_max is None or stall_min is None:
-            return lines + [
+            lines += [
                 "",
                 "**Finding.** The sweep did not produce a clean q-organized "
                 "live/stall split",
@@ -379,7 +379,14 @@ def _render_analysis_sections() -> list:
                 "`examples/equivocation_threshold.py`.",
                 "",
             ]
-        lines += [
+        else:
+            lines += _equivocation_finding(live_max, stall_min)
+    lines += _render_churn_section()
+    return lines
+
+
+def _equivocation_finding(live_max, stall_min) -> list:
+    return [
             "",
             "**Finding.** The equivocation stall is organized by the "
             "effective lie",
@@ -414,6 +421,86 @@ def _render_analysis_sections() -> list:
             "begins (artifact: `examples/out/equivocation_threshold.json`).",
             "",
         ]
+
+
+def _render_churn_section() -> list:
+    ch_path = REPO / "examples" / "out" / "churn_tolerance.json"
+    if not ch_path.exists():
+        return []
+    ch = json.loads(ch_path.read_text())
+    cfg = ch["config"]
+    gaps = ch["worst_gap_per_model"]
+    lines = [
+        "## Churn tolerance: the quorum window is a ~a^7 availability "
+        "filter",
+        "",
+        f"Membership churn sweep (`examples/churn_tolerance.py`; "
+        f"{cfg['nodes']} nodes,",
+        f"round budget {cfg['rounds']}, per-round dead<->alive toggle "
+        "probability c;",
+        "measured simulator vs three analytic first-passage models — "
+        "medians:",
+        "uptime-only budget, two-factor dilution, exact quorum-window "
+        "DP):",
+        "",
+        "| churn c | finalized fraction | measured median | uptime-DP | "
+        "two-factor-DP | window-DP |",
+        "|---|---|---|---|---|---|",
+    ]
+    for cell in ch["cells"]:
+        mm = cell["model_medians"]
+
+        def fmt(v):
+            return v if v is not None else "—"
+
+        lines.append(
+            f"| {cell['churn']} | {cell['finalized_fraction']} "
+            f"| {fmt(cell['median_final_round'])} | {fmt(mm['uptime'])} "
+            f"| {fmt(mm['two_factor'])} | {fmt(mm['window'])} |")
+    lines += [
+        "",
+        "**Finding.** Conclusive votes arrive at exactly the two-factor "
+        "rate (own",
+        "uptime x peer availability; telemetry-verified), yet neither "
+        "participation",
+        "model predicts finality — only the exact window DP tracks it "
+        "(worst",
+        f"completeness gap {gaps['window']} vs {gaps['two_factor']} / "
+        f"{gaps['uptime']}; the window residual above the "
+        f"{ch['noise_floor_3sigma']} binomial",
+        "noise floor is the DP's mean-field error — within-round peer "
+        "draws share one",
+        "realized alive fraction — and errs conservative everywhere).",
+        "The mechanism is the kernel's own quorum rule (`vote.go:54-75`): "
+        "EVERY vote",
+        "shifts the 8-slot window, a timed-out (dead-peer) query occupies "
+        "a slot with",
+        "its consider bit off, and confidence bumps only when >= 7 of the "
+        "last 8 slots",
+        "are considered-yes — so the bump rate per slot is P[Bin(8, a) >= "
+        "7] =",
+        "a^8 + 8 a^7 (1-a) ~ 8 a^7: finality throughput degrades with the "
+        "SEVENTH",
+        "power of response availability, not linearly.  The 8 a^7 (1-a) "
+        "term is the",
+        "filter's forgiveness — an isolated neutral is free (7 of 8 still "
+        "bumps),",
+        "which is why the window model even beats two-factor dilution at "
+        "low churn;",
+        "the cost begins at >= 2 neutrals per window and then compounds.  "
+        "Churn never",
+        "stalls consensus (confidence pauses, never resets — no "
+        "metastability, unlike",
+        "equivocation), but sustained availability below ~85% makes "
+        "latency explode.",
+        "The same filter prices every neutral source (drop_probability, "
+        "request",
+        "expiry); the latency-weighted/clustered sampling families "
+        "sidestep it by",
+        "masking dead peers in their draw weights "
+        "(artifact: `examples/out/churn_tolerance.json`).",
+        "",
+    ]
     return lines
 
 

@@ -1,0 +1,266 @@
+"""Churn tolerance: the quorum window is a 7th-power availability filter.
+
+The equivocation sweep (`examples/equivocation_threshold.py`) located the
+protocol's one genuine liveness *attack* (the metastable preference
+loop).  This study quantifies the cost of *benign* dynamism — membership
+churn (`config.churn_probability`: nodes toggle dead<->alive per round,
+the `Connman` add/remove plane of `net.go:3-31` exercised continuously)
+— by testing three analytic models against the simulator:
+
+1. **Own-uptime budget**: a node ingests k conclusive votes per alive
+   round; finality = first-passage to ceil(134/k) alive-rounds.
+2. **Two-factor dilution**: under uniform sampling the peer draw ignores
+   aliveness — querying a departed peer times out to a NEUTRAL vote
+   (faithful to the reference's request-expiry semantics,
+   `processor.go:21,40`, neutral err `vote.go:56`) — so an alive node
+   gains Binomial(k, a_r) conclusive votes per round, where
+   a_r = 1/2 + (1-2c)^r/2 is the mean-field alive fraction.
+3. **Quorum-window filter** (exact kernel semantics, `vote.go:54-75` /
+   `ops/voterecord._apply_vote_bits`): EVERY vote shifts the 8-slot
+   window and a neutral vote occupies a slot with its consider bit off;
+   confidence bumps only when >= 7 of the last 8 slots are
+   considered-yes (and pauses — does not reset — otherwise).  Model: DP
+   over (alive, consider-window pattern, bumps) with consider bits
+   Bernoulli(a_r), absorbing at 128 bumps.
+
+Measured result (see RESULTS.md "Churn" section): models 1 and 2 fail
+badly above ~1% churn — votes ARE applied at exactly the two-factor
+rate (verified via telemetry), yet finality lags by 2x and collapses at
+the round budget — while model 3 tracks the simulator across the whole
+grid to within ~0.09 completeness (the others are off by up to 1.0).
+The residual exceeds per-node binomial noise and is the model's
+mean-field error — consider bits treated as independent where the real
+within-round draws share one realized alive fraction (convexity of the
+~a^7 rate makes fluctuations help), plus finite-size wander of that
+fraction — and it errs on the conservative side everywhere.  The protocol content: the 8-window/7-quorum rule makes finality
+throughput scale like P[Bin(8, a) >= 7] = a^8 + 8 a^7 (1-a), i.e.
+**~8 a^7 for a < 1**: the chit pipeline degrades with the SEVENTH power
+of response availability, not linearly.  The 8 a^7 (1-a) term is the
+filter's forgiveness: an ISOLATED neutral slot costs nothing (7
+considered-yes of 8 still bumps), so at low churn the window model even
+beats the two-factor model (which forfeits every neutral vote); the
+cost begins at >= 2 neutrals per window and then compounds.  Churn
+never stalls consensus (confidence pauses rather than resets — no
+metastability, unlike equivocation), but sustained availability below
+~85% makes finality latency explode multiplicatively.  The same filter applies to any
+source of neutral responses (`drop_probability`, request expiry), which
+is why the latency-weighted/clustered sampling families mask dead peers
+in their draw weights instead of paying it.
+
+Usage:
+    python examples/churn_tolerance.py [--nodes 4096] [--txs 32]
+        [--rounds 128] [--json-out examples/out/churn_tolerance.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")  # allow running from the repo root
+
+import jax
+import numpy as np
+
+from go_avalanche_tpu.config import AvalancheConfig
+from go_avalanche_tpu.models import avalanche as av
+
+CHURN_GRID = (0.0, 0.001, 0.003, 0.01, 0.03, 0.1, 0.3, 0.5)
+CUTOFFS = (17, 20, 25, 34, 50, 128)
+VOTES_NEEDED = 134      # 6 warm-up + 128 bumps at k=8 (golden-pinned)
+BUMPS_NEEDED = 128      # finalization_score
+WINDOW, QUORUM = 8, 7
+
+
+def alive_fraction(c: float, r: int) -> float:
+    """Mean-field alive fraction at round r (0-based), all-alive start."""
+    return 0.5 + 0.5 * (1.0 - 2.0 * c) ** r
+
+
+def uptime_dp(c: float, k: int, max_rounds: int) -> np.ndarray:
+    """Model 1: P[>= ceil(134/k) alive-rounds by round r] (1-based r)."""
+    threshold = -(-VOTES_NEEDED // k)
+    dist = np.zeros((2, threshold))
+    dist[1, 0] = 1.0
+    done = np.zeros(max_rounds)
+    absorbed = 0.0
+    for r in range(max_rounds):
+        new = np.zeros_like(dist)
+        absorbed += dist[1, threshold - 1]
+        new[1, 1:] = dist[1, :-1]
+        new[0] = dist[0]
+        dist[1] = new[1] * (1 - c) + new[0] * c
+        dist[0] = new[0] * (1 - c) + new[1] * c
+        done[r] = absorbed
+    return done
+
+
+def two_factor_dp(c: float, k: int, max_rounds: int) -> np.ndarray:
+    """Model 2: P[>= 134 conclusive votes by round r] (1-based r)."""
+    needed = VOTES_NEEDED
+    js = np.arange(k + 1)
+    comb = np.array([math.comb(k, j) for j in js], dtype=np.float64)
+    dist = np.zeros((2, needed))
+    dist[1, 0] = 1.0
+    done = np.zeros(max_rounds)
+    absorbed = 0.0
+    for r in range(max_rounds):
+        a = alive_fraction(c, r)
+        pmf = comb * a ** js * (1.0 - a) ** (k - js)
+        alive_row = dist[1]
+        acc = pmf[0] * alive_row
+        for j in range(1, k + 1):
+            absorbed += pmf[j] * alive_row[needed - j:].sum()
+            shifted = np.zeros(needed)
+            shifted[j:] = alive_row[: needed - j]
+            acc = acc + pmf[j] * shifted
+        dist = np.stack([dist[0] * (1 - c) + acc * c,
+                         acc * (1 - c) + dist[0] * c])
+        done[r] = absorbed
+    return done
+
+
+def window_dp(c: float, k: int, max_rounds: int) -> np.ndarray:
+    """Model 3: exact kernel DP — P[finalized by round r] (1-based r).
+
+    State (alive in {0,1}, consider-window pattern in 2^8, bumps<128);
+    per vote-slot an ALIVE node shifts a Bernoulli(a_r) consider bit in
+    and bumps iff the new window has >= QUORUM considered (all conclusive
+    votes are honest YES here, so considered == considered-yes); dead
+    nodes' windows freeze.  Mean-field over peers, exact in everything
+    else.
+    """
+    n_w = 1 << WINDOW
+    half = n_w >> 1
+    popcount = np.array([bin(w).count("1") for w in range(n_w)])
+    # Shift map: w -> ((w & 127) << 1) | b; pairs (w, w+128) merge.
+    targets0 = (np.arange(half) << 1)           # b = 0 (neutral slot)
+    targets1 = targets0 | 1                     # b = 1 (considered yes)
+    dist = np.zeros((2, n_w, BUMPS_NEEDED))
+    dist[1, 0, 0] = 1.0
+    done = np.zeros(max_rounds)
+    absorbed = 0.0
+    for r in range(max_rounds):
+        a = alive_fraction(c, r)
+        for _ in range(k):
+            mass = dist[1]
+            merged = mass[:half] + mass[half:]              # [half, B]
+            new = np.zeros_like(mass)
+            for b, p, targets in ((0, 1 - a, targets0), (1, a, targets1)):
+                bumped = popcount[targets] >= QUORUM
+                t_nb, t_b = targets[~bumped], targets[bumped]
+                new[t_nb] += p * merged[~bumped]
+                src = merged[bumped]
+                absorbed += p * src[:, -1].sum()
+                new[t_b, 1:] += p * src[:, :-1]
+            dist[1] = new
+        done[r] = absorbed
+        # Toggle: windows and bump counts ride along dead<->alive.
+        dead, alive_m = dist[0], dist[1]
+        dist = np.stack([dead * (1 - c) + alive_m * c,
+                         alive_m * (1 - c) + dead * c])
+    return done
+
+
+def measure_cell(n_nodes: int, n_txs: int, rounds: int, c: float,
+                 seed: int) -> np.ndarray:
+    """Per-node finality round (1-based; -1 if unfinalized) from one run."""
+    cfg = AvalancheConfig(churn_probability=c, gossip=False)
+    state = av.init(jax.random.key(seed), n_nodes, n_txs, cfg)
+    final, _ = jax.jit(av.run_scan, static_argnames=("cfg", "n_rounds"))(
+        state, cfg, rounds)
+    fin_at = np.asarray(jax.device_get(final.finalized_at))  # [N, T], -1 open
+    node_round = fin_at.max(axis=1)          # a node's slowest target
+    node_round = np.where((fin_at >= 0).all(axis=1), node_round + 1, -1)
+    return node_round
+
+
+def _median_round(done: np.ndarray) -> int | None:
+    idx = int(np.searchsorted(done, 0.5))
+    return idx + 1 if idx < len(done) else None
+
+
+def main(argv=None) -> dict:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--nodes", type=int, default=4096)
+    ap.add_argument("--txs", type=int, default=32)
+    ap.add_argument("--rounds", type=int, default=128)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--force-cpu", action="store_true",
+                    help="pin the CPU backend (the jax.config route — a "
+                    "JAX_PLATFORMS env var cannot override the axon "
+                    "sitecustomize)")
+    ap.add_argument("--json-out", type=str,
+                    default="examples/out/churn_tolerance.json")
+    args = ap.parse_args(argv)
+    if args.force_cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    k = AvalancheConfig().k
+    cells, worst = [], {"uptime": 0.0, "two_factor": 0.0, "window": 0.0}
+    t0 = time.time()
+    for c in CHURN_GRID:
+        node_round = measure_cell(args.nodes, args.txs, args.rounds, c,
+                                  args.seed)
+        dps = {"uptime": uptime_dp(c, k, args.rounds),
+               "two_factor": two_factor_dp(c, k, args.rounds),
+               "window": window_dp(c, k, args.rounds)}
+        finalized = node_round >= 0
+        row = {"churn": c,
+               "finalized_fraction": round(float(finalized.mean()), 4),
+               "median_final_round": (int(np.median(node_round[finalized]))
+                                      if finalized.any() else None),
+               "model_medians": {m: _median_round(d)
+                                 for m, d in dps.items()},
+               "completeness": {}}
+        for r in CUTOFFS:
+            if r > args.rounds:
+                continue
+            measured = float((node_round[finalized] <= r).sum()
+                             / len(node_round))
+            entry = {"measured": round(measured, 4)}
+            for m, d in dps.items():
+                entry[m] = round(float(d[r - 1]), 4)
+                worst[m] = max(worst[m], abs(measured - float(d[r - 1])))
+            row["completeness"][str(r)] = entry
+        cells.append(row)
+        print(f"churn={c:<6} finalized={row['finalized_fraction']:<7} "
+              f"median={row['median_final_round']} "
+              f"models={row['model_medians']}", flush=True)
+
+    # Worst-case 3-sigma band on a measured fraction (p=1/2); per-node
+    # finality events are positively correlated through the shared alive
+    # trajectory, so treat this as a floor, not the expected residual —
+    # the window model's residual above it is mean-field error (see
+    # module docstring), conservative side.
+    noise = 1.5 / np.sqrt(args.nodes)
+    result = {
+        "config": {"nodes": args.nodes, "txs": args.txs,
+                   "rounds": args.rounds, "k": k, "seed": args.seed,
+                   "votes_needed": VOTES_NEEDED,
+                   "backend": jax.devices()[0].platform},
+        "cells": cells,
+        "worst_gap_per_model": {m: round(v, 4) for m, v in worst.items()},
+        "noise_floor_3sigma": round(float(noise), 4),
+        "rate_factor_note": "bump rate per slot = P[Bin(8,a)>=7] "
+                            "= a^8 + 8 a^7 (1-a)  (~8 a^7 for a<1)",
+        "elapsed_s": round(time.time() - t0, 1),
+    }
+    os.makedirs(os.path.dirname(args.json_out) or ".", exist_ok=True)
+    with open(args.json_out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(f"\nworst |measured-model| per model: "
+          f"{result['worst_gap_per_model']} "
+          f"(3-sigma binomial noise floor "
+          f"{result['noise_floor_3sigma']}; the window model's residual "
+          f"above it is mean-field error, conservative side)")
+    print(f"artifact: {args.json_out} ({result['elapsed_s']}s)")
+    return result
+
+
+if __name__ == "__main__":
+    main()
