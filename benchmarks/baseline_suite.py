@@ -429,7 +429,7 @@ def _render_churn_section() -> list:
         return []
     ch = json.loads(ch_path.read_text())
     cfg = ch["config"]
-    gaps = ch["worst_gap_per_model"]
+    gaps = ch["worst_gap_per_pairing"]
     lines = [
         "## Churn tolerance: the quorum window is a ~a^7 availability "
         "filter",
@@ -437,15 +437,18 @@ def _render_churn_section() -> list:
         f"Membership churn sweep (`examples/churn_tolerance.py`; "
         f"{cfg['nodes']} nodes,",
         f"round budget {cfg['rounds']}, per-round dead<->alive toggle "
-        "probability c;",
-        "measured simulator vs three analytic first-passage models — "
-        "medians:",
-        "uptime-only budget, two-factor dilution, exact quorum-window "
-        "DP):",
+        "probability c.",
+        "Both non-response semantics measured — the window-shifting "
+        "default and",
+        "`skip_absent_votes` (reference-host expiry semantics) — against "
+        "three",
+        "first-passage models (medians shown: exact quorum-window DP for "
+        "the default,",
+        "two-factor dilution DP for skip; uptime-only in the artifact):",
         "",
-        "| churn c | finalized fraction | measured median | uptime-DP | "
-        "two-factor-DP | window-DP |",
-        "|---|---|---|---|---|---|",
+        "| churn c | default: finalized | default median | window-DP | "
+        "skip: finalized | skip median | two-factor-DP |",
+        "|---|---|---|---|---|---|---|",
     ]
     for cell in ch["cells"]:
         mm = cell["model_medians"]
@@ -454,50 +457,64 @@ def _render_churn_section() -> list:
             return v if v is not None else "—"
 
         lines.append(
-            f"| {cell['churn']} | {cell['finalized_fraction']} "
-            f"| {fmt(cell['median_final_round'])} | {fmt(mm['uptime'])} "
-            f"| {fmt(mm['two_factor'])} | {fmt(mm['window'])} |")
+            f"| {cell['churn']} "
+            f"| {cell['default']['finalized_fraction']} "
+            f"| {fmt(cell['default']['median_final_round'])} "
+            f"| {fmt(mm['window'])} "
+            f"| {cell['skip']['finalized_fraction']} "
+            f"| {fmt(cell['skip']['median_final_round'])} "
+            f"| {fmt(mm['two_factor'])} |")
     lines += [
         "",
-        "**Finding.** Conclusive votes arrive at exactly the two-factor "
-        "rate (own",
-        "uptime x peer availability; telemetry-verified), yet neither "
-        "participation",
-        "model predicts finality — only the exact window DP tracks it "
-        "(worst",
-        f"completeness gap {gaps['window']} vs {gaps['two_factor']} / "
-        f"{gaps['uptime']}; the window residual above the "
-        f"{ch['noise_floor_3sigma']} binomial",
-        "noise floor is the DP's mean-field error — within-round peer "
-        "draws share one",
-        "realized alive fraction — and errs conservative everywhere).",
-        "The mechanism is the kernel's own quorum rule (`vote.go:54-75`): "
-        "EVERY vote",
-        "shifts the 8-slot window, a timed-out (dead-peer) query occupies "
-        "a slot with",
-        "its consider bit off, and confidence bumps only when >= 7 of the "
-        "last 8 slots",
-        "are considered-yes — so the bump rate per slot is P[Bin(8, a) >= "
-        "7] =",
-        "a^8 + 8 a^7 (1-a) ~ 8 a^7: finality throughput degrades with the "
-        "SEVENTH",
-        "power of response availability, not linearly.  The 8 a^7 (1-a) "
-        "term is the",
-        "filter's forgiveness — an isolated neutral is free (7 of 8 still "
-        "bumps),",
-        "which is why the window model even beats two-factor dilution at "
-        "low churn;",
-        "the cost begins at >= 2 neutrals per window and then compounds.  "
-        "Churn never",
-        "stalls consensus (confidence pauses, never resets — no "
-        "metastability, unlike",
-        "equivocation), but sustained availability below ~85% makes "
-        "latency explode.",
-        "The same filter prices every neutral source (drop_probability, "
-        "request",
-        "expiry); the latency-weighted/clustered sampling families "
-        "sidestep it by",
-        "masking dead peers in their draw weights "
+        "**Finding.** In the default semantics, conclusive votes arrive "
+        "at exactly",
+        "the two-factor rate (own uptime x peer availability; "
+        "telemetry-verified),",
+        "yet no participation model predicts finality — only the exact "
+        "window DP",
+        f"tracks it (worst completeness gap {gaps['window_vs_default']} "
+        f"vs {gaps['two_factor_vs_default']} /",
+        f"{gaps['uptime_vs_default']}; its residual above the "
+        f"{ch['noise_floor_3sigma']} binomial noise floor is",
+        "mean-field error, conservative side).  The mechanism is the "
+        "quorum rule",
+        "(`vote.go:54-75`): EVERY vote shifts the 8-slot window, a "
+        "timed-out query",
+        "occupies a slot with its consider bit off, and confidence bumps "
+        "only when",
+        ">= 7 of the last 8 slots are considered-yes — bump rate per "
+        "slot =",
+        "P[Bin(8, a) >= 7] = a^8 + 8 a^7 (1-a) ~ 8 a^7: finality degrades "
+        "with the",
+        "SEVENTH power of availability.  An isolated neutral is free (7 "
+        "of 8 still",
+        "bumps — the 8 a^7 (1-a) term); the cost starts at >= 2 neutrals "
+        "per window",
+        "and compounds.  Churn never stalls consensus (confidence pauses, "
+        "never",
+        "resets — no metastability, unlike equivocation), but sustained "
+        "availability",
+        "below ~85% explodes latency.",
+        "",
+        "The study exposed a semantic choice: the reference HOST path "
+        "never delivers",
+        "a dead peer's vote at all (request expiry, `response.go:5-51` — "
+        "no window",
+        "shift), where the batched default synthesizes a window-shifting "
+        "neutral.",
+        "`config.skip_absent_votes=True` implements the host semantics, "
+        "and measured",
+        "trajectories under it match the two-factor DP essentially "
+        "exactly (worst",
+        f"gap {gaps['two_factor_vs_skip']}; medians coincide) — churn "
+        "cost collapses from ~a^7 to",
+        "linear dilution: at c=0.1 skip mode finalizes ~99% by round ~54 "
+        "where the",
+        "default finalizes nothing within the budget.  The default stays "
+        "window-",
+        "shifting as the conservative wire-protocol reading (a timeout IS "
+        "evidence of",
+        "unavailability; the window is the protocol's recency filter) "
         "(artifact: `examples/out/churn_tolerance.json`).",
         "",
     ]

@@ -131,6 +131,22 @@ class AvalancheConfig:
                                       #   => neutral vote, vote.go:56 semantics)
     churn_probability: float = 0.0    # P(a node toggles dead<->alive, per
                                       #   round) — dynamic membership
+    skip_absent_votes: bool = False   # what a NON-response (dead peer,
+                                      #   drop, self-draw) does to the
+                                      #   vote window.  False: a delivered
+                                      #   neutral — shifts the window with
+                                      #   its consider bit off
+                                      #   (vote.go:54-75), making finality
+                                      #   degrade ~8*a^7 in availability a
+                                      #   (RESULTS.md churn study).  True:
+                                      #   registers nothing, like the
+                                      #   reference HOST path where an
+                                      #   expired/missing response never
+                                      #   reaches RegisterVotes
+                                      #   (processor.go:61-122,
+                                      #   response.go expiry) — cost
+                                      #   becomes linear dilution.
+                                      #   SEQUENTIAL vote mode only.
 
     def __post_init__(self) -> None:
         if not (0 < self.window <= 8):
@@ -149,6 +165,11 @@ class AvalancheConfig:
                 "top-k over all N peers (O(N^2) state)")
         if self.n_clusters < 1:
             raise ValueError("n_clusters must be >= 1 (1 = no clustering)")
+        if self.skip_absent_votes and self.vote_mode is not VoteMode.SEQUENTIAL:
+            raise ValueError(
+                "skip_absent_votes applies to the SEQUENTIAL vote mode only "
+                "(the QUORUM mode's alpha-threshold already consumes "
+                "absence as its neutral outcome)")
         if self.n_clusters > 1 and not self.sample_with_replacement:
             raise ValueError(
                 "clustered topology requires sample_with_replacement "

@@ -167,7 +167,10 @@ def register_packed_votes_fused(
     """Dispatch between the XLA path (default — measured faster, see module
     docstring) and the Pallas kernel (`prefer_pallas=True`, 2D
     block-divisible shapes only)."""
-    if prefer_pallas and state.votes.ndim == 2:
+    # The Pallas kernel implements only the default (delivered-neutral)
+    # consider semantics; skip_absent_votes configs fall through to the
+    # XLA path, which reads the flag from cfg.
+    if prefer_pallas and state.votes.ndim == 2 and not cfg.skip_absent_votes:
         n, t = state.votes.shape
         bn, bt = min(DEFAULT_BLOCK[0], n), min(DEFAULT_BLOCK[1], t)
         if n % bn == 0 and t % bt == 0:

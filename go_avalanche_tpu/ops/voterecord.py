@@ -205,6 +205,7 @@ def register_packed_votes(
     k: int,
     cfg: AvalancheConfig = DEFAULT_CONFIG,
     update_mask: jax.Array | None = None,
+    absent_is_skip: bool | None = None,
 ) -> Tuple[VoteRecordState, jax.Array]:
     """Apply k votes per record from bit-packed planes, oldest-first.
 
@@ -218,10 +219,29 @@ def register_packed_votes(
     votes, which is what one reference response produces at most one status
     update per target from, `processor.go:105-112`).
 
+    `absent_is_skip` selects what a zero consider bit MEANS.  False: a
+    DELIVERED neutral vote — it shifts the window with its consider bit
+    off, exactly `vote.go:54-75`.  True: a vote that never arrived — the
+    slot registers NOTHING (no shift, no confidence transition),
+    mirroring the reference HOST path where an expired or missing
+    response never reaches RegisterVotes at all (`processor.go:61-122`;
+    `response.go:5-51` expiry) and present votes are conclusive.  None
+    (the default) reads `cfg.skip_absent_votes`, so every ingest site —
+    including the fused/Pallas dispatcher's fallback — follows the
+    config with no per-call-site threading; pass a bool to override
+    explicitly (tests).  The window-occupancy cost of the False mode is
+    quantified in RESULTS.md's churn study.
+
     Returns (new_state, any_changed).
     """
     if not (0 < k <= 8):
         raise ValueError("k must be in (0, 8] for uint8 packing")
+
+    if absent_is_skip is None:
+        absent_is_skip = cfg.skip_absent_votes
+    if absent_is_skip:
+        return _register_packed_votes_skip(state, yes_pack, consider_pack,
+                                           k, cfg, update_mask)
 
     votes, consider, confidence = state
     any_changed = jnp.zeros(state.votes.shape, jnp.bool_)
@@ -281,6 +301,49 @@ def register_packed_votes(
     if not full_window:
         votes &= window_mask
         consider &= window_mask
+    new_state = VoteRecordState(votes, consider, confidence)
+    if update_mask is not None:
+        update_mask = jnp.asarray(update_mask, jnp.bool_)
+        new_state = VoteRecordState(
+            jnp.where(update_mask, new_state.votes, state.votes),
+            jnp.where(update_mask, new_state.consider, state.consider),
+            jnp.where(update_mask, new_state.confidence, state.confidence),
+        )
+        any_changed = any_changed & update_mask
+    return new_state, any_changed
+
+
+def _register_packed_votes_skip(
+    state: VoteRecordState,
+    yes_pack: jax.Array,
+    present_pack: jax.Array,
+    k: int,
+    cfg: AvalancheConfig,
+    update_mask: jax.Array | None,
+) -> Tuple[VoteRecordState, jax.Array]:
+    """`register_packed_votes` with absent slots registering nothing.
+
+    Plain per-slot `_apply_vote_bits` + select (no incremental-counter
+    fusion): this path only activates for configs with non-responses
+    (churn / drops / weighted self-draws) under `skip_absent_votes`, never
+    for the flagship bench config, so clarity wins over the hand-fused
+    form.  Present votes carry non_neutral=True — every batched responder
+    commits to a preference; delivered-neutral semantics remain the
+    default mode's job.
+    """
+    votes, consider, confidence = state
+    any_changed = jnp.zeros(state.votes.shape, jnp.bool_)
+    for j in range(k):
+        bit = jnp.uint8(1 << j)
+        present = (present_pack & bit) != 0
+        yes_bit = (yes_pack & bit) != 0
+        v2, c2, conf2, ch2 = _apply_vote_bits(
+            votes, consider, confidence, yes_bit,
+            jnp.ones_like(yes_bit), cfg)
+        votes = jnp.where(present, v2, votes)
+        consider = jnp.where(present, c2, consider)
+        confidence = jnp.where(present, conf2, confidence)
+        any_changed |= ch2 & present
     new_state = VoteRecordState(votes, consider, confidence)
     if update_mask is not None:
         update_mask = jnp.asarray(update_mask, jnp.bool_)

@@ -144,7 +144,9 @@ def test_churn_artifact_reproduces_cross_backend():
     art = json.load(open(path))
     c = art["config"]
     cell = next(x for x in art["cells"] if x["churn"] == 0.01)
-    node_round = measure_cell(c["nodes"], c["txs"], c["rounds"], 0.01,
-                              c["seed"])
-    assert round(float((node_round >= 0).mean()), 4) \
-        == cell["finalized_fraction"], cell
+    for mode, skip in (("default", False), ("skip", True)):
+        node_round = measure_cell(c["nodes"], c["txs"], c["rounds"], 0.01,
+                                  c["seed"], skip_absent=skip,
+                                  n_seeds=c["n_seeds"])
+        assert round(float((node_round >= 0).mean()), 4) \
+            == cell[mode]["finalized_fraction"], (mode, cell)

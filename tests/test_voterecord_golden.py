@@ -182,3 +182,66 @@ def test_vmap_over_batch_matches_elementwise():
     single = jnp.stack([run_one(jnp.array(a)) for a in (False, True)])
     batched = jax.vmap(run_one)(jnp.array([False, True]))
     np.testing.assert_array_equal(np.asarray(single), np.asarray(batched))
+
+
+def test_skip_mode_matches_present_only_sequential():
+    """`absent_is_skip=True`: an absent slot registers NOTHING — the
+    packed result must equal applying register_vote ONLY for present
+    slots (the reference HOST semantics: an expired/missing response
+    never reaches RegisterVotes, `processor.go:61-122`).  Present votes
+    are conclusive yes/no."""
+    rng = np.random.default_rng(11)
+    batch, rounds, k = 32, 40, 8
+    yes = rng.random((rounds, k, batch)) < 0.7
+    present = rng.random((rounds, k, batch)) < 0.6
+
+    seq_state = vr.init_state(jnp.zeros((batch,), jnp.bool_))
+    pack_state = vr.init_state(jnp.zeros((batch,), jnp.bool_))
+    for r in range(rounds):
+        for j in range(k):
+            err = np.where(yes[r, j], 0, 1).astype(np.int32)
+            seq_state, _ = vr.register_vote(
+                seq_state, jnp.asarray(err),
+                update_mask=jnp.asarray(present[r, j]))
+        yes_pack = np.zeros((batch,), np.uint8)
+        present_pack = np.zeros((batch,), np.uint8)
+        for j in range(k):
+            yes_pack |= (yes[r, j].astype(np.uint8) << j)
+            present_pack |= (present[r, j].astype(np.uint8) << j)
+        pack_state, _ = vr.register_packed_votes(
+            pack_state, jnp.asarray(yes_pack), jnp.asarray(present_pack),
+            k, absent_is_skip=True)
+    for a, b in zip(seq_state, pack_state):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_skip_mode_all_present_matches_default_mode():
+    """With every slot present the two consider-bit meanings coincide:
+    skip mode and the default fused path must be bit-identical."""
+    rng = np.random.default_rng(13)
+    batch, rounds, k = 16, 30, 8
+    full = np.uint8(0xFF)
+    a = vr.init_state(jnp.zeros((batch,), jnp.bool_))
+    b = vr.init_state(jnp.zeros((batch,), jnp.bool_))
+    for _ in range(rounds):
+        yes_pack = jnp.asarray(rng.integers(0, 256, batch, dtype=np.uint8))
+        a, ch_a = vr.register_packed_votes(a, yes_pack, full, k)
+        b, ch_b = vr.register_packed_votes(b, yes_pack, full, k,
+                                           absent_is_skip=True)
+        np.testing.assert_array_equal(np.asarray(ch_a), np.asarray(ch_b))
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_skip_mode_all_absent_is_identity():
+    """A fully absent pack must leave every plane untouched and report
+    no change."""
+    state = vr.init_state(jnp.asarray([True, False]))
+    for _ in range(3):
+        state, _ = vr.register_vote(state, jnp.int32(0))
+    before = state
+    after, changed = vr.register_packed_votes(
+        state, jnp.uint8(0xFF), jnp.uint8(0), 8, absent_is_skip=True)
+    assert not bool(np.asarray(changed).any())
+    for x, y in zip(before, after):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
