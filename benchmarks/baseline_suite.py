@@ -423,6 +423,31 @@ def _equivocation_finding(live_max, stall_min) -> list:
         ]
 
 
+def _fmt_dash(v):
+    return v if v is not None else "—"
+
+
+def _semantics_table(cells: list, key: str) -> list:
+    """The shared measured-vs-models table of the churn/drop sweeps: one
+    row per grid value, both non-response semantics beside their DPs."""
+    lines = [
+        f"| {key} | default: finalized | default median | window-DP | "
+        "skip: finalized | skip median | two-factor-DP |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for cell in cells:
+        mm = cell["model_medians"]
+        lines.append(
+            f"| {cell[key]} "
+            f"| {cell['default']['finalized_fraction']} "
+            f"| {_fmt_dash(cell['default']['median_final_round'])} "
+            f"| {_fmt_dash(mm['window'])} "
+            f"| {cell['skip']['finalized_fraction']} "
+            f"| {_fmt_dash(cell['skip']['median_final_round'])} "
+            f"| {_fmt_dash(mm['two_factor'])} |")
+    return lines
+
+
 def _render_churn_section() -> list:
     ch_path = REPO / "examples" / "out" / "churn_tolerance.json"
     if not ch_path.exists():
@@ -446,24 +471,8 @@ def _render_churn_section() -> list:
         "the default,",
         "two-factor dilution DP for skip; uptime-only in the artifact):",
         "",
-        "| churn c | default: finalized | default median | window-DP | "
-        "skip: finalized | skip median | two-factor-DP |",
-        "|---|---|---|---|---|---|---|",
     ]
-    for cell in ch["cells"]:
-        mm = cell["model_medians"]
-
-        def fmt(v):
-            return v if v is not None else "—"
-
-        lines.append(
-            f"| {cell['churn']} "
-            f"| {cell['default']['finalized_fraction']} "
-            f"| {fmt(cell['default']['median_final_round'])} "
-            f"| {fmt(mm['window'])} "
-            f"| {cell['skip']['finalized_fraction']} "
-            f"| {fmt(cell['skip']['median_final_round'])} "
-            f"| {fmt(mm['two_factor'])} |")
+    lines += _semantics_table(ch["cells"], "churn")
     lines += [
         "",
         "**Finding.** In the default semantics, conclusive votes arrive "
@@ -496,6 +505,28 @@ def _render_churn_section() -> list:
         "availability",
         "below ~85% explodes latency.",
         "",
+    ]
+    if ch.get("drop_cells"):
+        dgaps = ch["drop_worst_gap_per_pairing"]
+        lines += [
+            "The same filter prices response DROPS (per-slot iid, "
+            "constant availability",
+            "a = 1-d — no trajectory noise), where the validation is "
+            "exact: measured",
+            "medians equal the constant-a DPs at every drop rate in both "
+            "semantics, and",
+            f"the worst completeness gaps (window_vs_default "
+            f"{dgaps['window_vs_default']}, two_factor_vs_skip",
+            f"{dgaps['two_factor_vs_skip']}) sit BELOW the binomial noise "
+            "floor — confirming the",
+            "churn-mode residual above is trajectory realization "
+            "variance, not model",
+            "error:",
+            "",
+        ]
+        lines += _semantics_table(ch["drop_cells"], "drop")
+        lines += [""]
+    lines += [
         "The study exposed a semantic choice: the reference HOST path "
         "never delivers",
         "a dead peer's vote at all (request expiry, `response.go:5-51` — "

@@ -85,6 +85,7 @@ from go_avalanche_tpu.config import AvalancheConfig
 from go_avalanche_tpu.models import avalanche as av
 
 CHURN_GRID = (0.0, 0.001, 0.003, 0.01, 0.03, 0.1, 0.3, 0.5)
+DROP_GRID = (0.05, 0.1, 0.2, 0.3)
 CUTOFFS = (17, 20, 25, 34, 50, 128)
 VOTES_NEEDED = 134      # 6 warm-up + 128 bumps at k=8 (golden-pinned)
 BUMPS_NEEDED = 128      # finalization_score
@@ -114,8 +115,11 @@ def uptime_dp(c: float, k: int, max_rounds: int) -> np.ndarray:
     return done
 
 
-def two_factor_dp(c: float, k: int, max_rounds: int) -> np.ndarray:
-    """Model 2: P[>= 134 conclusive votes by round r] (1-based r)."""
+def _votes_fp_dp(avail_fn, toggle_c: float, k: int,
+                 max_rounds: int) -> np.ndarray:
+    """First-passage DP to 134 votes: an alive node gains
+    Binomial(k, avail_fn(r)) conclusive votes per round, then everything
+    toggles dead<->alive with prob `toggle_c` (0 = always alive)."""
     needed = VOTES_NEEDED
     js = np.arange(k + 1)
     comb = np.array([math.comb(k, j) for j in js], dtype=np.float64)
@@ -123,8 +127,9 @@ def two_factor_dp(c: float, k: int, max_rounds: int) -> np.ndarray:
     dist[1, 0] = 1.0
     done = np.zeros(max_rounds)
     absorbed = 0.0
+    c = toggle_c
     for r in range(max_rounds):
-        a = alive_fraction(c, r)
+        a = avail_fn(r)
         pmf = comb * a ** js * (1.0 - a) ** (k - js)
         alive_row = dist[1]
         acc = pmf[0] * alive_row
@@ -139,15 +144,28 @@ def two_factor_dp(c: float, k: int, max_rounds: int) -> np.ndarray:
     return done
 
 
-def window_dp(c: float, k: int, max_rounds: int) -> np.ndarray:
-    """Model 3: exact kernel DP — P[finalized by round r] (1-based r).
+def two_factor_dp(c: float, k: int, max_rounds: int) -> np.ndarray:
+    """Model 2: P[>= 134 conclusive votes by round r] (1-based r)."""
+    return _votes_fp_dp(lambda r: alive_fraction(c, r), c, k, max_rounds)
+
+
+def drop_two_factor_dp(d: float, k: int, max_rounds: int) -> np.ndarray:
+    """Skip-semantics DP for drops: Binomial(k, 1-d) conclusive votes per
+    round, always-alive, first-passage to 134."""
+    return _votes_fp_dp(lambda r: 1.0 - d, 0.0, k, max_rounds)
+
+
+def _window_fp_dp(avail_fn, toggle_c: float, k: int,
+                  max_rounds: int) -> np.ndarray:
+    """Exact kernel DP — P[finalized by round r] (1-based r).
 
     State (alive in {0,1}, consider-window pattern in 2^8, bumps<128);
-    per vote-slot an ALIVE node shifts a Bernoulli(a_r) consider bit in
-    and bumps iff the new window has >= QUORUM considered (all conclusive
-    votes are honest YES here, so considered == considered-yes); dead
-    nodes' windows freeze.  Mean-field over peers, exact in everything
-    else.
+    per vote-slot an ALIVE node shifts a Bernoulli(avail_fn(r)) consider
+    bit in and bumps iff the new window has >= QUORUM considered (all
+    conclusive votes are honest YES here, so considered ==
+    considered-yes); dead nodes' windows freeze, and everything toggles
+    dead<->alive with prob `toggle_c` (0 = always alive) after each
+    round.  Mean-field over peers, exact in everything else.
     """
     n_w = 1 << WINDOW
     half = n_w >> 1
@@ -159,8 +177,9 @@ def window_dp(c: float, k: int, max_rounds: int) -> np.ndarray:
     dist[1, 0, 0] = 1.0
     done = np.zeros(max_rounds)
     absorbed = 0.0
+    c = toggle_c
     for r in range(max_rounds):
-        a = alive_fraction(c, r)
+        a = avail_fn(r)
         for _ in range(k):
             mass = dist[1]
             merged = mass[:half] + mass[half:]              # [half, B]
@@ -181,9 +200,23 @@ def window_dp(c: float, k: int, max_rounds: int) -> np.ndarray:
     return done
 
 
+def window_dp(c: float, k: int, max_rounds: int) -> np.ndarray:
+    """Model 3 under churn: quorum-window DP at the mean-field alive
+    fraction, with own-aliveness toggling."""
+    return _window_fp_dp(lambda r: alive_fraction(c, r), c, k, max_rounds)
+
+
+def drop_window_dp(d: float, k: int, max_rounds: int) -> np.ndarray:
+    """Default-semantics DP for response DROPS: constant availability
+    a = 1-d, node always alive — the clean constant-a validation of the
+    C(a) = P[Bin(8,a) >= 7] bump rate (per-slot absences are iid, so no
+    trajectory realization noise)."""
+    return _window_fp_dp(lambda r: 1.0 - d, 0.0, k, max_rounds)
+
+
 def measure_cell(n_nodes: int, n_txs: int, rounds: int, c: float,
                  seed: int, skip_absent: bool = False,
-                 n_seeds: int = 1) -> np.ndarray:
+                 n_seeds: int = 1, drop: float = 0.0) -> np.ndarray:
     """Per-node finality rounds (1-based; -1 if unfinalized), pooled over
     `n_seeds` alive-trajectory realizations.
 
@@ -194,6 +227,7 @@ def measure_cell(n_nodes: int, n_txs: int, rounds: int, c: float,
     the compiled function (same shapes, same static cfg).
     """
     cfg = AvalancheConfig(churn_probability=c, gossip=False,
+                          drop_probability=drop,
                           skip_absent_votes=skip_absent)
     run = jax.jit(av.run_scan, static_argnames=("cfg", "n_rounds"))
     out = []
@@ -231,56 +265,81 @@ def main(argv=None) -> dict:
         jax.config.update("jax_platforms", "cpu")
 
     k = AvalancheConfig().k
-    cells = []
-    worst = {"uptime_vs_default": 0.0, "two_factor_vs_default": 0.0,
-             "window_vs_default": 0.0, "two_factor_vs_skip": 0.0}
     t0 = time.time()
-    for c in CHURN_GRID:
-        measured = {
-            "default": measure_cell(args.nodes, args.txs, args.rounds, c,
-                                    args.seed, n_seeds=args.n_seeds),
-            "skip": measure_cell(args.nodes, args.txs, args.rounds, c,
-                                 args.seed, skip_absent=True,
-                                 n_seeds=args.n_seeds),
-        }
-        dps = {"uptime": uptime_dp(c, k, args.rounds),
-               "two_factor": two_factor_dp(c, k, args.rounds),
-               "window": window_dp(c, k, args.rounds)}
-        row = {"churn": c,
-               "model_medians": {m: _median_round(d)
-                                 for m, d in dps.items()},
-               "completeness": {}}
-        for mode, node_round in measured.items():
-            fin = node_round >= 0
-            row[mode] = {
-                "finalized_fraction": round(float(fin.mean()), 4),
-                "median_final_round": (int(np.median(node_round[fin]))
-                                       if fin.any() else None)}
-        for r in CUTOFFS:
-            if r > args.rounds:
-                continue
-            entry = {}
+
+    def sweep(grid, key_name, dps_for, pairings, measure_kw_for):
+        """Run one (grid value -> both-semantics measurement + DPs) sweep.
+
+        `dps_for(v)` returns the {model: done-array} dict; `pairings`
+        maps a gap name to its (model, mode) comparison; `measure_kw_for`
+        returns extra measure_cell kwargs per (value, skip) so churn and
+        drop sweeps share every line of accounting (the cross-sweep gap
+        comparison in RESULTS.md relies on identical definitions).
+        """
+        cells = []
+        worst = {p: 0.0 for p in pairings}
+        for v in grid:
+            measured = {
+                mode: measure_cell(args.nodes, args.txs, args.rounds,
+                                   seed=args.seed, n_seeds=args.n_seeds,
+                                   skip_absent=skip,
+                                   **measure_kw_for(v, skip))
+                for mode, skip in (("default", False), ("skip", True))}
+            dps = dps_for(v)
+            row = {key_name: v,
+                   "model_medians": {m: _median_round(d)
+                                     for m, d in dps.items()},
+                   "completeness": {}}
             for mode, node_round in measured.items():
                 fin = node_round >= 0
-                entry[mode] = round(float((node_round[fin] <= r).sum()
-                                          / len(node_round)), 4)
-            for m, d in dps.items():
-                entry[m] = round(float(d[r - 1]), 4)
-            for pairing, (a, b) in {
-                    "uptime_vs_default": ("uptime", "default"),
-                    "two_factor_vs_default": ("two_factor", "default"),
-                    "window_vs_default": ("window", "default"),
-                    "two_factor_vs_skip": ("two_factor", "skip")}.items():
-                worst[pairing] = max(worst[pairing],
-                                     abs(entry[a] - entry[b]))
-            row["completeness"][str(r)] = entry
-        cells.append(row)
-        print(f"churn={c:<6} "
-              f"default={row['default']['finalized_fraction']:<7}"
-              f"@{row['default']['median_final_round']} "
-              f"skip={row['skip']['finalized_fraction']:<7}"
-              f"@{row['skip']['median_final_round']} "
-              f"models={row['model_medians']}", flush=True)
+                row[mode] = {
+                    "finalized_fraction": round(float(fin.mean()), 4),
+                    "median_final_round": (int(np.median(node_round[fin]))
+                                           if fin.any() else None)}
+            for r in CUTOFFS:
+                if r > args.rounds:
+                    continue
+                entry = {}
+                for mode, node_round in measured.items():
+                    fin = node_round >= 0
+                    entry[mode] = round(float((node_round[fin] <= r).sum()
+                                              / len(node_round)), 4)
+                for m, d in dps.items():
+                    entry[m] = round(float(d[r - 1]), 4)
+                for pairing, (a, b) in pairings.items():
+                    worst[pairing] = max(worst[pairing],
+                                         abs(entry[a] - entry[b]))
+                row["completeness"][str(r)] = entry
+            cells.append(row)
+            print(f"{key_name}={v:<6} "
+                  f"default={row['default']['finalized_fraction']:<7}"
+                  f"@{row['default']['median_final_round']} "
+                  f"skip={row['skip']['finalized_fraction']:<7}"
+                  f"@{row['skip']['median_final_round']} "
+                  f"models={row['model_medians']}", flush=True)
+        return cells, worst
+
+    cells, worst = sweep(
+        CHURN_GRID, "churn",
+        lambda c: {"uptime": uptime_dp(c, k, args.rounds),
+                   "two_factor": two_factor_dp(c, k, args.rounds),
+                   "window": window_dp(c, k, args.rounds)},
+        {"uptime_vs_default": ("uptime", "default"),
+         "two_factor_vs_default": ("two_factor", "default"),
+         "window_vs_default": ("window", "default"),
+         "two_factor_vs_skip": ("two_factor", "skip")},
+        lambda c, skip: {"c": c})
+
+    # Drop sweep: the same two semantics under per-slot iid response
+    # drops (constant availability a = 1-d) — the trajectory-noise-free
+    # validation of the C(a) rate and its collapse under the knob.
+    drop_cells, drop_worst = sweep(
+        DROP_GRID, "drop",
+        lambda d: {"window": drop_window_dp(d, k, args.rounds),
+                   "two_factor": drop_two_factor_dp(d, k, args.rounds)},
+        {"window_vs_default": ("window", "default"),
+         "two_factor_vs_skip": ("two_factor", "skip")},
+        lambda d, skip: {"c": 0.0, "drop": d})
 
     # Worst-case 3-sigma band on a measured fraction (p=1/2) over the
     # pooled sample (nodes x seeds); per-node finality events are
@@ -296,7 +355,10 @@ def main(argv=None) -> dict:
                    "votes_needed": VOTES_NEEDED,
                    "backend": jax.devices()[0].platform},
         "cells": cells,
+        "drop_cells": drop_cells,
         "worst_gap_per_pairing": {m: round(v, 4) for m, v in worst.items()},
+        "drop_worst_gap_per_pairing": {m: round(v, 4)
+                                       for m, v in drop_worst.items()},
         "noise_floor_3sigma": round(float(noise), 4),
         "rate_factor_note": "default-mode bump rate per slot = "
                             "P[Bin(8,a)>=7] = a^8 + 8 a^7 (1-a) "
@@ -307,7 +369,8 @@ def main(argv=None) -> dict:
     os.makedirs(os.path.dirname(args.json_out) or ".", exist_ok=True)
     with open(args.json_out, "w") as f:
         json.dump(result, f, indent=1)
-    print(f"\nworst |measured-model| per pairing: "
+    print(f"\ndrop-sweep worst gaps: {result['drop_worst_gap_per_pairing']}")
+    print(f"worst |measured-model| per pairing: "
           f"{result['worst_gap_per_pairing']} "
           f"(3-sigma binomial noise floor "
           f"{result['noise_floor_3sigma']}; the window model's residual "
