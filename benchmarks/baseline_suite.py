@@ -382,6 +382,7 @@ def _render_analysis_sections() -> list:
         else:
             lines += _equivocation_finding(live_max, stall_min)
     lines += _render_churn_section()
+    lines += _render_quorum_dial_section()
     return lines
 
 
@@ -547,6 +548,70 @@ def _render_churn_section() -> list:
         "evidence of",
         "unavailability; the window is the protocol's recency filter) "
         "(artifact: `examples/out/churn_tolerance.json`).",
+        "",
+    ]
+    return lines
+
+
+def _render_quorum_dial_section() -> list:
+    qd_path = REPO / "examples" / "out" / "quorum_dial.json"
+    if not qd_path.exists():
+        return []
+    qd = json.loads(qd_path.read_text())
+    cfg = qd["config"]
+    lines = [
+        "## The quorum dial: availability vs liveness vs safety",
+        "",
+        f"Quorum sweep (`examples/quorum_dial.py`; window {cfg['window']}, "
+        f"{cfg['nodes']} nodes,",
+        f"{cfg['rounds']}-round budget).  Availability side is closed form "
+        "from the",
+        "churn/drop-validated bump rate C_Q(a) = P[Bin(8,a) >= Q]; "
+        "liveness and",
+        "safety are measured on the conflict DAG — safety under contested "
+        "priors",
+        "(half the network initially prefers each lane) with "
+        "equivocation/drop",
+        "pressure, counting sets finalized INCONSISTENTLY across honest "
+        "nodes:",
+        "",
+        "| quorum | a50 (rate halves) | latency x at a=0.9 | "
+        "equivocation stall eps* | max conflicting sets |",
+        "|---|---|---|---|---|",
+    ]
+    for row in qd["rows"]:
+        lines.append(
+            f"| {row['quorum']}-of-8 | {row['a50']} "
+            f"| {row['latency_factor_a090']} "
+            f"| {_fmt_dash(row['equivocation_stall_eps'])} "
+            f"| {row['max_conflicting_sets']}/"
+            f"{row['safety'][0]['n_sets']} |")
+    lines += [
+        "",
+        "**Finding.** Lowering the quorum buys availability and an "
+        "apparently higher",
+        "equivocation stall threshold — but the residual liveness under "
+        "attack below",
+        "Q=6 is partially UNSAFE: with eps=0.05 equivocators and "
+        "contested priors,",
+        "Q=5 finalizes different winners on different honest nodes in a "
+        "substantial",
+        "fraction of conflict sets (drops make it worse), while every "
+        "probed Q >= 6",
+        "cell has zero conflicts — those quorums fail SAFE by stalling, "
+        "exactly the",
+        "Avalanche paper's scope (rogue double-spends may stay undecided "
+        "forever but",
+        "are never finalized inconsistently).  Unanimity (8-of-8) is "
+        "dominated: no",
+        "measured safety gain over 6-7, a 2.3x latency multiplier at 90% "
+        "availability,",
+        "and a LOWER stall threshold (one equivocator poisons any "
+        "window).  The",
+        "reference's 7-of-8 sits one quorum step of safety margin above "
+        "the break, at",
+        "a ~1.2x availability premium over 6-of-8 "
+        "(artifact: `examples/out/quorum_dial.json`).",
         "",
     ]
     return lines

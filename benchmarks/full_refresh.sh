@@ -20,5 +20,6 @@ run baseline_suite  3600 python benchmarks/baseline_suite.py
 run window_scaling  1800 python examples/window_scaling.py
 run equiv_threshold 1800 python examples/equivocation_threshold.py
 run churn_tolerance 1800 python examples/churn_tolerance.py
+run quorum_dial     1800 python examples/quorum_dial.py
 commit_evidence "RESULTS refresh at HEAD on recovered hardware"
 echo "=== $(stamp) full refresh complete ===" | tee -a "$LOG"

@@ -179,3 +179,32 @@ def test_drop_window_dp_matches_churn_window_dp_limit():
     m_drop = int(np.searchsorted(drop, 0.5)) + 1
     m_churn = int(np.searchsorted(churn, 0.5)) + 1
     assert 1.85 <= m_churn / m_drop <= 2.0, (m_drop, m_churn)
+
+
+def test_quorum_dial_closed_forms():
+    """C_Q(a) and a50: pinned values and monotonicity.  C_7(a) must match
+    the churn study's closed form a^8 + 8 a^7 (1-a); a50 rises with Q
+    (stricter quorums need more availability); C_8(a) = a^8 exactly."""
+    from examples.churn_tolerance import alive_fraction  # noqa: F401
+    from examples.quorum_dial import a50, c_q
+
+    for a in (0.5, 0.75, 0.9, 1.0):
+        assert c_q(a, 7) == pytest.approx(a ** 8 + 8 * a ** 7 * (1 - a))
+        assert c_q(a, 8) == pytest.approx(a ** 8)
+    a50s = [a50(q) for q in (5, 6, 7, 8)]
+    assert all(x < y for x, y in zip(a50s, a50s[1:]))
+    assert a50(7) == pytest.approx(0.7989, abs=1e-3)
+    for q in (5, 6, 7, 8):
+        assert c_q(a50(q), q) == pytest.approx(0.5, abs=1e-6)
+
+
+@pytest.mark.slow
+def test_contested_priors_are_safe_at_reference_quorum():
+    """50/50-split priors with drops at quorum 7: the network must
+    resolve every set with ZERO conflicting finalizations across nodes
+    (the safety half of the quorum-dial finding, at a small shape)."""
+    from examples.quorum_dial import agreement_cell
+
+    cell = agreement_cell(128, 16, 2, 400, quorum=7, eps=0.0, drop=0.2)
+    assert cell["conflicting_sets"] == 0
+    assert cell["honest_resolved"] == 1.0
