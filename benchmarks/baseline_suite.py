@@ -616,6 +616,29 @@ def _render_quorum_dial_section() -> list:
         "(artifact: `examples/out/quorum_dial.json`).",
         "",
     ]
+    if qd.get("window_pairs"):
+        lines += [
+            "Sweeping the WINDOW as well (margin 1 and 2 at every packed "
+            "window size,",
+            "same eps=0.05 contested-priors probe) shows the boundary is "
+            "organized by",
+            "the quorum RATIO Q/W, not the absolute margin: 3-of-4 has "
+            "margin 1 yet",
+            "violates grossly (ratio 0.75), while every probed ratio >= "
+            "5/6 is clean —",
+            "the reference's 7/8 = 0.875 clears the ~0.8 boundary with "
+            "room:",
+            "",
+            "| Q-of-W | ratio Q/W | margin | a50 | conflicting sets "
+            "(per seed) |",
+            "|---|---|---|---|---|",
+        ]
+        for p in qd["window_pairs"]:
+            lines.append(
+                f"| {p['quorum']}-of-{p['window']} | {p['ratio']} "
+                f"| {p['margin']} | {p['a50']} "
+                f"| {p['conflicting_sets_per_seed']} |")
+        lines += [""]
     return lines
 
 

@@ -65,20 +65,24 @@ QUORUM_GRID = (5, 6, 7, 8)
 EPS_GRID = (0.025, 0.05, 0.075, 0.1, 0.15, 0.2, 0.3)
 SAFETY_CELLS = ((0.0, 0.2), (0.05, 0.0), (0.05, 0.2))   # (eps, drop)
 WINDOW = 8
+# (window, quorum) pairs for the ratio-law extension: margin 1 and 2 at
+# every window size the uint8 packing admits down to 4.
+WINDOW_PAIRS = ((8, 7), (8, 6), (7, 6), (7, 5), (6, 5), (6, 4), (5, 4),
+                (4, 3))
 
 
-def c_q(a: float, quorum: int) -> float:
-    """Bump rate per vote slot: P[Bin(8, a) >= quorum]."""
-    return float(sum(math.comb(WINDOW, j) * a ** j * (1 - a) ** (WINDOW - j)
-                     for j in range(quorum, WINDOW + 1)))
+def c_q(a: float, quorum: int, window: int = WINDOW) -> float:
+    """Bump rate per vote slot: P[Bin(window, a) >= quorum]."""
+    return float(sum(math.comb(window, j) * a ** j * (1 - a) ** (window - j)
+                     for j in range(quorum, window + 1)))
 
 
-def a50(quorum: int) -> float:
+def a50(quorum: int, window: int = WINDOW) -> float:
     """Availability where the bump rate halves: C_Q(a50) = 1/2."""
     lo, hi = 0.0, 1.0
     for _ in range(60):
         mid = (lo + hi) / 2
-        if c_q(mid, quorum) < 0.5:
+        if c_q(mid, quorum, window) < 0.5:
             lo = mid
         else:
             hi = mid
@@ -87,7 +91,8 @@ def a50(quorum: int) -> float:
 
 def agreement_cell(n_nodes: int, n_txs: int, set_size: int, rounds: int,
                    quorum: int, eps: float, drop: float,
-                   seed: int = 0, n_seeds: int = 1) -> dict:
+                   seed: int = 0, n_seeds: int = 1,
+                   window: int = WINDOW) -> dict:
     """Contested-priors safety probe: half the nodes initially prefer
     each lane of every conflict set; count sets finalized INCONSISTENTLY
     across honest nodes (the safety violation) and the honest resolution
@@ -96,7 +101,7 @@ def agreement_cell(n_nodes: int, n_txs: int, set_size: int, rounds: int,
     reports per-seed conflict counts — a zero-conflicts claim should
     rest on more than one realization."""
     per_seed = [_agreement_one(n_nodes, n_txs, set_size, rounds, quorum,
-                               eps, drop, s)
+                               eps, drop, s, window)
                 for s in range(seed, seed + n_seeds)]
     out = dict(per_seed[0])
     out["conflicting_sets_per_seed"] = [p["conflicting_sets"]
@@ -110,12 +115,13 @@ def agreement_cell(n_nodes: int, n_txs: int, set_size: int, rounds: int,
 
 def _agreement_one(n_nodes: int, n_txs: int, set_size: int, rounds: int,
                    quorum: int, eps: float, drop: float,
-                   seed: int) -> dict:
+                   seed: int, window: int = WINDOW) -> dict:
     cs = jnp.arange(n_txs, dtype=jnp.int32) // set_size
     lane0 = (jnp.arange(n_txs) % set_size) == 0
     even_rows = (jnp.arange(n_nodes)[:, None] % 2) == 0
     init_pref = jnp.where(even_rows, lane0[None, :], ~lane0[None, :])
-    cfg = AvalancheConfig(quorum=quorum, byzantine_fraction=eps,
+    cfg = AvalancheConfig(window=window, quorum=quorum,
+                          byzantine_fraction=eps,
                           drop_probability=drop, flip_probability=1.0,
                           adversary_strategy=AdversaryStrategy.EQUIVOCATE)
     state = dag.init(jax.random.key(seed), n_nodes, cs, cfg,
@@ -216,6 +222,28 @@ def main(argv=None) -> dict:
               f"stall_eps={row['equivocation_stall_eps']} "
               f"max_conflicts={row['max_conflicting_sets']}", flush=True)
 
+    # --- ratio-law extension: the SAME safety probe across (window,
+    # quorum) pairs at margin 1 and 2.  The organizing quantity is the
+    # quorum RATIO Q/W, not the absolute margin W-Q: 3-of-4 has margin 1
+    # yet violates grossly (ratio 0.75), while 5-of-6 (0.83) is clean.
+    pair_rows = []
+    for window, quorum in WINDOW_PAIRS:
+        cell = agreement_cell(args.nodes, args.txs, args.conflict_size,
+                              args.rounds, quorum, eps=0.05, drop=0.0,
+                              n_seeds=args.n_seeds, window=window)
+        pair = {"window": window, "quorum": quorum,
+                "ratio": round(quorum / window, 4),
+                "margin": window - quorum,
+                "a50": round(a50(quorum, window), 4),
+                "conflicting_sets_per_seed":
+                    cell["conflicting_sets_per_seed"],
+                "max_conflicting_sets": cell["conflicting_sets"],
+                "n_sets": cell["n_sets"]}
+        pair_rows.append(pair)
+        print(f"W={window} Q={quorum} ratio={pair['ratio']} "
+              f"margin={pair['margin']}: conflicts "
+              f"{pair['conflicting_sets_per_seed']}", flush=True)
+
     result = {
         "config": {"nodes": args.nodes, "txs": args.txs,
                    "conflict_size": args.conflict_size,
@@ -224,6 +252,7 @@ def main(argv=None) -> dict:
                    "safety_n_seeds": args.n_seeds,
                    "backend": jax.devices()[0].platform},
         "rows": rows,
+        "window_pairs": pair_rows,
         "elapsed_s": round(time.time() - t0, 1),
     }
     os.makedirs(os.path.dirname(args.json_out) or ".", exist_ok=True)

@@ -228,3 +228,16 @@ def test_results_render_from_committed_artifacts():
     # Every row of the config table survived the merge/render round-trip.
     for row in data["results"]:
         assert str(row["name"]) in md
+
+
+def test_c_q_generalizes_over_window():
+    """c_q(a, Q, W): the generalized bump rate must reduce to the 8-window
+    closed form and behave monotonically in all three arguments."""
+    from examples.quorum_dial import a50, c_q
+
+    assert c_q(0.9, 7, 8) == pytest.approx(0.9 ** 8 + 8 * 0.9 ** 7 * 0.1)
+    assert c_q(0.9, 4, 4) == pytest.approx(0.9 ** 4)
+    for w, q in ((8, 7), (7, 6), (6, 5), (5, 4), (4, 3)):
+        assert c_q(0.95, q, w) > c_q(0.8, q, w)        # rises with a
+        assert c_q(0.9, q, w) > c_q(0.9, q + 1, w) if q + 1 <= w else True
+        assert c_q(a50(q, w), q, w) == pytest.approx(0.5, abs=1e-6)
