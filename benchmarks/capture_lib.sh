@@ -55,6 +55,7 @@ commit_evidence() {
            examples/out/equivocation_threshold.json \
            examples/out/churn_tolerance.json \
            examples/out/quorum_dial.json \
+           examples/out/oppose_scaling.json \
            examples/out/finality_fit.json; do
     [ -f "$f" ] || continue
     # add must be checked: a swallowed failure (e.g. an operator's git

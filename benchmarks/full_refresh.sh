@@ -21,5 +21,6 @@ run window_scaling  1800 python examples/window_scaling.py
 run equiv_threshold 1800 python examples/equivocation_threshold.py
 run churn_tolerance 1800 python examples/churn_tolerance.py
 run quorum_dial     1800 python examples/quorum_dial.py
+run oppose_scaling  1800 python examples/oppose_scaling.py
 commit_evidence "RESULTS refresh at HEAD on recovered hardware"
 echo "=== $(stamp) full refresh complete ===" | tee -a "$LOG"

@@ -241,3 +241,37 @@ def test_c_q_generalizes_over_window():
         assert c_q(0.95, q, w) > c_q(0.8, q, w)        # rises with a
         assert c_q(0.9, q, w) > c_q(0.9, q + 1, w) if q + 1 <= w else True
         assert c_q(a50(q, w), q, w) == pytest.approx(0.5, abs=1e-6)
+
+
+def test_power_law_fit_recovers_planted_slope():
+    """fit_power_law must recover a planted log-log relation exactly and
+    extrapolate it to the 100k point."""
+    from examples.oppose_scaling import fit_power_law
+
+    pts = [{"n": n, "eps_star": 3.0 * n ** -0.5}
+           for n in (256, 1024, 4096, 16384)]
+    fit = fit_power_law(pts)
+    assert fit["slope"] == pytest.approx(-0.5, abs=1e-6)
+    assert fit["r2"] == 1.0
+    assert fit["eps_star_at_100k"] == pytest.approx(3.0 / 100_000 ** 0.5,
+                                                    rel=1e-3)
+
+
+@pytest.mark.slow
+def test_oppose_artifact_reproduces_cross_backend():
+    """One bisection probe point of the recorded scaling artifact must
+    reproduce bit-for-bit (threefry PRNG) on this backend."""
+    import json
+    import os
+
+    path = "examples/out/oppose_scaling.json"
+    if not os.path.exists(path):
+        pytest.skip("artifact not recorded")
+    from examples.oppose_scaling import live_fraction
+
+    art = json.load(open(path))
+    row = art["rows"][0]                      # smallest n: cheapest
+    probe = row["probes"][-1]
+    live = live_fraction(row["n"], probe["eps"], art["config"]["rounds"],
+                         art["config"]["seeds"])
+    assert round(live, 4) == probe["live"], (probe, live)
