@@ -273,6 +273,12 @@ def render_results_md(results, backend: str) -> str:
         "(and `tests/test_sharding.py` for the plain sharded round,",
         "`tests/test_sharded_streaming_dag.py` for the streaming",
         "conflict-DAG); wall-clock here is single-chip.",
+        "Appendix studies below: paper-fidelity finality curves, the",
+        "equivocation liveness threshold, churn/drop availability (the",
+        "quorum window as a ~a^7 filter and the `skip_absent_votes`",
+        "semantics knob), the quorum dial (safety boundary at ratio",
+        "Q/W ~ 0.8), and the OPPOSE_MAJORITY ~1/sqrt(n) metastability",
+        "scaling law.",
         "",
         "| Config | Rounds | Outcome | Median finality | p90 | Wall (s) |",
         "|---|---|---|---|---|---|",
