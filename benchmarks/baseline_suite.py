@@ -627,23 +627,31 @@ def _render_quorum_dial_section() -> list:
         lines += [
             "Sweeping the WINDOW as well (margin 1 and 2 at every packed "
             "window size,",
-            "same eps=0.05 contested-priors probe) shows the boundary is "
-            "organized by",
-            "the quorum RATIO Q/W, not the absolute margin: 3-of-4 has "
-            "margin 1 yet",
-            "violates grossly (ratio 0.75), while every probed ratio >= "
-            "5/6 is clean —",
-            "the reference's 7/8 = 0.875 clears the ~0.8 boundary with "
-            "room:",
+            "same eps=0.05 contested-priors probe) shows the SAFETY "
+            "boundary is",
+            "organized by the quorum RATIO Q/W, not the absolute margin: "
+            "3-of-4 has",
+            "margin 1 yet violates grossly (ratio 0.75), while every "
+            "probed ratio >=",
+            "5/6 is clean — the reference's 7/8 = 0.875 clears the ~0.8 "
+            "boundary with",
+            "room.  The equivocation stall threshold, by contrast, is "
+            "essentially",
+            "INVARIANT across the whole grid (~0.05 everywhere) — "
+            "re-confirming that",
+            "attack targets the preference loop, not the window rule; "
+            "the axes the",
+            "(W, Q) choice actually moves are availability and safety:",
             "",
-            "| Q-of-W | ratio Q/W | margin | a50 | conflicting sets "
-            "(per seed) |",
-            "|---|---|---|---|---|",
+            "| Q-of-W | ratio Q/W | margin | a50 | stall eps* | "
+            "conflicting sets (per seed) |",
+            "|---|---|---|---|---|---|",
         ]
         for p in qd["window_pairs"]:
             lines.append(
                 f"| {p['quorum']}-of-{p['window']} | {p['ratio']} "
                 f"| {p['margin']} | {p['a50']} "
+                f"| {_fmt_dash(p.get('equivocation_stall_eps'))} "
                 f"| {p['conflicting_sets_per_seed']} |")
         lines += [""]
     return lines

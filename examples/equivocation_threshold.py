@@ -71,13 +71,14 @@ def resolved_fraction(state: dag.DagSimState, cfg: AvalancheConfig,
 
 def sweep_cell(n_nodes: int, n_txs: int, set_size: int, rounds: int,
                eps: float, p: float, strategy: AdversaryStrategy,
-               seed: int = 0, quorum: int = 7) -> dict:
-    """One (eps, p, strategy) cell.  `quorum` sweeps the window's
-    conclusiveness threshold (default = the protocol's 7-of-8,
+               seed: int = 0, quorum: int = 7, window: int = 8) -> dict:
+    """One (eps, p, strategy) cell.  `quorum`/`window` sweep the vote
+    window's conclusiveness rule (default = the protocol's 7-of-8,
     `vote.go:55,58`) — used by `examples/quorum_dial.py` to measure how
-    the stall threshold moves with the quorum."""
+    the stall threshold moves with the quorum and window."""
     cfg = AvalancheConfig(byzantine_fraction=eps, flip_probability=p,
-                          adversary_strategy=strategy, quorum=quorum)
+                          adversary_strategy=strategy, quorum=quorum,
+                          window=window)
     cs = jnp.arange(n_txs, dtype=jnp.int32) // set_size
     state = dag.init(jax.random.key(seed), n_nodes, cs, cfg)
     # eps only enters `init` (the byzantine mask is STATE); zero it in the

@@ -231,17 +231,30 @@ def main(argv=None) -> dict:
         cell = agreement_cell(args.nodes, args.txs, args.conflict_size,
                               args.rounds, quorum, eps=0.05, drop=0.0,
                               n_seeds=args.n_seeds, window=window)
+        # Liveness axis for the pair: stall threshold under full-rate
+        # equivocation (eps shares one compile per pair — it only enters
+        # init).
+        stalled = []
+        for eps in EPS_GRID:
+            c = sweep_cell(args.nodes, args.txs, args.conflict_size,
+                           args.rounds, eps=eps, p=1.0,
+                           strategy=AdversaryStrategy.EQUIVOCATE,
+                           quorum=quorum, window=window)
+            if c["resolved"] < 0.5:
+                stalled.append(eps)
         pair = {"window": window, "quorum": quorum,
                 "ratio": round(quorum / window, 4),
                 "margin": window - quorum,
                 "a50": round(a50(quorum, window), 4),
+                "equivocation_stall_eps": min(stalled) if stalled else None,
                 "conflicting_sets_per_seed":
                     cell["conflicting_sets_per_seed"],
                 "max_conflicting_sets": cell["conflicting_sets"],
                 "n_sets": cell["n_sets"]}
         pair_rows.append(pair)
         print(f"W={window} Q={quorum} ratio={pair['ratio']} "
-              f"margin={pair['margin']}: conflicts "
+              f"margin={pair['margin']} "
+              f"stall_eps={pair['equivocation_stall_eps']}: conflicts "
               f"{pair['conflicting_sets_per_seed']}", flush=True)
 
     result = {
