@@ -275,3 +275,36 @@ def test_oppose_artifact_reproduces_cross_backend():
     live = live_fraction(row["n"], probe["eps"], art["config"]["rounds"],
                          art["config"]["seeds"])
     assert round(live, 4) == probe["live"], (probe, live)
+
+
+@pytest.mark.slow
+def test_quorum_dial_artifact_reproduces_cross_backend():
+    """One liveness cell and one safety cell of the recorded quorum-dial
+    artifact must reproduce bit-for-bit (threefry PRNG) on this
+    backend."""
+    import json
+    import os
+
+    path = "examples/out/quorum_dial.json"
+    if not os.path.exists(path):
+        pytest.skip("artifact not recorded")
+    from examples.equivocation_threshold import sweep_cell
+    from examples.quorum_dial import agreement_cell
+    from go_avalanche_tpu.config import AdversaryStrategy
+
+    art = json.load(open(path))
+    c = art["config"]
+    row = next(r for r in art["rows"] if r["quorum"] == 7)
+    cell = next(x for x in row["cells"] if x["eps"] == 0.05)
+    redo = sweep_cell(c["nodes"], c["txs"], c["conflict_size"],
+                      c["rounds"], eps=0.05, p=1.0,
+                      strategy=AdversaryStrategy.EQUIVOCATE, quorum=7)
+    assert redo["resolved"] == cell["resolved"], (redo, cell)
+
+    safety = next(s for s in row["safety"]
+                  if s["eps"] == 0.05 and s["drop"] == 0.0)
+    redo_s = agreement_cell(c["nodes"], c["txs"], c["conflict_size"],
+                            c["rounds"], quorum=7, eps=0.05, drop=0.0,
+                            n_seeds=c["safety_n_seeds"])
+    assert redo_s["conflicting_sets_per_seed"] \
+        == safety["conflicting_sets_per_seed"], (redo_s, safety)
