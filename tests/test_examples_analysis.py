@@ -308,3 +308,23 @@ def test_quorum_dial_artifact_reproduces_cross_backend():
                             n_seeds=c["safety_n_seeds"])
     assert redo_s["conflicting_sets_per_seed"] \
         == safety["conflicting_sets_per_seed"], (redo_s, safety)
+
+
+@pytest.mark.slow
+def test_churn_and_drops_compose_multiplicatively():
+    """The availability law composes: with churn c AND drop rate d the
+    per-slot availability is a_r(c) * (1-d), and the quorum-window DP
+    with that composed schedule must track the measured simulator
+    (completeness within trajectory noise at every cutoff)."""
+    import numpy as np
+
+    from examples.churn_tolerance import (_window_fp_dp, alive_fraction,
+                                          measure_cell)
+
+    c, d = 0.01, 0.1
+    dp = _window_fp_dp(lambda r: alive_fraction(c, r) * (1 - d), c, 8, 128)
+    node_round = measure_cell(2048, 16, 128, c, seed=0, n_seeds=3, drop=d)
+    fin = node_round >= 0
+    for r in (34, 50, 128):
+        measured = (node_round[fin] <= r).sum() / len(node_round)
+        assert abs(measured - dp[r - 1]) < 0.06, (r, measured, dp[r - 1])
