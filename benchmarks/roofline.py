@@ -168,22 +168,39 @@ def main() -> None:
 
     # --- phase: vote-ingest kernel alone (k fused window updates on the
     # record planes — RegisterVotes, `processor.go:92-117`).  Carry the
-    # records; vote planes vary per iteration via a cheap xor so the scan
-    # cannot hoist them.
+    # records AND the vote planes: closing over [N, T] planes bakes
+    # ~270 MB of constants into the module, which the axon tunnel's
+    # remote_compile rejects with HTTP 413 (observed 2026-07-31); as
+    # carry leaves they live in HBM and the module stays small.  The
+    # per-iteration xor also stops the scan hoisting the ingest.
     yes0 = jax.random.randint(jax.random.key(1), state.records.votes.shape,
                               0, 256, jnp.uint8)
     con0 = jnp.full(state.records.votes.shape, 0xFF, jnp.uint8)
 
-    def ingest_step(recs, i=jnp.int32(1)):
-        y = yes0 ^ i.astype(jnp.uint8)
-        return vr.register_packed_votes(recs, y, con0, cfg.k, cfg)[0]
+    def ingest_step(carry, i=jnp.int32(1)):
+        recs, yes, con = carry
+        y = yes ^ i.astype(jnp.uint8)
+        return (vr.register_packed_votes(recs, y, con, cfg.k, cfg)[0],
+                yes, con)
 
-    def ingest_only(recs):
-        def body(r, i):
-            return ingest_step(r, i), None
-        return lax.scan(body, recs, jnp.arange(R, dtype=jnp.int32))[0]
+    def ingest_probe(carry):
+        # Bytes-probe twin: output ONLY the updated records.  Returning
+        # the untouched vote planes (as `ingest_step` must, to be
+        # scan-shaped) makes XLA copy them into outputs and
+        # cost_analysis() counts the copies — ~2x the plane bytes that
+        # the timed scan, which carries them copy-free, never moves
+        # (verified on this backend with a pass-through probe).
+        recs, yes, con = carry
+        y = yes ^ jnp.uint8(1)
+        return vr.register_packed_votes(recs, y, con, cfg.k, cfg)[0]
 
-    measure("ingest_kernel", ingest_step, ingest_only, state.records)
+    def ingest_only(carry):
+        def body(c, i):
+            return ingest_step(c, i), None
+        return lax.scan(body, carry, jnp.arange(R, dtype=jnp.int32))[0]
+
+    measure("ingest_kernel", ingest_probe, ingest_only,
+            (state.records, yes0, con0))
 
     # --- phase: preference pack + k row-gathers (the vote-exchange
     # collective's single-chip form).

@@ -58,20 +58,29 @@ def northstar_config(window_sets: int, set_cap: int):
 
 def northstar_state(nodes: int, backlog_sets: int, set_cap: int,
                     window_sets: int,
-                    track_finality: bool = True) -> Tuple[object, object]:
+                    track_finality: bool = True,
+                    retire_cap: int | None = None) -> Tuple[object, object]:
     """Build (state, cfg) for the streaming conflict-DAG workload.
 
     `track_finality=False` drops the per-(node, tx) finalized_at plane —
     17% less memory traffic per step (XLA cost analysis, PERF_NOTES.md);
     streaming latency metrics come from SetOutputs, so results are
     unchanged.  Default True for checkpoint compatibility with runs that
-    saved the plane.
+    saved the plane.  `retire_cap` selects the capped gather/scatter
+    retire-refill path (`cfg.stream_retire_cap`) — 1.34x faster than the
+    dense rewrite on TPU v5e at 4096 nodes, 0.90x at 100k (PERF_NOTES
+    r05 retire-cap A/B; shape-dependent), default off to keep
+    trajectories comparable with the pinned dense artifacts.
     """
+    import dataclasses
+
     import jax
 
     from go_avalanche_tpu.models import streaming_dag as sdg
 
     cfg = northstar_config(window_sets, set_cap)
+    if retire_cap is not None:
+        cfg = dataclasses.replace(cfg, stream_retire_cap=retire_cap)
     scores = jax.random.randint(jax.random.key(_SCORE_SEED),
                                 (backlog_sets, set_cap), 0, _SCORE_MAX)
     backlog = sdg.make_set_backlog(scores)
