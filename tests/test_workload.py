@@ -45,6 +45,20 @@ def test_shapes_match_declared_config():
     assert NORTH_STAR["backlog_sets"] * NORTH_STAR["set_cap"] == 1_000_000
 
 
+def test_retire_cap_knob_only_changes_the_config():
+    """`retire_cap` selects the capped scheduler (PERF_NOTES r05 TPU A/B)
+    without perturbing the built state: trajectories stay comparable."""
+    import dataclasses
+
+    dense, cfg_dense = northstar_state(**QUICK)
+    capped, cfg_capped = northstar_state(**QUICK, retire_cap=16)
+    assert cfg_dense.stream_retire_cap is None
+    assert cfg_capped.stream_retire_cap == 16
+    assert dataclasses.replace(cfg_capped, stream_retire_cap=None) == cfg_dense
+    for x, y in zip(_leaves(dense), _leaves(capped)):
+        np.testing.assert_array_equal(x, y)
+
+
 def test_tracking_flag_only_changes_the_plane():
     on, _ = northstar_state(**QUICK)
     off, _ = northstar_state(**QUICK, track_finality=False)
