@@ -221,7 +221,8 @@ def test_results_render_from_committed_artifacts():
 
     data = json.load(open("benchmarks/results.json"))
     md = render_results_md(data["results"], data["backend"])
-    for header in ("# RESULTS", "## Paper fidelity",
+    for header in ("# RESULTS", "## Hardware throughput evidence",
+                   "## Paper fidelity",
                    "## Liveness threshold under equivocation",
                    "## Churn tolerance", "## The quorum dial"):
         assert header in md, header
