@@ -278,8 +278,9 @@ def render_results_md(results, backend: str) -> str:
         "equivocation liveness threshold, churn/drop availability (the",
         "quorum window as a ~a^7 filter and the `skip_absent_votes`",
         "semantics knob), the quorum dial (safety boundary at ratio",
-        "Q/W ~ 0.8), and the OPPOSE_MAJORITY ~1/sqrt(n) metastability",
-        "scaling law.",
+        "Q/W ~ 0.8), the OPPOSE_MAJORITY ~1/sqrt(n) metastability",
+        "scaling law, and the retire-cap scheduling tradeoff (knee at",
+        "the settle rate W/L).",
         "",
         "| Config | Rounds | Outcome | Median finality | p90 | Wall (s) |",
         "|---|---|---|---|---|---|",
@@ -461,6 +462,59 @@ def _render_analysis_sections() -> list:
     lines += _render_churn_section()
     lines += _render_quorum_dial_section()
     lines += _render_oppose_scaling_section()
+    lines += _render_retire_cap_section()
+    return lines
+
+
+def _render_retire_cap_section() -> list:
+    rc_path = REPO / "examples" / "out" / "retire_cap_tradeoff.json"
+    if not rc_path.exists():
+        return []
+    rc = json.loads(rc_path.read_text())
+    law = rc["law"]
+    cfgd = rc["config"]
+    lines = [
+        "## Retire-cap tradeoff: the scheduler throttle is free down "
+        "to the settle rate",
+        "",
+        "`stream_retire_cap=K` bounds the streaming scheduler to K "
+        "set-retirements per",
+        "round (the TPU-fast gather/scatter path, PERF_NOTES r05).  "
+        "Scheduling cost of",
+        f"the throttle, measured by draining B={cfgd['backlog_sets']} "
+        f"sets through a W={cfgd['window_sets']} window",
+        f"(`examples/retire_cap_tradeoff.py`, {cfgd['nodes']} nodes, "
+        f"dense anchor {law['r_dense']} rounds):",
+        "",
+        "| cap K | rounds to drain | vs dense | B/K+L predicts | "
+        "measured/predicted |",
+        "|---|---|---|---|---|",
+    ]
+    for r in law["rows"]:
+        lines.append(f"| {r['cap']} | {r['measured']} "
+                     f"| {r['ratio_vs_dense']}x | {r['predicted']} "
+                     f"| {r['measured_over_predicted']} |")
+    lines += [
+        "",
+        "**Finding.** The cap is an admission-rate throttle with a "
+        "sharp knee at the",
+        f"steady settle rate K* = B/R_dense = {law['knee_cap']} "
+        "(= W/L): above it the drain",
+        "is within ~3% of dense; below it `rounds = B/K + L` predicts "
+        "every cell within",
+        "0.1%.  In-window settle latency is bit-invariant "
+        f"(median/p90 = {law['settle_latency_median']}/"
+        f"{law.get('settle_latency_p90', law['settle_latency_median'])}"
+        " at every cap — asserted per cell by the study itself)",
+        "and liveness + one-winner hold down to K=1 — the cap delays "
+        "retirement and",
+        "admission, never the consensus in between.  Operating "
+        "guidance: cap at 2-4x",
+        "the settle rate W/L; the TPU perf win costs nothing on the "
+        "scheduling axis",
+        "(artifact: `examples/out/retire_cap_tradeoff.json`).",
+        "",
+    ]
     return lines
 
 

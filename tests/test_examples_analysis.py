@@ -329,3 +329,24 @@ def test_churn_and_drops_compose_multiplicatively():
     for r in (34, 50, 128):
         measured = (node_round[fin] <= r).sum() / len(node_round)
         assert abs(measured - dp[r - 1]) < 0.06, (r, measured, dp[r - 1])
+
+
+def test_retire_cap_artifact_reproduces_cross_backend():
+    """One throttled cell of the recorded retire-cap tradeoff artifact
+    must reproduce bit-for-bit (threefry PRNG) on this backend — and a
+    capped drain must match the dense cell's latency stats exactly."""
+    import json
+    import os
+
+    path = "examples/out/retire_cap_tradeoff.json"
+    if not os.path.exists(path):
+        pytest.skip("artifact not recorded")
+    from examples.retire_cap_tradeoff import run_cell
+
+    art = json.load(open(path))
+    dense = next(c for c in art["cells"] if c["cap"] is None)
+    cell = next(c for c in art["cells"] if c["cap"] == 4)
+    redo = run_cell(4)
+    assert redo == cell, (redo, cell)
+    assert redo["settle_latency_median"] == dense["settle_latency_median"]
+    assert redo["settle_latency_p90"] == dense["settle_latency_p90"]
