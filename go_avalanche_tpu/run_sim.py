@@ -366,8 +366,10 @@ def main(argv=None) -> Dict:
                         metavar="SETS",
                         help="streaming_dag: cap set-slots retired+refilled "
                              "per round and rewrite only their window "
-                             "columns (experimental; default dense rewrite "
-                             "— see PERF_NOTES.md)")
+                             "columns.  Free above ~2-4x the settle rate "
+                             "W/L and 1.3-1.5x faster on TPU at mid-sized "
+                             "node counts (RESULTS.md retire-cap tradeoff; "
+                             "PERF_NOTES r05 A/B).  Default: dense rewrite")
     # output / tooling
     parser.add_argument("--json", action="store_true",
                         help="emit one JSON line instead of key=value text")
