@@ -56,6 +56,7 @@ commit_evidence() {
            examples/out/churn_tolerance.json \
            examples/out/quorum_dial.json \
            examples/out/oppose_scaling.json \
+           examples/out/retire_cap_tradeoff.json \
            examples/out/finality_fit.json; do
     [ -f "$f" ] || continue
     # add must be checked: a swallowed failure (e.g. an operator's git

@@ -22,5 +22,6 @@ run equiv_threshold 1800 python examples/equivocation_threshold.py
 run churn_tolerance 1800 python examples/churn_tolerance.py
 run quorum_dial     1800 python examples/quorum_dial.py
 run oppose_scaling  1800 python examples/oppose_scaling.py
+run retire_cap      1800 python examples/retire_cap_tradeoff.py
 commit_evidence "RESULTS refresh at HEAD on recovered hardware"
 echo "=== $(stamp) full refresh complete ===" | tee -a "$LOG"
