@@ -28,7 +28,6 @@ import time
 sys.path.insert(0, ".")  # allow running from the repo root
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from go_avalanche_tpu.config import AvalancheConfig

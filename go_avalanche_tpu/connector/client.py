@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import socket
 import struct
-from typing import List, NamedTuple, Optional, Sequence, Tuple
+from typing import List, NamedTuple, Sequence, Tuple
 
 from go_avalanche_tpu.connector import protocol as proto
 from go_avalanche_tpu.config import AdversaryStrategy

@@ -30,7 +30,6 @@ nothing).
 from __future__ import annotations
 
 import math
-from functools import partial
 from typing import Tuple
 
 import jax

@@ -9,10 +9,9 @@ on-device telemetry/state and reduces on host — nothing runs in the hot loop.
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+from typing import Dict, List
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG

@@ -8,7 +8,6 @@ minority below the alpha threshold.
 """
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 

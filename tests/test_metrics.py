@@ -7,7 +7,6 @@ import pytest
 
 from go_avalanche_tpu.config import AvalancheConfig
 from go_avalanche_tpu.models import snowball
-from go_avalanche_tpu.ops import voterecord as vr
 from go_avalanche_tpu.types import Status
 from go_avalanche_tpu.utils import metrics
 

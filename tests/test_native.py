@@ -13,7 +13,7 @@ import random
 
 import pytest
 
-from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
+from go_avalanche_tpu.config import AvalancheConfig
 from go_avalanche_tpu.types import Response, Status, Vote
 from go_avalanche_tpu.utils.golden import (
     ScalarVoteRecord,

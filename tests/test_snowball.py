@@ -6,7 +6,6 @@ warm-up + finalization_score conclusive votes.
 """
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 
