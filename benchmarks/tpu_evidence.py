@@ -201,6 +201,38 @@ def _run(name: str, argv: list, env: dict, timeout: float,
     return result
 
 
+def _post_capture_probe_status(all_lanes: list, env: dict):
+    """Post-capture DISPATCH-probe result when a work lane failed, else
+    None.  `all_lanes[0]` must be the initial device_probe lane.
+
+    Attributes the failure in the artifact itself: a lane that timed out
+    with no output followed by a failing dispatch probe is a tunnel
+    wedge (the round-5 third-wedge signature, PERF_NOTES), not a code
+    failure.  The probe is `benchmarks/dispatch_probe.py` — a REAL
+    device computation, because the half-alive wedge state answers
+    enumeration (`_PROBE`) in 0.1 s while any dispatch hangs, which
+    would mis-attribute a wedge as a code failure.  Skipped when the
+    initial probe itself failed (no work lane ran — rerunning the probe
+    would only echo it).  120 s budget covers a cold compile; in the
+    wedged scenario the tunnel is already stuck, so the probe's own
+    hard kill cannot make things worse.  Returns {"status", "detail"?}
+    so the WHY (e.g. "not a TPU backend") lands in the committed
+    artifact, not just the gitignored lane log.
+    """
+    if not all_lanes or all_lanes[0]["status"] != "pass":
+        return None
+    if all(r["status"] == "pass" for r in all_lanes):
+        return None
+    r = _run("post_capture_probe",
+             [sys.executable,
+              str(REPO / "benchmarks" / "dispatch_probe.py")],
+             env, 120.0)
+    out = {"status": r["status"]}
+    if "detail" in r:
+        out["detail"] = r["detail"]
+    return out
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--timeout", type=float, default=600.0)
@@ -257,6 +289,9 @@ def main() -> None:
            "perf_lanes": perf_lanes,
            "all_pass": (probe["status"] == "pass"
                         and all(r["status"] == "pass" for r in lanes))}
+    post = _post_capture_probe_status(lanes + perf_lanes, base)
+    if post is not None:
+        out["post_capture_probe"] = post
     (REPO / "benchmarks" / "tpu_evidence.json").write_text(
         json.dumps(out, indent=1) + "\n")
     print(json.dumps(out))

@@ -198,3 +198,39 @@ def test_delta_walk_survives_non_object_json_archive(tmp_path):
     out = bench._attach_prev_delta({"metric": m, "value": 55.0},
                                    search_dir=str(tmp_path))
     assert out["prev_round"] == 3
+
+
+def test_post_capture_probe_attributes_failures(monkeypatch, tmp_path):
+    """A capture with a failed WORK lane runs a post-capture DISPATCH
+    probe (dispatch_probe.py, not the enumeration-only _PROBE — the
+    half-alive wedge answers enumeration while computation hangs) so
+    the artifact attributes timeout-vs-wedge itself.  An all-pass
+    capture, or one whose initial device probe already failed, must
+    NOT spend a probe."""
+    import os
+
+    from benchmarks import tpu_evidence as te
+
+    monkeypatch.setattr(te, "LOGS", tmp_path)
+    calls = []
+
+    def fake_run(name, argv, env, timeout, pytest_lane=False):
+        calls.append(argv[-1])
+        return {"lane": name, "status": "fail", "wall_s": 0.1,
+                "detail": {"why": "wedged"}}
+
+    monkeypatch.setattr(te, "_run", fake_run)
+    env = dict(os.environ)
+    # all pass: no probe
+    assert te._post_capture_probe_status(
+        [{"status": "pass"}, {"status": "pass"}], env) is None
+    # initial device probe failed (e.g. CPU box): rerunning it is noise
+    assert te._post_capture_probe_status(
+        [{"status": "fail"}], env) is None
+    assert calls == []
+    # a work lane failed after a passing probe: dispatch-probe and
+    # surface status + detail in the artifact
+    out = te._post_capture_probe_status(
+        [{"status": "pass"}, {"status": "timeout"}], env)
+    assert out == {"status": "fail", "detail": {"why": "wedged"}}
+    assert len(calls) == 1 and calls[0].endswith("dispatch_probe.py")
