@@ -229,6 +229,13 @@ def test_results_render_from_committed_artifacts():
     # Every row of the config table survived the merge/render round-trip.
     for row in data["results"]:
         assert str(row["name"]) in md
+    # The COMMITTED RESULTS.md must be byte-identical to the render from
+    # the committed artifacts: a hand edit to either side that isn't
+    # reflected in the other is doc/generator drift, and an unattended
+    # refresh would silently revert it.
+    assert md == open("RESULTS.md").read(), (
+        "RESULTS.md is not the render of the committed artifacts — "
+        "regenerate via baseline_suite.render_results_md")
 
 
 def test_c_q_generalizes_over_window():
