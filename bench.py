@@ -58,8 +58,36 @@ def _sync(state) -> None:
     np.asarray(jax.numpy.sum(state.records.confidence.astype(jax.numpy.int32)))
 
 
+def flagship_program(cfg, n_rounds: int):
+    """The jitted flagship scan `bench()` times: `n_rounds` of
+    `models/avalanche.round_step` inside one jit, input state DONATED so
+    the [N, T] record planes update in place instead of double-buffering
+    in HBM.  Module-level (not inlined in `bench()`) so
+    `benchmarks/hlo_pin.py` hashes THE timed program, not a
+    reconstruction of it.
+    """
+    import functools
+
+    import jax
+
+    from go_avalanche_tpu.models import avalanche as av
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def run(s):
+        def body(st, _):
+            new_s, _ = av.round_step(st, cfg)
+            return new_s, None
+        out, _ = jax.lax.scan(body, s, None, length=n_rounds)
+        return out
+
+    return run
+
+
 def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
-          repeats: int = 3) -> dict:
+          repeats: int = 3, exchange: str = "fused",
+          profile: bool = False) -> dict:
+    import dataclasses
+
     import jax
 
     from benchmarks.workload import flagship_state
@@ -71,45 +99,76 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
     # vote count below assumes are live.  Shared builder: roofline.py
     # measures phase bandwidth on this exact construction.
     state, cfg = flagship_state(n_nodes, n_txs, k)
+    if exchange != "fused":
+        cfg = dataclasses.replace(cfg, fused_exchange=False)
 
     # The round loop runs ON DEVICE (lax.scan inside one jit): dispatching
     # rounds one by one from Python pays a fixed per-call latency (~6ms
     # through the axon tunnel) that would dominate the measurement.
-    @jax.jit
-    def run(s):
-        def body(st, _):
-            new_s, _ = av.round_step(st, cfg)
-            return new_s, None
-        out, _ = jax.lax.scan(body, s, None, length=n_rounds)
-        return out
+    # Donation means each call consumes its input, so the repeats chain
+    # the evolved state (shape-invariant workload: nothing finalizes,
+    # throughput per round is identical from any round's state).
+    run = flagship_program(cfg, n_rounds)
 
     # Warm-up: compile + one executed sweep.
-    _sync(run(state))
+    state = run(state)
+    _sync(state)
 
     best_dt = None
     for _ in range(repeats):
         t0 = time.perf_counter()
-        _sync(run(state))
+        state = run(state)
+        _sync(state)
         dt = time.perf_counter() - t0
         best_dt = dt if best_dt is None else min(best_dt, dt)
 
     votes = n_nodes * n_txs * k * n_rounds
     votes_per_sec = votes / best_dt
-    return {
+    # The metric string is part of the round-over-round delta contract
+    # (`_attach_prev_delta` compares same-metric rounds only): unchanged
+    # for the default fused engine, tagged for the legacy engine so an A/B
+    # never masquerades as a regression/win against fused rounds.
+    engine_tag = "" if exchange == "fused" else ", legacy-exchange"
+    result = {
         "metric": f"sustained vote ingest ({n_nodes} nodes x {n_txs} txs, "
                   f"k={k}, {n_rounds} rounds, "
-                  f"{jax.devices()[0].platform})",
+                  f"{jax.devices()[0].platform}{engine_tag})",
         "value": round(votes_per_sec, 1),
         "unit": "votes/sec",
         "vs_baseline": round(votes_per_sec / NORTH_STAR_VOTES_PER_SEC, 4),
     }
+    if profile:
+        result["profile_ms"] = _phase_profile(av, state, cfg)
+    return result
+
+
+def _phase_profile(av, state, cfg) -> dict:
+    """Per-phase wall times (ms) from ONE eager round's `annotate` spans.
+
+    The timed measurement above runs the round as a single fused program —
+    nothing per-phase is observable there.  This replays one round eagerly
+    under `tracing.collect_phase_times`, where the same `annotate(...)`
+    spans the profiler sees become wall-clock timers.  Eager dispatch
+    overhead rides along, so treat the numbers as a relative breakdown,
+    not absolute phase costs (`eager_total` records the denominator).
+    """
+    from go_avalanche_tpu.utils import tracing
+
+    t0 = time.perf_counter()
+    with tracing.collect_phase_times() as phases:
+        av.round_step(state, cfg)
+    total = time.perf_counter() - t0
+    out = {name: round(dt * 1e3, 3) for name, dt in sorted(phases.items())}
+    out["eager_total"] = round(total * 1e3, 3)
+    return out
 
 
 def _worker_main(args: argparse.Namespace) -> None:
     if args.force_cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")
-    result = bench(args.nodes, args.txs, args.rounds, args.k)
+    result = bench(args.nodes, args.txs, args.rounds, args.k,
+                   exchange=args.exchange, profile=args.profile)
     if args.nonce:
         # Echoed back so the parent can verify this line belongs to THIS
         # run (the salvage path must never credit a stale line).
@@ -224,6 +283,17 @@ def main() -> None:
     parser.add_argument("--txs", type=int, default=16384)
     parser.add_argument("--rounds", type=int, default=20)
     parser.add_argument("--k", type=int, default=8)
+    parser.add_argument("--exchange", choices=("fused", "legacy"),
+                        default="fused",
+                        help="peer-exchange engine (cfg.fused_exchange): "
+                             "'fused' = single-gather vote collection "
+                             "(default, ops/exchange.py), 'legacy' = the "
+                             "k-pass loops (A/B reference; tags the metric "
+                             "so same-metric deltas never cross engines)")
+    parser.add_argument("--profile", action="store_true",
+                        help="attach per-phase wall times (one eager round "
+                             "under tracing.collect_phase_times) as a "
+                             "'profile_ms' key in the JSON line")
     parser.add_argument("--worker", action="store_true",
                         help="internal: run the measurement in-process")
     parser.add_argument("--force-cpu", action="store_true",
@@ -243,8 +313,10 @@ def main() -> None:
         _worker_main(args)
         return
 
+    flags = [f"--exchange={args.exchange}"] \
+        + (["--profile"] if args.profile else [])
     size = [f"--nodes={args.nodes}", f"--txs={args.txs}",
-            f"--rounds={args.rounds}", f"--k={args.k}"]
+            f"--rounds={args.rounds}", f"--k={args.k}", *flags]
     errors: list[str] = []
 
     # Accelerator attempts with backoff (round-1 failure was transient-shaped).
@@ -263,7 +335,7 @@ def main() -> None:
     cpu_size = [f"--nodes={min(args.nodes, 2048)}",
                 f"--txs={min(args.txs, 2048)}",
                 f"--rounds={min(args.rounds, 5)}",
-                f"--k={args.k}", "--force-cpu"]
+                f"--k={args.k}", *flags, "--force-cpu"]
     parsed, diag = _run_attempt(cpu_size, args.attempt_timeout)
     if parsed is not None:
         parsed["metric"] += " [CPU FALLBACK — accelerator unavailable" \

@@ -1,0 +1,123 @@
+"""Machine-checked pin of the flagship bench program's StableHLO.
+
+The r03->r05 "is the compiled program still the same?" comparison in
+`PERF_NOTES.md` was done by hand (eyeballing HLO dumps across rounds).
+This makes program drift machine-checked: lower the EXACT program
+`bench.py` times (`bench.flagship_program` — same builder, same donation,
+same scan) against abstract full-shape inputs (`jax.eval_shape`: no 4 GB
+state materializes, a CPU box pins the 16384x16384 program in ~1 s),
+strip source locations from the StableHLO text, and hash it.
+
+The archive (`benchmarks/hlo_pin.json`) stores one hash per platform —
+lowering embeds platform-specific custom calls (e.g. the CPU PRNG FFI), so
+a CPU hash cannot check a TPU program.  The tier-1 test
+(`tests/test_bench.py::test_hlo_pin_flagship_hash_matches_archive`)
+recomputes the current platform's hash every run: an UNINTENDED program
+change fails CI; an intended one re-pins with `--update` and the diff of
+`hlo_pin.json` records that the program changed on purpose.
+
+    python benchmarks/hlo_pin.py             # check current platform
+    python benchmarks/hlo_pin.py --update    # re-pin after intended change
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import hashlib
+import json
+import re
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+ARCHIVE = Path(__file__).with_name("hlo_pin.json")
+
+# The flagship shape bench.py defaults to (its --nodes/--txs/--rounds/--k).
+FLAGSHIP = dict(nodes=16384, txs=16384, rounds=20, k=8)
+
+
+def flagship_stablehlo(nodes: int, txs: int, rounds: int, k: int,
+                       exchange: str = "fused") -> str:
+    """StableHLO text of the flagship bench program at the given shape.
+
+    Abstract lowering: `jax.eval_shape` turns the state builder into
+    ShapeDtypeStructs, so nothing allocates and full bench shape lowers on
+    any host.  The program object comes from `bench.flagship_program` —
+    the one `bench()` executes — so the hash pins the timed program
+    itself.
+    """
+    import jax
+
+    import bench
+    from benchmarks.workload import flagship_config, flagship_state
+
+    cfg = flagship_config(txs, k)
+    if exchange != "fused":
+        cfg = dataclasses.replace(cfg, fused_exchange=False)
+    state_abs = jax.eval_shape(lambda: flagship_state(nodes, txs, k)[0])
+    return bench.flagship_program(cfg, rounds).lower(state_abs).as_text()
+
+
+def strip_locations(hlo_text: str) -> str:
+    """Drop source-location metadata: inline ``loc(...)`` attributes and
+    trailing ``#loc`` definition lines.  Locations shift with ANY edit to
+    files on the call path (even comments); the pin must only move when
+    the PROGRAM moves."""
+    stripped = re.sub(r"loc\([^)]*\)", "", hlo_text)
+    return "\n".join(line for line in stripped.splitlines()
+                     if not line.lstrip().startswith("#loc"))
+
+
+def hlo_hash(hlo_text: str) -> str:
+    """sha256 of the location-stripped StableHLO text."""
+    return hashlib.sha256(strip_locations(hlo_text).encode()).hexdigest()
+
+
+def _load_archive() -> dict:
+    if ARCHIVE.exists():
+        return json.loads(ARCHIVE.read_text())
+    return {"workload": dict(FLAGSHIP), "hashes": {}}
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--update", action="store_true",
+                        help="re-pin: write the current platform's hash "
+                             "into the archive instead of checking it")
+    args = parser.parse_args()
+
+    import jax
+
+    platform = jax.default_backend()
+    archive = _load_archive()
+    workload = archive.get("workload", dict(FLAGSHIP))
+    current = hlo_hash(flagship_stablehlo(**workload))
+
+    if args.update:
+        archive["workload"] = workload
+        archive.setdefault("hashes", {})[platform] = current
+        archive["jax"] = jax.__version__
+        ARCHIVE.write_text(json.dumps(archive, indent=2, sort_keys=True)
+                           + "\n")
+        print(f"pinned {platform}: {current}")
+        return
+
+    pinned = archive.get("hashes", {}).get(platform)
+    if pinned is None:
+        print(f"no pin for platform '{platform}' in {ARCHIVE.name}; "
+              f"run with --update to create one", file=sys.stderr)
+        sys.exit(2)
+    if pinned != current:
+        print(f"DRIFT: flagship bench program changed on {platform}\n"
+              f"  pinned:  {pinned}\n"
+              f"  current: {current}\n"
+              f"If intended, re-pin with: python benchmarks/hlo_pin.py "
+              f"--update", file=sys.stderr)
+        sys.exit(1)
+    print(f"ok: {platform} flagship program matches pin ({current[:12]}...)")
+
+
+if __name__ == "__main__":
+    main()

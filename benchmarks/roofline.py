@@ -301,6 +301,37 @@ def main() -> None:
     measure("pref_gathers", gather_step, scan_factory(gather_step),
             gather_carry)
 
+    # --- phase: the FUSED exchange engine (ops/exchange.py, the default
+    # production path since the single-gather rework): pack + ONE flattened
+    # N*k-row gather + bit-transpose into the two uint8 vote planes.  Same
+    # logical bytes as `pref_gathers` (the legacy k-pass reference row
+    # above), one HLO gather instead of k serially-dependent ones —
+    # comparing the two rows is the on-hardware A/B of the rework.
+    from go_avalanche_tpu.ops import adversary as adv_ops
+    from go_avalanche_tpu.ops import exchange
+
+    resp0 = jnp.ones((args.nodes, cfg.k), jnp.bool_)
+    lie0 = jnp.zeros((args.nodes, cfg.k), jnp.bool_)
+    fused_carry = (state.records.confidence,
+                   jnp.zeros((args.nodes, args.txs), jnp.uint8))
+
+    def fused_step(carry, i=jnp.int32(1)):
+        conf, sink = carry
+        key = jax.random.fold_in(jax.random.key(11), i)
+        peers, _ = draw_peers(key, cfg, state.latency_weight, state.alive,
+                              args.nodes)
+        prefs = vr.is_accepted(conf)
+        packed = pack_bool_plane(prefs)
+        yes, con = exchange.fused_vote_packs(
+            packed, peers, resp0, lie0, key, cfg,
+            adv_ops.minority_plane(prefs), args.txs)
+        # conf varies per iteration and both vote planes feed the carry,
+        # so nothing hoists or dead-codes.
+        return (conf ^ i.astype(jnp.uint16), sink ^ yes ^ con)
+
+    measure("exchange_fused", fused_step, scan_factory(fused_step),
+            fused_carry)
+
     # --- phase: peer sampling alone.
     def sample_step(c, i=jnp.int32(1)):
         key = jax.random.fold_in(jax.random.key(9), i)

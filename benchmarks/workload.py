@@ -26,23 +26,31 @@ QUICK = dict(nodes=64, backlog_sets=1024, set_cap=2, window_sets=32)
 _SCORE_SEED, _SIM_SEED, _SCORE_MAX = 1, 0, 1 << 20
 
 
+def flagship_config(txs: int, k: int = 8):
+    """The flagship bench config alone — buildable without materializing
+    state (how `benchmarks/hlo_pin.py` lowers the full-shape program
+    abstractly): finalization unreachable within the timed window
+    (0x7FFE), gossip off (pre-seeded feed, matching the reference example
+    `main.go:49-53`), poll cap covering every tx."""
+    from go_avalanche_tpu.config import AvalancheConfig
+
+    return AvalancheConfig(finalization_score=0x7FFE, k=k, gossip=False,
+                           max_element_poll=max(4096, txs))
+
+
 def flagship_state(nodes: int, txs: int, k: int = 8):
     """The `bench.py` flagship workload: (state, cfg) for sustained vote
     ingest on `models/avalanche.round_step`.
 
     One construction shared by `bench.py` (the throughput number) and
     `benchmarks/roofline.py` (the per-phase bandwidth anchor) so the two
-    always measure the same program: finalization unreachable within the
-    timed window (0x7FFE), gossip off (pre-seeded feed, matching the
-    reference example `main.go:49-53`), poll cap covering every tx.
+    always measure the same program.
     """
     import jax
 
-    from go_avalanche_tpu.config import AvalancheConfig
     from go_avalanche_tpu.models import avalanche as av
 
-    cfg = AvalancheConfig(finalization_score=0x7FFE, k=k, gossip=False,
-                          max_element_poll=max(4096, txs))
+    cfg = flagship_config(txs, k)
     return av.init(jax.random.key(0), nodes, txs, cfg), cfg
 
 

@@ -229,7 +229,7 @@ def measure_cell(n_nodes: int, n_txs: int, rounds: int, c: float,
     cfg = AvalancheConfig(churn_probability=c, gossip=False,
                           drop_probability=drop,
                           skip_absent_votes=skip_absent)
-    run = jax.jit(av.run_scan, static_argnames=("cfg", "n_rounds"))
+    run = av.run_scan   # self-jitting (static cfg/n_rounds)
     out = []
     for s in range(seed, seed + n_seeds):
         state = av.init(jax.random.key(s), n_nodes, n_txs, cfg)

@@ -47,8 +47,7 @@ def run_point(n_nodes: int, n_txs: int, byzantine: float, seed: int,
     state = av.init(jax.random.key(seed), n_nodes, n_txs, cfg,
                     init_pref=init_pref)
     t0 = time.perf_counter()
-    state = jax.jit(av.run, static_argnames=("cfg", "max_rounds"))(
-        state, cfg, max_rounds)
+    state = av.run(state, cfg, max_rounds, donate=True)  # self-jitting
     stats = metrics.rounds_to_finality(state.finalized_at)
     fa = np.asarray(jax.device_get(state.finalized_at))
     n_rounds = int(jax.device_get(state.round))

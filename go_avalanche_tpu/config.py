@@ -110,6 +110,18 @@ class AvalancheConfig:
     cluster_locality: float = 0.8     # P(draw lands in own cluster), for
                                       #   equal-size clusters / uniform base
     gossip: bool = True
+    fused_exchange: bool = True       # peer-exchange engine selector
+                                      #   (ops/exchange.py).  True: ONE
+                                      #   flattened gather of the packed
+                                      #   preference plane produces all k
+                                      #   vote planes, and gossip admission
+                                      #   is one scatter over the flattened
+                                      #   (peer, polled-plane) pairs.
+                                      #   False: the legacy k-pass loops
+                                      #   (k row-gathers, k scatter-ORs).
+                                      #   Bit-exact either way — pinned by
+                                      #   tests/test_exchange.py golden
+                                      #   parity across every config axis.
     strict_validation: bool = False
     stream_retire_cap: Optional[int] = None
                                       # streaming_dag scheduler: cap the

@@ -16,13 +16,18 @@ Map insert/delete become masks: `added` replaces AddTargetToReconcile's map
 insert (`processor.go:55-56`), freezing finalized records replaces the
 delete (`processor.go:114-116`).
 
-Memory discipline: the per-round peer gather never materializes a
-``[nodes, k, txs]`` tensor — it runs as k gathers of ``[nodes, txs]`` planes
-bit-packed into two uint8 planes consumed by `register_packed_votes`.
+Memory discipline: the per-round peer gather never materializes a bool
+``[nodes, k, txs]`` tensor in HBM — the fused engine (`ops/exchange.py`,
+default) gathers all ``N*k`` rows of the BIT-PACKED preference plane in one
+HLO and bit-transposes them element-wise into the two uint8 planes
+`register_packed_votes` consumes; the legacy engine
+(`cfg.fused_exchange=False`) runs the same exchange as k row-gathers.  Both
+are bit-exact (tests/test_exchange.py).
 """
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import NamedTuple, Optional, Tuple
 
@@ -31,12 +36,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG, VoteMode
-from go_avalanche_tpu.ops import adversary, voterecord as vr
-from go_avalanche_tpu.ops.bitops import (
-    pack_bool_plane,
-    popcount8,
-    unpack_bool_plane,
-)
+from go_avalanche_tpu.ops import adversary, exchange, voterecord as vr
+from go_avalanche_tpu.ops.bitops import pack_bool_plane, popcount8
 from go_avalanche_tpu.ops.sampling import draw_peers
 from go_avalanche_tpu.utils.tracing import annotate
 
@@ -56,6 +57,16 @@ class AvalancheSimState(NamedTuple):
     added: jax.Array             # bool [N, T] — node reconciles target
     valid: jax.Array             # bool [T]   — Target.IsValid
     score_rank: jax.Array        # int32 [T]  — 0 = highest score (poll order)
+    poll_order: jax.Array        # int32 [T]  — argsort(score_rank): target
+                                 # ids best-score-first.  Hoisted to init
+                                 # so `capped_poll_mask` pays no per-round
+                                 # argsort; immutable whenever score_rank
+                                 # is (the streaming schedulers refresh
+                                 # both together, `score_rank_with_orders`)
+    poll_order_inv: jax.Array    # int32 [T]  — inverse permutation of
+                                 # poll_order (numerically == score_rank,
+                                 # kept as its own buffer so state
+                                 # donation never aliases two leaves)
     byzantine: jax.Array         # bool [N]
     alive: jax.Array             # bool [N]
     latency_weight: jax.Array    # float32 [N] — peer sampling propensity
@@ -121,10 +132,31 @@ def score_ranks(scores: jax.Array) -> jax.Array:
     (`avalanche.go:162-174`, disabled call at `processor.go:163`).  Ties
     break by index for determinism.
     """
-    order = jnp.argsort(-jnp.asarray(scores), stable=True)
+    return score_rank_with_orders(scores)[0]
+
+
+def score_rank_with_orders(scores: jax.Array) -> Tuple[jax.Array, jax.Array,
+                                                       jax.Array]:
+    """``(score_rank, poll_order, poll_order_inv)`` from raw scores — ONE
+    argsort for all three.
+
+    `poll_order` is the best-score-first target permutation (exactly what
+    `capped_poll_mask` used to recompute every round as
+    ``argsort(score_rank)``: ranks are a permutation, so their stable
+    argsort reproduces the score argsort bit-for-bit) and `poll_order_inv`
+    its inverse — which IS `score_rank`, built here as a second scatter so
+    the two state leaves never share a device buffer (donated states must
+    not alias inputs).  Used by `init` and by every scheduler that refreshes
+    scores mid-run (`models/backlog`, `models/streaming_dag`, their sharded
+    twins).
+    """
+    scores = jnp.asarray(scores)
     t = scores.shape[0]
-    return jnp.zeros((t,), jnp.int32).at[order].set(
-        jnp.arange(t, dtype=jnp.int32))
+    order = jnp.argsort(-scores, stable=True).astype(jnp.int32)
+    ar = jnp.arange(t, dtype=jnp.int32)
+    rank = jnp.zeros((t,), jnp.int32).at[order].set(ar)
+    inv = jnp.zeros((t,), jnp.int32).at[order].set(ar)
+    return rank, order, inv
 
 
 def init(
@@ -169,11 +201,14 @@ def init(
         latency_weights = jnp.ones((n_nodes,), jnp.float32)
 
     n_byz = int(round(cfg.byzantine_fraction * n_nodes))
+    score_rank, poll_order, poll_order_inv = score_rank_with_orders(scores)
     return AvalancheSimState(
         records=vr.init_state(init_pref),
         added=jnp.asarray(added, jnp.bool_),
         valid=jnp.asarray(valid, jnp.bool_),
-        score_rank=score_ranks(scores),
+        score_rank=score_rank,
+        poll_order=poll_order,
+        poll_order_inv=poll_order_inv,
         byzantine=jnp.arange(n_nodes) < n_byz,
         alive=jnp.ones((n_nodes,), jnp.bool_),
         latency_weight=jnp.asarray(latency_weights, jnp.float32),
@@ -188,20 +223,27 @@ def capped_poll_mask(
     pollable: jax.Array,
     score_rank: jax.Array,
     cap: int,
+    poll_order: Optional[jax.Array] = None,
+    poll_order_inv: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Keep at most `cap` pollable targets per node, best score first.
 
     The truncation at `processor.go:165-167` — but by the intended score
     order rather than whatever the map iterator yielded.  No-op (statically)
     when T <= cap.
+
+    `poll_order`/`poll_order_inv` are the init-time-hoisted argsort pair
+    (`AvalancheSimState.poll_order`); when omitted they are recomputed here
+    from `score_rank` — identical bits either way (ranks are a permutation),
+    the hoisted form just skips two argsorts per round.
     """
     t = pollable.shape[-1]
     if t <= cap:
         return pollable
-    order = jnp.argsort(score_rank)           # target indices, best first
+    order = jnp.argsort(score_rank) if poll_order is None else poll_order
     in_order = pollable[:, order]
     keep = (jnp.cumsum(in_order.astype(jnp.int32), axis=1) <= cap) & in_order
-    inv = jnp.argsort(order)
+    inv = jnp.argsort(order) if poll_order_inv is None else poll_order_inv
     return keep[:, inv]
 
 
@@ -220,7 +262,8 @@ def round_step(
         pollable = (state.added & state.alive[:, None] & state.valid[None, :]
                     & jnp.logical_not(fin))
         polled = capped_poll_mask(pollable, state.score_rank,
-                                  cfg.max_element_poll)
+                                  cfg.max_element_poll,
+                                  state.poll_order, state.poll_order_inv)
 
     # --- peer sampling: uniform (with/without replacement),
     # latency-weighted (BASELINE config 5), or clustered topology — the
@@ -243,32 +286,34 @@ def round_step(
                                            peers.shape)
 
     # --- gossip-on-poll: each polled peer admits targets it hasn't seen
-    # (`main.go:177`), via k scatter-ORs (no [N,k,T] tensor).
+    # (`main.go:177`) — one scatter over the flattened (peer, polled-plane)
+    # pairs (fused engine, default) or k scatter-ORs (legacy); identical
+    # bits either way (`ops/exchange.gossip_heard`).
     added = state.added
     admissions = jnp.int32(0)
     if cfg.gossip:
         with annotate("gossip_admission"):
-            heard = jnp.zeros((n, t), jnp.uint8)
-            polled_u8 = polled.astype(jnp.uint8)
-            for j in range(cfg.k):
-                heard = heard.at[peers[:, j]].max(polled_u8)
+            heard = exchange.gossip_heard(peers, polled.astype(jnp.uint8),
+                                          cfg)
             new_adds = ((heard > 0) & jnp.logical_not(added)
                         & state.alive[:, None] & state.valid[None, :])
             admissions = new_adds.sum().astype(jnp.int32)
             added = added | new_adds
 
     # --- gather peer preferences and pack the k votes into bit planes.
-    # The preference plane is bit-packed along txs BEFORE gathering, so each
-    # of the k row-gathers reads T/8 bytes per row instead of T (measured
-    # ~13% faster end-to-end at 8192x8192; it is also the sharded path's
-    # wire format, `parallel/sharded.py`).
+    # The preference plane is bit-packed along txs BEFORE gathering, so the
+    # gather reads T/8 bytes per (node, draw) instead of T (measured ~13%
+    # faster end-to-end at 8192x8192; it is also the sharded path's wire
+    # format, `parallel/sharded.py`).  The engine dispatch
+    # (`ops/exchange.gather_vote_packs`) collects all k draws in ONE
+    # flattened gather by default, or k row-gathers with
+    # `cfg.fused_exchange=False`.
     with annotate("gather_prefs"):
         prefs = vr.is_accepted(state.records.confidence)   # [N, T]
         packed_prefs = pack_bool_plane(prefs)              # [N, ceil(T/8)]
         minority_t = adversary.minority_plane(prefs)       # [T]
-        yes_pack, consider_pack = adversary.pack_adversarial_votes(
-            lambda j: unpack_bool_plane(packed_prefs[peers[:, j]], t),
-            responded, lie, k_byz, cfg, minority_t)
+        yes_pack, consider_pack = exchange.gather_vote_packs(
+            packed_prefs, peers, responded, lie, k_byz, cfg, minority_t, t)
 
     # --- ingest: k fused window updates on polled records only
     # (RegisterVotes, `processor.go:92-117`); finalized records freeze.
@@ -312,6 +357,8 @@ def round_step(
         added=added,
         valid=state.valid,
         score_rank=state.score_rank,
+        poll_order=state.poll_order,
+        poll_order_inv=state.poll_order_inv,
         byzantine=state.byzantine,
         alive=alive,
         latency_weight=state.latency_weight,
@@ -332,32 +379,62 @@ def all_settled(state: AvalancheSimState,
     return jnp.logical_not(pollable.any())
 
 
+# Bounded: a config sweep (examples/churn_tolerance.py builds dozens of
+# distinct cfgs) must not pin every compiled executable for process
+# lifetime — evicting the jitted wrapper lets jax's per-function compile
+# cache go with it.
+@functools.lru_cache(maxsize=32)
+def _compiled_run(cfg: AvalancheConfig, max_rounds: int, donate: bool):
+    def go(state: AvalancheSimState) -> AvalancheSimState:
+        def cond(s: AvalancheSimState) -> jax.Array:
+            return (jnp.logical_not(all_settled(s, cfg))
+                    & (s.round < max_rounds))
+
+        def body(s: AvalancheSimState) -> AvalancheSimState:
+            return round_step(s, cfg)[0]
+
+        return lax.while_loop(cond, body, state)
+
+    return jax.jit(go, donate_argnums=(0,) if donate else ())
+
+
 def run(
     state: AvalancheSimState,
     cfg: AvalancheConfig = DEFAULT_CONFIG,
     max_rounds: int = 2000,
+    donate: bool = False,
 ) -> AvalancheSimState:
-    """Run until the network settles (or `max_rounds`); single compile."""
+    """Run until the network settles (or `max_rounds`); single compile.
 
-    def cond(s: AvalancheSimState) -> jax.Array:
-        return jnp.logical_not(all_settled(s, cfg)) & (s.round < max_rounds)
+    Jits itself (keyed on the static cfg/max_rounds/donate) — callers no
+    longer wrap it in `jax.jit`.  `donate=True` threads `donate_argnums`
+    through so the ``[N, T]`` planes update IN PLACE instead of
+    double-buffering in HBM: the input state's buffers are consumed and
+    must not be reused afterwards (on backends without donation support,
+    e.g. CPU, jax falls back to copies with a warning).
+    """
+    return _compiled_run(cfg, int(max_rounds), bool(donate))(state)
 
-    def body(s: AvalancheSimState) -> AvalancheSimState:
-        new_s, _ = round_step(s, cfg)
-        return new_s
 
-    return lax.while_loop(cond, body, state)
+@functools.lru_cache(maxsize=32)  # bounded — see _compiled_run
+def _compiled_run_scan(cfg: AvalancheConfig, n_rounds: int, donate: bool):
+    def go(state: AvalancheSimState):
+        def step(s: AvalancheSimState, _):
+            return round_step(s, cfg)
+
+        return lax.scan(step, state, None, length=n_rounds)
+
+    return jax.jit(go, donate_argnums=(0,) if donate else ())
 
 
 def run_scan(
     state: AvalancheSimState,
     cfg: AvalancheConfig = DEFAULT_CONFIG,
     n_rounds: int = 200,
+    donate: bool = False,
 ) -> Tuple[AvalancheSimState, SimTelemetry]:
-    """Fixed-round run with stacked per-round telemetry (bench/curves)."""
+    """Fixed-round run with stacked per-round telemetry (bench/curves).
 
-    def step(s: AvalancheSimState, _):
-        new_s, tel = round_step(s, cfg)
-        return new_s, tel
-
-    return lax.scan(step, state, None, length=n_rounds)
+    Self-jitting, with the same `donate` contract as `run`.
+    """
+    return _compiled_run_scan(cfg, int(n_rounds), bool(donate))(state)

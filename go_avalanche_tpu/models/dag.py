@@ -35,8 +35,8 @@ from jax import lax
 
 from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
 from go_avalanche_tpu.models import avalanche as av
-from go_avalanche_tpu.ops import adversary, voterecord as vr
-from go_avalanche_tpu.ops.bitops import pack_bool_plane, unpack_bool_plane
+from go_avalanche_tpu.ops import adversary, exchange, voterecord as vr
+from go_avalanche_tpu.ops.bitops import pack_bool_plane
 from go_avalanche_tpu.ops.sampling import draw_peers
 
 
@@ -197,7 +197,8 @@ def round_step(
     pollable = (base.added & base.alive[:, None] & base.valid[None, :]
                 & jnp.logical_not(fin) & jnp.logical_not(rival_settled))
     polled = av.capped_poll_mask(pollable, base.score_rank,
-                                 cfg.max_element_poll)
+                                 cfg.max_element_poll,
+                                 base.poll_order, base.poll_order_inv)
 
     # Peer sampling + failure model: identical axes to the flat simulator
     # (`models/avalanche.py`) — the shared draw dispatch, byzantine lies,
@@ -219,15 +220,16 @@ def round_step(
     else:
         prefs = preferred_in_set(base.records.confidence, state.conflict_set,
                                  state.n_sets)
-    # Bit-pack the preference plane BEFORE the k row-gathers, as in
-    # `models/avalanche.round_step`: each gather then reads T/8 bytes per
-    # row instead of T (measured 23.0ms -> 10.6ms for the gather+pack stage
-    # at 100k nodes x 2048 txs on v5e — the streaming north-star shape).
+    # Bit-pack the preference plane BEFORE gathering, as in
+    # `models/avalanche.round_step`: the gather then reads T/8 bytes per
+    # (node, draw) instead of T (measured 23.0ms -> 10.6ms for the
+    # gather+pack stage at 100k nodes x 2048 txs on v5e — the streaming
+    # north-star shape).  The engine dispatch collects all k draws in one
+    # flattened gather by default (`ops/exchange.gather_vote_packs`).
     minority_t = adversary.minority_plane(prefs)
     packed_prefs = pack_bool_plane(prefs)
-    yes_pack, consider_pack = adversary.pack_adversarial_votes(
-        lambda j: unpack_bool_plane(packed_prefs[peers[:, j]], t),
-        responded, lie, k_byz, cfg, minority_t)
+    yes_pack, consider_pack = exchange.gather_vote_packs(
+        packed_prefs, peers, responded, lie, k_byz, cfg, minority_t, t)
 
     records, changed = vr.register_packed_votes(
         base.records, yes_pack, consider_pack, cfg.k, cfg, update_mask=polled)
@@ -255,6 +257,8 @@ def round_step(
         added=base.added,
         valid=base.valid,
         score_rank=base.score_rank,
+        poll_order=base.poll_order,
+        poll_order_inv=base.poll_order_inv,
         byzantine=base.byzantine,
         alive=alive,
         latency_weight=base.latency_weight,

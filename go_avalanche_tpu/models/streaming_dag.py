@@ -346,11 +346,14 @@ def _retire_and_refill(
                       state.backlog.score[safe_rows].reshape(w),
                       jnp.int32(-2**31 + 1))
 
+    score_rank, poll_order, poll_order_inv = av.score_rank_with_orders(score)
     new_base = base._replace(
         records=records,
         added=added,
         valid=valid,
-        score_rank=av.score_ranks(score),
+        score_rank=score_rank,
+        poll_order=poll_order,
+        poll_order_inv=poll_order_inv,
         finalized_at=finalized_at,
     )
     return StreamingDagState(

@@ -114,6 +114,35 @@ def pack_adversarial_votes(
     return yes_pack, consider_pack
 
 
+def apply_draw_planes(
+    key: jax.Array,
+    votes: jax.Array,
+    lie: jax.Array,
+    cfg: AvalancheConfig,
+    minority_t: jax.Array,
+) -> jax.Array:
+    """Adversary transform for ALL k draws at once (the fused exchange).
+
+    `votes` is the bool ``[N, k, T]`` gathered-response cube (draw axis 1),
+    `lie` the bool ``[N, k]`` lie mask.  Bit-exact twin of k `apply_plane`
+    calls: pure boolean selects for FLIP / OPPOSE_MAJORITY, and the
+    EQUIVOCATE coins are drawn per draw with the identical
+    ``fold_in(fold_in(key, 0x5A), draw)`` keys, so the fused engine and the
+    legacy k-pass loop see the same random stream.
+    """
+    s = cfg.adversary_strategy
+    if s is AdversaryStrategy.FLIP:
+        return jnp.logical_xor(votes, lie[:, :, None])
+    if s is AdversaryStrategy.EQUIVOCATE:
+        n, k, t = votes.shape
+        base = jax.random.fold_in(key, 0x5A)
+        coins = jnp.stack(
+            [jax.random.bernoulli(jax.random.fold_in(base, j), 0.5, (n, t))
+             for j in range(k)], axis=1)
+        return jnp.where(lie[:, :, None], coins, votes)
+    return jnp.where(lie[:, :, None], minority_t[None, None, :], votes)
+
+
 def apply_plane(
     key: jax.Array,
     draw: int,

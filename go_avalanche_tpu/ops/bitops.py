@@ -24,21 +24,25 @@ def popcount8(x: jax.Array) -> jax.Array:
 
 
 def pack_bool_plane(x: jax.Array) -> jax.Array:
-    """Pack a bool ``[n, t]`` plane into uint8 ``[n, ceil(t/8)]``, bit j of
-    byte b holding column ``8*b + j``.  The wire format for cross-shard
-    preference exchange: 8x less all-gather traffic than bool planes."""
-    n, t = x.shape
+    """Pack a bool ``[..., t]`` plane into uint8 ``[..., ceil(t/8)]``, bit j
+    of byte b holding column ``8*b + j``.  The wire format for cross-shard
+    preference exchange: 8x less all-gather traffic than bool planes.
+    Leading dimensions pass through (the fused exchange engine packs
+    ``[n, k, t]`` vote cubes with the same layout)."""
+    *lead, t = x.shape
     tp = -(-t // 8) * 8
     if tp != t:
-        x = jnp.pad(x, ((0, 0), (0, tp - t)))
+        x = jnp.pad(x, [(0, 0)] * len(lead) + [(0, tp - t)])
     shifts = jnp.arange(8, dtype=jnp.uint8)
-    return (x.reshape(n, tp // 8, 8).astype(jnp.uint8) << shifts).sum(
+    return (x.reshape(*lead, tp // 8, 8).astype(jnp.uint8) << shifts).sum(
         axis=-1).astype(jnp.uint8)
 
 
 def unpack_bool_plane(packed: jax.Array, t: int) -> jax.Array:
-    """Inverse of `pack_bool_plane`: uint8 ``[n, ceil(t/8)]`` -> bool
-    ``[n, t]``."""
+    """Inverse of `pack_bool_plane`: uint8 ``[..., ceil(t/8)]`` -> bool
+    ``[..., t]``.  Pure element-wise bit extraction, so on a gathered
+    ``[n, k, ceil(t/8)]`` cube XLA fuses it into whatever consumes the
+    bools — no unpacked cube ever lands in HBM."""
     shifts = jnp.arange(8, dtype=jnp.uint8)
-    bits = (packed[:, :, None] >> shifts) & jnp.uint8(1)
-    return bits.reshape(packed.shape[0], -1)[:, :t].astype(jnp.bool_)
+    bits = (packed[..., None] >> shifts) & jnp.uint8(1)
+    return bits.reshape(*packed.shape[:-1], -1)[..., :t].astype(jnp.bool_)

@@ -1,0 +1,166 @@
+"""The fused peer-exchange engine: single-gather vote collection and
+one-shot gossip scatter.
+
+The legacy round (`models/avalanche.round_step` pre-fusion) structured its
+peer-exchange phase as k sequential passes: k row-gathers of the bit-packed
+preference plane (one per draw, `adversary.pack_adversarial_votes`) and, with
+gossip on, k sequential scatter-ORs for admission.  DAG-Sword
+(arxiv 2311.04638) and TangleSim (arxiv 2305.01232) both identify
+message-exchange aggregation as the scaling bottleneck of large-network
+ledger simulators; on TPU the same bottleneck shows up as gather/scatter
+DISPATCH COUNT — k serially-dependent HLO ops where one would do.  This
+module collapses both loops:
+
+  * `fused_vote_packs` — ONE flattened gather of ``peers.reshape(N*k)`` rows
+    of the packed ``[n_src, ceil(T/8)]`` preference plane, bit-transposed
+    (element-wise, fully fusable) into the ``(yes_pack, consider_pack)``
+    ``[N, T]`` uint8 k-vote planes that `voterecord.register_packed_votes`
+    consumes.  The gather moves exactly the bytes the k legacy gathers moved
+    (N*k*T/8), but as a single HLO with no inter-pass dependencies.
+  * `fused_gossip_heard` — scatter-max over the flattened
+    ``(peer, polled-plane)`` pairs instead of k serially-dependent
+    scatter-ORs, bit-packed so each pass's update operand is ``[N*k, T/8]``
+    (values are single-bit bytes, so max IS or; duplicate peer draws
+    combine exactly as the k-pass loop combined them).
+
+Both are bit-exact against the legacy loops on every config axis
+(tests/test_exchange.py golden parity); `gather_vote_packs` dispatches on
+`cfg.fused_exchange` so either engine can be selected per run.
+
+The sharded drivers reuse `gather_vote_packs` with the all-gathered
+replicated plane as `packed_prefs` (global peer ids index it directly).
+Their gossip path keeps its own variant
+(`parallel/sharded._gossip_heard_packed`) — same per-bit packed scatter
+idiom as `fused_gossip_heard`, plus the cross-shard `all_to_all` OR the
+single-chip form doesn't need.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from go_avalanche_tpu.config import AvalancheConfig
+from go_avalanche_tpu.ops import adversary
+from go_avalanche_tpu.ops.bitops import pack_bool_plane, unpack_bool_plane
+
+
+def fused_vote_packs(
+    packed_prefs: jax.Array,
+    peers: jax.Array,
+    responded: jax.Array,
+    lie: jax.Array,
+    key: jax.Array,
+    cfg: AvalancheConfig,
+    minority_t: jax.Array,
+    t: int,
+) -> tuple:
+    """Single-gather k-vote collection; returns ``(yes_pack, consider_pack)``.
+
+    `packed_prefs` is the bit-packed preference plane ``[n_src, ceil(t/8)]``
+    (n_src >= N in sharded use: peer ids are global); `peers` int32
+    ``[N, k]``, `responded`/`lie` bool ``[N, k]``.  The gathered
+    ``[N, k, ceil(t/8)]`` cube is unpacked and re-packed along the DRAW axis
+    (bit j of `yes_pack` = draw j's vote) — a bit-transpose that is pure
+    element-wise shift/sum, so XLA fuses it into the gather's consumers and
+    the bool ``[N, k, T]`` cube never materializes in HBM.
+    """
+    n, k = peers.shape
+    if not (0 < k <= 8):
+        raise ValueError("k must be in (0, 8] for uint8 packing")
+    t8 = packed_prefs.shape[-1]
+    flat = packed_prefs[peers.reshape(n * k)]            # THE one gather
+    votes = unpack_bool_plane(flat.reshape(n, k, t8), t)   # [N, k, T] bools
+    votes = adversary.apply_draw_planes(key, votes, lie, cfg, minority_t)
+    shifts = jnp.arange(k, dtype=jnp.uint8)
+    yes_pack = (votes.astype(jnp.uint8) << shifts[None, :, None]).sum(
+        axis=1).astype(jnp.uint8)
+    consider = (responded.astype(jnp.uint8) << shifts[None, :]).sum(
+        axis=1).astype(jnp.uint8)
+    consider_pack = jnp.broadcast_to(consider[:, None], (n, t))
+    return yes_pack, consider_pack
+
+
+def legacy_vote_packs(
+    packed_prefs: jax.Array,
+    peers: jax.Array,
+    responded: jax.Array,
+    lie: jax.Array,
+    key: jax.Array,
+    cfg: AvalancheConfig,
+    minority_t: jax.Array,
+    t: int,
+) -> tuple:
+    """The k-pass engine: one row-gather + unpack + adversary pass per draw
+    (`adversary.pack_adversarial_votes`).  Kept selectable
+    (`cfg.fused_exchange=False`) as the golden-parity reference."""
+    return adversary.pack_adversarial_votes(
+        lambda j: unpack_bool_plane(packed_prefs[peers[:, j]], t),
+        responded, lie, key, cfg, minority_t)
+
+
+def gather_vote_packs(
+    packed_prefs: jax.Array,
+    peers: jax.Array,
+    responded: jax.Array,
+    lie: jax.Array,
+    key: jax.Array,
+    cfg: AvalancheConfig,
+    minority_t: jax.Array,
+    t: int,
+) -> tuple:
+    """The exchange-engine dispatch every multi-target round calls
+    (`models/avalanche`, `models/dag`, `parallel/sharded*`): fused
+    single-gather engine or the legacy k-pass loop, per
+    `cfg.fused_exchange`.  Both return identical bits."""
+    engine = fused_vote_packs if cfg.fused_exchange else legacy_vote_packs
+    return engine(packed_prefs, peers, responded, lie, key, cfg,
+                  minority_t, t)
+
+
+def fused_gossip_heard(peers: jax.Array, polled_u8: jax.Array) -> jax.Array:
+    """Flattened gossip admission scatter; uint8 ``[N, T]`` heard plane.
+
+    The flattened form of the k-pass scatter-OR loop (`main.go:177`
+    batched): every (poller i, draw j) pair contributes poller i's polled
+    plane to row ``peers[i, j]``, all N*k pairs per scatter — no serial
+    dependency between passes, unlike the legacy loop's k chained
+    scatter-ORs.  The polled plane is BIT-PACKED along txs first and
+    scattered one bit position per pass (a max-scatter of values in
+    {0, 1<<b} IS an or-scatter — `parallel/sharded._gossip_heard_packed`'s
+    idiom), so the repeated update operand is ``[N*k, T/8]``: at k=8 the
+    transient equals the legacy loop's single ``[N, T]`` operand instead
+    of 8x it (a bare one-shot uint8 scatter would stage ~1.6 GB at the
+    100k x 2048 north-star shape).  `jnp.repeat` aligns update rows with
+    ``peers.reshape(N*k)`` — row-major, so pair (i, j) sits at i*k + j.
+    Duplicate targets resolve exactly as the sequential maxes did.
+    """
+    n, t = polled_u8.shape
+    k = peers.shape[1]
+    idx = peers.reshape(n * k)
+    packed = pack_bool_plane(polled_u8.astype(jnp.bool_))   # [N, ceil(T/8)]
+    t8 = packed.shape[1]
+    heard8 = jnp.zeros((n, t8), jnp.uint8)
+    for b in range(8):
+        src = packed & jnp.uint8(1 << b)
+        upd = jnp.repeat(src, k, axis=0)                    # [N*k, T/8]
+        heard8 |= jnp.zeros((n, t8), jnp.uint8).at[idx].max(upd)
+    return unpack_bool_plane(heard8, t).astype(jnp.uint8)
+
+
+def legacy_gossip_heard(peers: jax.Array, polled_u8: jax.Array) -> jax.Array:
+    """The k-pass gossip admission: one scatter-OR per draw (golden-parity
+    reference for `fused_gossip_heard`)."""
+    n, t = polled_u8.shape
+    heard = jnp.zeros((n, t), jnp.uint8)
+    for j in range(peers.shape[1]):
+        heard = heard.at[peers[:, j]].max(polled_u8)
+    return heard
+
+
+def gossip_heard(peers: jax.Array, polled_u8: jax.Array,
+                 cfg: AvalancheConfig) -> jax.Array:
+    """Gossip-admission dispatch on `cfg.fused_exchange`."""
+    if cfg.fused_exchange:
+        return fused_gossip_heard(peers, polled_u8)
+    return legacy_gossip_heard(peers, polled_u8)

@@ -17,6 +17,7 @@ This replaces the reference's absence of any distributed backend (its
 
 from __future__ import annotations
 
+import inspect
 from typing import Optional, Sequence
 
 import jax
@@ -25,6 +26,27 @@ from jax.sharding import Mesh
 
 NODES_AXIS = "nodes"
 TXS_AXIS = "txs"
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False):
+    """`jax.shard_map` across jax versions — the one spot that knows the API.
+
+    Newer jax exposes top-level `jax.shard_map`; older releases (this
+    container ships 0.4.37) only have
+    `jax.experimental.shard_map.shard_map`.  The replication-check kwarg
+    was renamed `check_rep` -> `check_vma` SEPARATELY from the top-level
+    promotion, so the dispatch probes the actual signature rather than
+    treating one change as a proxy for the other.  Every sharded driver
+    routes through this wrapper so both probes live in exactly one place.
+    """
+    if hasattr(jax, "shard_map"):
+        fn = jax.shard_map
+    else:
+        from jax.experimental.shard_map import shard_map as fn
+    kwarg = ("check_vma" if "check_vma" in inspect.signature(fn).parameters
+             else "check_rep")
+    return fn(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              **{kwarg: check_vma})
 
 
 def make_mesh(

@@ -51,10 +51,10 @@ from go_avalanche_tpu.models.avalanche import (
     popcnt_plane,
     stamp_finality,
 )
-from go_avalanche_tpu.ops import adversary, voterecord as vr
+from go_avalanche_tpu.ops import adversary, exchange, voterecord as vr
 from go_avalanche_tpu.ops.bitops import pack_bool_plane, unpack_bool_plane
 from go_avalanche_tpu.ops.sampling import draw_peers
-from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS
+from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS, shard_map
 
 
 def state_specs(track_finality: bool = True) -> AvalancheSimState:
@@ -75,6 +75,8 @@ def state_specs(track_finality: bool = True) -> AvalancheSimState:
         added=P(NODES_AXIS, TXS_AXIS),
         valid=P(TXS_AXIS),
         score_rank=P(TXS_AXIS),
+        poll_order=P(TXS_AXIS),      # consulted only when n_tx_shards == 1
+        poll_order_inv=P(TXS_AXIS),  # (the >1 path binary-searches ranks)
         byzantine=P(),           # replicated [N]: peer lookups need all rows
         alive=P(),
         latency_weight=P(),      # replicated [N]: global sampling CDF
@@ -85,7 +87,12 @@ def state_specs(track_finality: bool = True) -> AvalancheSimState:
 
 
 def shard_state(state: AvalancheSimState, mesh) -> AvalancheSimState:
-    """Place a host-built state onto the mesh with the canonical shardings."""
+    """Place a host-built state onto the mesh with the canonical shardings.
+
+    `device_put` may ALIAS leaves whose placement already matches (single
+    host, replicated spec) rather than copy — so when the result feeds a
+    `donate=True` driver, treat the ORIGINAL `state` as consumed too.
+    """
     return jax.tree.map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
         state, state_specs(state.finalized_at is not None))
@@ -109,6 +116,8 @@ def global_capped_poll_mask(
     score_rank: jax.Array,
     cap: int,
     n_tx_shards: int,
+    poll_order: jax.Array | None = None,
+    poll_order_inv: jax.Array | None = None,
 ) -> jax.Array:
     """`capped_poll_mask` with the cap honored GLOBALLY across tx shards.
 
@@ -134,7 +143,8 @@ def global_capped_poll_mask(
     if total_t <= cap:
         return pollable                     # statically un-truncated
     if n_tx_shards == 1:
-        return capped_poll_mask(pollable, score_rank, cap)
+        return capped_poll_mask(pollable, score_rank, cap,
+                                poll_order, poll_order_inv)
 
     n_local = pollable.shape[0]
     rank_row = score_rank[None, :]
@@ -226,7 +236,8 @@ def _local_round(
     pollable = (state.added & alive_local[:, None] & state.valid[None, :]
                 & jnp.logical_not(fin))
     polled = global_capped_poll_mask(pollable, state.score_rank,
-                                     cfg.max_element_poll, n_tx_shards)
+                                     cfg.max_element_poll, n_tx_shards,
+                                     state.poll_order, state.poll_order_inv)
 
     # --- sample k global peer ids for the local rows: the shared draw
     # dispatch (weighted CDFs / cluster rows are global + replicated).
@@ -270,9 +281,12 @@ def _local_round(
     if cfg.adversary_strategy is AdversaryStrategy.EQUIVOCATE:
         k_vote = jax.random.fold_in(k_byz, lax.axis_index(TXS_AXIS))
 
-    yes_pack, consider_pack = adversary.pack_adversarial_votes(
-        lambda j: unpack_bool_plane(packed_global[peers[:, j]], t_local),
-        responded, lie, k_vote, cfg, minority_t)
+    # Engine dispatch (`ops/exchange.gather_vote_packs`): global peer ids
+    # index the replicated packed plane — one flattened gather (fused,
+    # default) or k row-gathers (legacy).
+    yes_pack, consider_pack = exchange.gather_vote_packs(
+        packed_global, peers, responded, lie, k_vote, cfg, minority_t,
+        t_local)
 
     # --- ingest.
     if cfg.vote_mode is VoteMode.SEQUENTIAL:
@@ -321,6 +335,8 @@ def _local_round(
         added=added,
         valid=state.valid,
         score_rank=state.score_rank,
+        poll_order=state.poll_order,
+        poll_order_inv=state.poll_order_inv,
         byzantine=state.byzantine,
         alive=alive,
         latency_weight=state.latency_weight,
@@ -331,16 +347,28 @@ def _local_round(
     return new_state, telemetry
 
 
+def _donate(donate: bool) -> tuple:
+    """`donate_argnums` for the state argument — the shared knob every
+    sharded driver threads through its jit so the ``[N, T]`` planes update
+    in place instead of double-buffering in HBM."""
+    return (0,) if donate else ()
+
+
 def _shard_mapped(mesh, fn, track_finality: bool = True):
     specs = state_specs(track_finality)
     tel_specs = SimTelemetry(*([P()] * len(SimTelemetry._fields)))
-    return jax.shard_map(fn, mesh=mesh, in_specs=(specs,),
-                         out_specs=(specs, tel_specs), check_vma=False)
+    return shard_map(fn, mesh=mesh, in_specs=(specs,),
+                     out_specs=(specs, tel_specs), check_vma=False)
 
 
-def make_sharded_round_step(mesh, cfg: AvalancheConfig = DEFAULT_CONFIG):
+def make_sharded_round_step(mesh, cfg: AvalancheConfig = DEFAULT_CONFIG,
+                            donate: bool = False):
     """Build a jitted one-round step over the mesh; call it with a (global)
-    `AvalancheSimState` placed by `shard_state`."""
+    `AvalancheSimState` placed by `shard_state`.
+
+    `donate=True` donates the input state to each call (in-place plane
+    updates) — callers must chain ``state = step(state)[0]`` and never
+    reuse a consumed state."""
     n_tx = mesh.shape[TXS_AXIS]
     cache = {}
 
@@ -350,7 +378,7 @@ def make_sharded_round_step(mesh, cfg: AvalancheConfig = DEFAULT_CONFIG):
         if (n_global, track) not in cache:
             cache[(n_global, track)] = jax.jit(_shard_mapped(
                 mesh, lambda s: _local_round(s, cfg, n_global, n_tx),
-                track_finality=track))
+                track_finality=track), donate_argnums=_donate(donate))
         return cache[(n_global, track)](state)
 
     return step
@@ -361,6 +389,7 @@ def run_scan_sharded(
     state: AvalancheSimState,
     cfg: AvalancheConfig = DEFAULT_CONFIG,
     n_rounds: int = 100,
+    donate: bool = False,
 ) -> Tuple[AvalancheSimState, SimTelemetry]:
     """Fixed-round sharded run; one jit, collectives inside the scan."""
     n_global = state.records.votes.shape[0]
@@ -374,7 +403,8 @@ def run_scan_sharded(
 
     return jax.jit(_shard_mapped(
         mesh, local_scan,
-        track_finality=state.finalized_at is not None))(state)
+        track_finality=state.finalized_at is not None),
+        donate_argnums=_donate(donate))(state)
 
 
 def run_sharded(
@@ -382,6 +412,7 @@ def run_sharded(
     state: AvalancheSimState,
     cfg: AvalancheConfig = DEFAULT_CONFIG,
     max_rounds: int = 2000,
+    donate: bool = False,
 ) -> AvalancheSimState:
     """Run until globally settled (psum'd flag) or `max_rounds`; one jit."""
     n_global = state.records.votes.shape[0]
@@ -412,6 +443,6 @@ def run_sharded(
         return final
 
     specs = state_specs(state.finalized_at is not None)
-    fn = jax.shard_map(local_run, mesh=mesh, in_specs=(specs,),
-                       out_specs=specs, check_vma=False)
-    return jax.jit(fn)(state)
+    fn = shard_map(local_run, mesh=mesh, in_specs=(specs,),
+                   out_specs=specs, check_vma=False)
+    return jax.jit(fn, donate_argnums=_donate(donate))(state)

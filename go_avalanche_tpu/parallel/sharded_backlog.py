@@ -46,7 +46,7 @@ from go_avalanche_tpu.models.backlog import (
 )
 from go_avalanche_tpu.ops import voterecord as vr
 from go_avalanche_tpu.parallel import sharded
-from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS
+from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS, shard_map
 
 
 def backlog_state_specs(track_finality: bool = True) -> BacklogSimState:
@@ -178,11 +178,16 @@ def _local_retire_and_refill(
                       jnp.int32(-2**31 + 1))
     finalized_at = av.reset_finality(sim.finalized_at, take)
 
+    # Per-shard ranks (module note), with the hoisted poll-order pair
+    # refreshed in the same single argsort.
+    score_rank, poll_order, poll_order_inv = av.score_rank_with_orders(score)
     new_sim = sim._replace(
         records=records,
         added=added,
         valid=valid,
-        score_rank=av.score_ranks(score),   # per-shard ranks (module note)
+        score_rank=score_rank,
+        poll_order=poll_order,
+        poll_order_inv=poll_order_inv,
         finalized_at=finalized_at,
     )
     retired = lax.psum(settled.sum().astype(jnp.int32), TXS_AXIS)
@@ -226,12 +231,14 @@ def _shard_mapped(mesh, fn, with_tel=True, track_finality: bool = True):
         out_specs = (specs, tel_specs)
     else:
         out_specs = specs
-    return jax.shard_map(fn, mesh=mesh, in_specs=(specs,),
-                         out_specs=out_specs, check_vma=False)
+    return shard_map(fn, mesh=mesh, in_specs=(specs,),
+                     out_specs=out_specs, check_vma=False)
 
 
-def make_sharded_backlog_step(mesh, cfg: AvalancheConfig = DEFAULT_CONFIG):
-    """Jitted (state) -> (state, telemetry) scheduler+round step."""
+def make_sharded_backlog_step(mesh, cfg: AvalancheConfig = DEFAULT_CONFIG,
+                              donate: bool = False):
+    """Jitted (state) -> (state, telemetry) scheduler+round step.
+    `donate=True` donates the input state per call (chain, never reuse)."""
     n_tx = mesh.shape[TXS_AXIS]
     cache = {}
 
@@ -241,7 +248,8 @@ def make_sharded_backlog_step(mesh, cfg: AvalancheConfig = DEFAULT_CONFIG):
         if (n_global, track) not in cache:
             cache[(n_global, track)] = jax.jit(_shard_mapped(
                 mesh, lambda s: _local_step(s, cfg, n_global, n_tx),
-                track_finality=track))
+                track_finality=track),
+                donate_argnums=sharded._donate(donate))
         return cache[(n_global, track)](state)
 
     return step
@@ -252,6 +260,7 @@ def run_scan_sharded_backlog(
     state: BacklogSimState,
     cfg: AvalancheConfig = DEFAULT_CONFIG,
     n_rounds: int = 100,
+    donate: bool = False,
 ) -> Tuple[BacklogSimState, BacklogTelemetry]:
     """Fixed-round sharded stream; one jit, collectives inside the scan."""
     n_global = state.sim.records.votes.shape[0]
@@ -265,7 +274,8 @@ def run_scan_sharded_backlog(
 
     return jax.jit(_shard_mapped(
         mesh, local_scan,
-        track_finality=state.sim.finalized_at is not None))(state)
+        track_finality=state.sim.finalized_at is not None),
+        donate_argnums=sharded._donate(donate))(state)
 
 
 def run_sharded_backlog(
@@ -273,6 +283,7 @@ def run_sharded_backlog(
     state: BacklogSimState,
     cfg: AvalancheConfig = DEFAULT_CONFIG,
     max_rounds: int = 100_000,
+    donate: bool = False,
 ) -> BacklogSimState:
     """Stream the whole backlog to settlement over the mesh; one jit.
 
@@ -305,4 +316,5 @@ def run_sharded_backlog(
 
     return jax.jit(_shard_mapped(
         mesh, local_run, with_tel=False,
-        track_finality=state.sim.finalized_at is not None))(state)
+        track_finality=state.sim.finalized_at is not None),
+        donate_argnums=sharded._donate(donate))(state)

@@ -38,11 +38,11 @@ from go_avalanche_tpu.config import (
 from go_avalanche_tpu.models import avalanche as av
 from go_avalanche_tpu.models import dag as dag_model
 from go_avalanche_tpu.models.dag import DagSimState
-from go_avalanche_tpu.ops import adversary, voterecord as vr
-from go_avalanche_tpu.ops.bitops import pack_bool_plane, unpack_bool_plane
+from go_avalanche_tpu.ops import adversary, exchange, voterecord as vr
+from go_avalanche_tpu.ops.bitops import pack_bool_plane
 from go_avalanche_tpu.ops.sampling import draw_peers
 from go_avalanche_tpu.parallel import sharded
-from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS
+from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS, shard_map
 
 
 def dag_state_specs(n_sets: int,
@@ -146,7 +146,9 @@ def _local_round(
     # Global 4096-inv cap across tx shards, as in `parallel/sharded.py`.
     polled = sharded.global_capped_poll_mask(pollable, base.score_rank,
                                              cfg.max_element_poll,
-                                             n_tx_shards)
+                                             n_tx_shards,
+                                             base.poll_order,
+                                             base.poll_order_inv)
 
     # The shared draw dispatch, exactly as in `parallel/sharded`.
     peers, self_draw = draw_peers(k_sample, cfg, base.latency_weight,
@@ -177,9 +179,9 @@ def _local_round(
     if cfg.adversary_strategy is AdversaryStrategy.EQUIVOCATE:
         k_vote = jax.random.fold_in(k_byz, lax.axis_index(TXS_AXIS))
 
-    yes_pack, consider_pack = adversary.pack_adversarial_votes(
-        lambda j: unpack_bool_plane(packed_global[peers[:, j]], t_local),
-        responded, lie, k_vote, cfg, minority_t)
+    yes_pack, consider_pack = exchange.gather_vote_packs(
+        packed_global, peers, responded, lie, k_vote, cfg, minority_t,
+        t_local)
 
     records, changed = vr.register_packed_votes(
         base.records, yes_pack, consider_pack, cfg.k, cfg, update_mask=polled)
@@ -213,7 +215,8 @@ def _local_round(
     )
     new_base = av.AvalancheSimState(
         records=records, added=base.added, valid=base.valid,
-        score_rank=base.score_rank, byzantine=base.byzantine,
+        score_rank=base.score_rank, poll_order=base.poll_order,
+        poll_order_inv=base.poll_order_inv, byzantine=base.byzantine,
         alive=alive, latency_weight=base.latency_weight,
         finalized_at=finalized_at, round=base.round + 1, key=k_next)
     return DagSimState(new_base, state.conflict_set, state.n_sets,
@@ -229,13 +232,15 @@ def _shard_mapped(mesh, n_sets: int, fn, tel: bool = True,
         out_specs = (specs, tel_specs)
     else:
         out_specs = specs
-    return jax.shard_map(fn, mesh=mesh, in_specs=(specs,),
-                         out_specs=out_specs, check_vma=False)
+    return shard_map(fn, mesh=mesh, in_specs=(specs,),
+                     out_specs=out_specs, check_vma=False)
 
 
-def make_sharded_dag_round_step(mesh, cfg: AvalancheConfig = DEFAULT_CONFIG):
+def make_sharded_dag_round_step(mesh, cfg: AvalancheConfig = DEFAULT_CONFIG,
+                                donate: bool = False):
     """Build a jitted one-round DAG step over the mesh; call it with a
-    (global) `DagSimState` placed by `shard_dag_state`."""
+    (global) `DagSimState` placed by `shard_dag_state`.  `donate=True`
+    donates the input state per call (chain, never reuse)."""
     cache = {}
 
     n_tx = mesh.shape[TXS_AXIS]
@@ -248,7 +253,8 @@ def make_sharded_dag_round_step(mesh, cfg: AvalancheConfig = DEFAULT_CONFIG):
             cache[key] = jax.jit(_shard_mapped(
                 mesh, state.n_sets,
                 lambda s: _local_round(s, cfg, n_global, n_tx),
-                set_size=state.set_size, track_finality=key[3]))
+                set_size=state.set_size, track_finality=key[3]),
+                donate_argnums=sharded._donate(donate))
         return cache[key](state)
 
     return step
@@ -259,6 +265,7 @@ def run_sharded_dag(
     state: DagSimState,
     cfg: AvalancheConfig = DEFAULT_CONFIG,
     max_rounds: int = 2000,
+    donate: bool = False,
 ) -> DagSimState:
     """Run until every (live node, set) resolved globally, or `max_rounds`;
     one jit, early exit via a psum'd settled flag."""
@@ -302,4 +309,4 @@ def run_sharded_dag(
     fn = _shard_mapped(mesh, state.n_sets, local_run, tel=False,
                        set_size=state.set_size,
                        track_finality=state.base.finalized_at is not None)
-    return jax.jit(fn)(state)
+    return jax.jit(fn, donate_argnums=sharded._donate(donate))(state)

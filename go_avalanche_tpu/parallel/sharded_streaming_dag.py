@@ -52,7 +52,7 @@ from go_avalanche_tpu.models.streaming_dag import (
 )
 from go_avalanche_tpu.ops import voterecord as vr
 from go_avalanche_tpu.parallel import sharded, sharded_dag
-from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS
+from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS, shard_map
 
 
 def streaming_dag_state_specs(n_sets: int,
@@ -285,11 +285,16 @@ def _local_retire_and_refill(
                       state.backlog.score[safe_rows].reshape(w_local),
                       jnp.int32(-2**31 + 1))
 
+    # Per-shard ranks (module note), with the hoisted poll-order pair
+    # refreshed in the same single argsort.
+    score_rank, poll_order, poll_order_inv = av.score_rank_with_orders(score)
     new_base = base._replace(
         records=records,
         added=added,
         valid=valid,
-        score_rank=av.score_ranks(score),   # per-shard ranks (module note)
+        score_rank=score_rank,
+        poll_order=poll_order,
+        poll_order_inv=poll_order_inv,
         finalized_at=finalized_at,
     )
     retired = lax.psum(settled.sum().astype(jnp.int32), TXS_AXIS)
@@ -336,13 +341,15 @@ def _shard_mapped(mesh, n_sets: int, fn, with_tel=True, set_size=None,
         out_specs = (specs, tel_specs)
     else:
         out_specs = specs
-    return jax.shard_map(fn, mesh=mesh, in_specs=(specs,),
-                         out_specs=out_specs, check_vma=False)
+    return shard_map(fn, mesh=mesh, in_specs=(specs,),
+                     out_specs=out_specs, check_vma=False)
 
 
 def make_sharded_streaming_dag_step(mesh,
-                                    cfg: AvalancheConfig = DEFAULT_CONFIG):
-    """Jitted (state) -> (state, telemetry) scheduler+conflict-round step."""
+                                    cfg: AvalancheConfig = DEFAULT_CONFIG,
+                                    donate: bool = False):
+    """Jitted (state) -> (state, telemetry) scheduler+conflict-round step.
+    `donate=True` donates the input state per call (chain, never reuse)."""
     n_tx = mesh.shape[TXS_AXIS]
     cache = {}
 
@@ -356,7 +363,8 @@ def make_sharded_streaming_dag_step(mesh,
             cache[key] = jax.jit(_shard_mapped(
                 mesh, state.dag.n_sets,
                 lambda s: _local_step(s, cfg, c, n_global, n_tx),
-                set_size=state.dag.set_size, track_finality=key[4]))
+                set_size=state.dag.set_size, track_finality=key[4]),
+                donate_argnums=sharded._donate(donate))
         return cache[key](state)
 
     return step
@@ -367,6 +375,7 @@ def run_sharded_streaming_dag(
     state: StreamingDagState,
     cfg: AvalancheConfig = DEFAULT_CONFIG,
     max_rounds: int = 100_000,
+    donate: bool = False,
 ) -> StreamingDagState:
     """Stream the whole conflict graph to settlement over the mesh; one jit.
 
@@ -402,7 +411,7 @@ def run_sharded_streaming_dag(
                        set_size=state.dag.set_size,
                        track_finality=state.dag.base.finalized_at
                        is not None)
-    return jax.jit(fn)(state)
+    return jax.jit(fn, donate_argnums=sharded._donate(donate))(state)
 
 
 def run_scan_sharded_streaming_dag(
@@ -410,6 +419,7 @@ def run_scan_sharded_streaming_dag(
     state: StreamingDagState,
     cfg: AvalancheConfig = DEFAULT_CONFIG,
     n_rounds: int = 100,
+    donate: bool = False,
 ) -> Tuple[StreamingDagState, StreamingDagTelemetry]:
     """Fixed-round sharded stream; one jit, collectives inside the scan."""
     n_global = state.dag.base.records.votes.shape[0]
@@ -424,4 +434,5 @@ def run_scan_sharded_streaming_dag(
 
     return jax.jit(_shard_mapped(
         mesh, state.dag.n_sets, local_scan, set_size=state.dag.set_size,
-        track_finality=state.dag.base.finalized_at is not None))(state)
+        track_finality=state.dag.base.finalized_at is not None),
+        donate_argnums=sharded._donate(donate))(state)

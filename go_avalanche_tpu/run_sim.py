@@ -115,8 +115,9 @@ def run_avalanche(args, cfg: AvalancheConfig) -> Dict:
         state = sharded.run_sharded(mesh, state, cfg,
                                     max_rounds=args.max_rounds)
     else:
-        state = jax.jit(av.run, static_argnames=("cfg", "max_rounds"))(
-            state, cfg, args.max_rounds)
+        # av.run jits itself (static cfg/max_rounds); donate frees the
+        # double-buffered [N, T] planes — the init state is not reused.
+        state = av.run(state, cfg, args.max_rounds, donate=True)
     fin = np.asarray(jax.device_get(
         vr.has_finalized(state.records.confidence, cfg)))
     out = {

@@ -43,9 +43,75 @@ def annotate(name: str):
     """Named region visible in profiler timelines AND in HLO metadata.
 
     Usable as context manager inside traced code (`jax.named_scope`) — the
-    simulators annotate their phases with this.
+    simulators annotate their phases with this.  Under
+    `collect_phase_times`, the same spans double as wall-clock phase timers
+    (bench.py --profile) with no changes to the annotated code.
     """
+    if _PHASE_SINK is not None:
+        return _TimedPhase(name)
     return jax.named_scope(name)
+
+
+# Active `collect_phase_times` accumulator, or None (the default: annotate
+# spans are pure named scopes).  Module-level on purpose — the annotated
+# simulators must not need a handle to the collector.
+_PHASE_SINK: Optional[Dict[str, float]] = None
+
+
+def _quiesce() -> None:
+    """Drain the device queue: block on every live array.
+
+    The span boundaries need a barrier — eager dispatch is asynchronous, so
+    without one a phase's wall time would bleed into whichever span fetches
+    a result first.  Everything an eager phase dispatched is reachable from
+    a live array, so blocking on all of them is a sound (if blunt) fence.
+    """
+    for arr in jax.live_arrays():
+        try:
+            arr.block_until_ready()
+        except RuntimeError:
+            pass  # deleted/donated buffers have nothing to wait for
+
+
+class _TimedPhase:
+    """annotate()'s span under `collect_phase_times`: quiesce, time,
+    accumulate.  Eager execution only — under a jit trace the barrier sees
+    no new arrays and the span records ~0, it never breaks tracing."""
+
+    def __init__(self, name: str) -> None:
+        self._name = name
+
+    def __enter__(self) -> "_TimedPhase":
+        _quiesce()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        _quiesce()
+        if _PHASE_SINK is not None:
+            self._record(time.perf_counter() - self._t0)
+        return False
+
+    def _record(self, dt: float) -> None:
+        _PHASE_SINK[self._name] = _PHASE_SINK.get(self._name, 0.0) + dt
+
+
+@contextlib.contextmanager
+def collect_phase_times() -> Iterator[Dict[str, float]]:
+    """Collect wall seconds per `annotate` span for the enclosed block.
+
+    Run the annotated code EAGERLY inside (phases inside a jit execute as
+    one fused program — there is nothing per-span to time there).  Yields
+    the accumulating ``{span name: seconds}`` dict; nesting restores the
+    outer collector on exit.
+    """
+    global _PHASE_SINK
+    prev, _PHASE_SINK = _PHASE_SINK, {}
+    try:
+        yield _PHASE_SINK
+        _quiesce()  # un-annotated tail work completes before the caller's
+    finally:        # surrounding timer (bench.py --profile) stops
+        _PHASE_SINK = prev
 
 
 def start_server(port: int = 9999):

@@ -193,7 +193,8 @@ def test_sharded_minority_matches_unsharded():
     from jax.sharding import PartitionSpec as P
 
     from go_avalanche_tpu.parallel import sharded
-    from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS, make_mesh
+    from go_avalanche_tpu.parallel.mesh import (NODES_AXIS, TXS_AXIS,
+                                                 make_mesh, shard_map)
 
     mesh = make_mesh(n_node_shards=4, n_tx_shards=2,
                      devices=jax.devices()[:8])
@@ -202,7 +203,7 @@ def test_sharded_minority_matches_unsharded():
     # Include an exact 50/50 column to pin the tie semantics.
     prefs = prefs.at[:, 0].set(jnp.arange(n) < n // 2)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda p: sharded._global_minority_plane(p, n),
         mesh=mesh, in_specs=P(NODES_AXIS, TXS_AXIS),
         out_specs=P(TXS_AXIS), check_vma=False)

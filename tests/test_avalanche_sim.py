@@ -149,6 +149,49 @@ def test_scan_and_while_loop_agree_on_settled_state():
     assert int(np.asarray(tel.finalizations).sum()) == 24 * 3
 
 
+def test_poll_order_hoist_matches_recomputed_argsorts():
+    """The init-time-hoisted `poll_order`/`poll_order_inv` pair must equal
+    what `capped_poll_mask` used to recompute every round
+    (``argsort(score_rank)`` and its inverse), and feeding the hoisted pair
+    in must return the same mask bits as recomputing."""
+    cfg = AvalancheConfig(max_element_poll=4)
+    n, t = 16, 12
+    scores = jax.random.randint(jax.random.key(8), (t,), 0, 1000)
+    state = av.init(jax.random.key(2), n, t, cfg, scores=scores)
+
+    order = np.argsort(np.asarray(state.score_rank), kind="stable")
+    np.testing.assert_array_equal(np.asarray(state.poll_order), order)
+    np.testing.assert_array_equal(np.asarray(state.poll_order_inv),
+                                  np.argsort(order, kind="stable"))
+    # Ranks are a permutation, so the inverse IS score_rank — but stored
+    # as its own buffer (donation must never alias two state leaves).
+    np.testing.assert_array_equal(np.asarray(state.poll_order_inv),
+                                  np.asarray(state.score_rank))
+
+    pollable = jax.random.bernoulli(jax.random.key(3), 0.7, (n, t))
+    hoisted = av.capped_poll_mask(pollable, state.score_rank,
+                                  cfg.max_element_poll,
+                                  state.poll_order, state.poll_order_inv)
+    recomputed = av.capped_poll_mask(pollable, state.score_rank,
+                                     cfg.max_element_poll)
+    np.testing.assert_array_equal(np.asarray(hoisted),
+                                  np.asarray(recomputed))
+
+
+def test_score_rank_with_orders_single_argsort_consistency():
+    """`score_rank_with_orders` returns a consistent (rank, order, inv)
+    triple from ONE argsort: order is best-score-first with index
+    tie-break, and rank/inv invert it."""
+    scores = jnp.array([5, 9, 9, -3, 5], jnp.int32)
+    rank, order, inv = av.score_rank_with_orders(scores)
+    np.testing.assert_array_equal(np.asarray(order), [1, 2, 0, 4, 3])
+    np.testing.assert_array_equal(
+        np.asarray(rank)[np.asarray(order)], np.arange(5))
+    np.testing.assert_array_equal(np.asarray(inv), np.asarray(rank))
+    np.testing.assert_array_equal(np.asarray(av.score_ranks(scores)),
+                                  np.asarray(rank))
+
+
 def test_init_accepts_per_node_priors():
     """2-D init_pref gives contested networks: per-node initial
     preferences, which still converge to network-wide agreement."""

@@ -59,6 +59,80 @@ def test_parse_result_contract():
     assert bench._parse_result("not json\n") is None
 
 
+@pytest.mark.parametrize("exchange", ["fused", "legacy"])
+def test_bench_one_line_json_contract_both_engines(exchange):
+    """End-to-end bench.py smoke on CPU at 128x128 x 2 rounds: BOTH
+    exchange engines must satisfy the contract — exactly one stdout line,
+    it parses as the result dict, value > 0, exit 0.  The fused run also
+    carries the --profile phase breakdown without breaking the line."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    argv = [sys.executable, str(repo / "bench.py"), "--nodes", "128",
+            "--txs", "128", "--rounds", "2", "--attempts", "1",
+            f"--exchange={exchange}"]
+    if exchange == "fused":
+        argv.append("--profile")
+    proc = subprocess.run(argv, capture_output=True, text=True,
+                          timeout=560, cwd=str(repo), env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    parsed = json.loads(lines[0])
+    assert parsed["unit"] == "votes/sec"
+    assert parsed["value"] > 0
+    tagged = "legacy-exchange" in parsed["metric"]
+    assert tagged == (exchange == "legacy")
+    if exchange == "fused":
+        # --profile attaches the per-phase breakdown (annotate spans of
+        # the flagship round: gossip off => no gossip_admission span).
+        prof = parsed["profile_ms"]
+        assert {"poll_mask", "sample_peers", "gather_prefs",
+                "ingest_votes", "eager_total"} <= set(prof)
+        assert all(v >= 0 for v in prof.values())
+
+
+def test_hlo_pin_flagship_hash_matches_archive():
+    """The flagship bench program's location-stripped StableHLO hash must
+    match the archived pin (benchmarks/hlo_pin.json) — the machine-checked
+    form of the hand-run r03->r05 bench-program comparison.  Abstract
+    lowering (`jax.eval_shape`): the full 16384^2 shape pins in ~1 s with
+    no allocation.  On drift: if the program changed ON PURPOSE, re-pin
+    with `python benchmarks/hlo_pin.py --update` and commit the new hash."""
+    import jax
+
+    from benchmarks import hlo_pin
+
+    archive = json.loads(hlo_pin.ARCHIVE.read_text())
+    pinned = archive["hashes"].get(jax.default_backend())
+    if pinned is None:
+        pytest.skip(f"no {jax.default_backend()} pin archived yet")
+    current = hlo_pin.hlo_hash(
+        hlo_pin.flagship_stablehlo(**archive["workload"]))
+    assert current == pinned, (
+        "flagship bench program drifted from benchmarks/hlo_pin.json; "
+        "if intended, re-pin with `python benchmarks/hlo_pin.py --update`")
+
+
+def test_hlo_pin_strip_locations_is_edit_invariant():
+    """The strip must remove BOTH inline loc(...) attributes and #loc
+    definition lines — whitespace/comment edits to files on the call path
+    must not move the pin."""
+    from benchmarks import hlo_pin
+
+    text = ('module @jit_run {\n'
+            '  %0 = stablehlo.add %a, %b loc("x.py":12:0)\n'
+            '} loc(#loc42)\n'
+            '#loc42 = loc("y.py":7:0)\n')
+    moved = text.replace("12:0", "99:5").replace('"y.py":7', '"y.py":88')
+    assert "loc" not in hlo_pin.strip_locations(text)
+    assert hlo_pin.hlo_hash(text) == hlo_pin.hlo_hash(moved)
+
+
 @pytest.mark.slow
 def test_roofline_quick_emits_parseable_rows(tmp_path):
     """The roofline harness (VERDICT r4 item 4) runs end-to-end on CPU and
@@ -77,7 +151,8 @@ def test_roofline_quick_emits_parseable_rows(tmp_path):
     rows = [json.loads(l) for l in out.read_text().splitlines()]
     phases = {r["phase"] for r in rows}
     assert {"dispatch_floor", "round_step_full", "ingest_kernel",
-            "pref_gathers", "peer_sampling", "streaming_step"} <= phases
+            "pref_gathers", "exchange_fused", "peer_sampling",
+            "streaming_step"} <= phases
     for r in rows:
         assert r["bytes_mb_per_round"] >= 0
         assert r["scan_length"] >= 1

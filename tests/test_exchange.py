@@ -1,0 +1,179 @@
+"""Golden bit-parity of the fused peer-exchange engine (`ops/exchange.py`).
+
+The fused engine (one flattened N*k-row gather + element-wise
+bit-transpose; one scatter-max gossip admission) must produce EXACTLY the
+bits of the legacy k-pass loops on every config axis — that equivalence is
+what lets `cfg.fused_exchange` default to the fast path.  Three layers:
+
+  * unit parity of the two engine primitives on random inputs, across all
+    adversary strategies and duplicate peer draws;
+  * whole-trajectory parity of `models/avalanche.round_step` and
+    `models/dag.round_step` (every state leaf, bit-for-bit) across gossip
+    on/off, drop > 0, byzantine > 0 x all strategies, weighted/clustered
+    sampling, both vote modes, distinct draws, churn, and the poll cap;
+  * the same under donation (`run(..., donate=True)`).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_avalanche_tpu.config import (
+    AdversaryStrategy,
+    AvalancheConfig,
+    VoteMode,
+)
+from go_avalanche_tpu.models import avalanche as av
+from go_avalanche_tpu.models import dag as dag_model
+from go_avalanche_tpu.ops import exchange
+from go_avalanche_tpu.ops.bitops import pack_bool_plane
+
+
+def _assert_trees_equal(a, b) -> None:
+    """Bit-exact leaf compare (PRNG keys via their raw key data)."""
+    paths_a = jax.tree_util.tree_flatten_with_path(a)[0]
+    paths_b = jax.tree_util.tree_flatten_with_path(b)[0]
+    assert len(paths_a) == len(paths_b)
+    for (pa, la), (_, lb) in zip(paths_a, paths_b):
+        if jax.dtypes.issubdtype(getattr(la, "dtype", np.dtype("O")),
+                                 jax.dtypes.prng_key):
+            la, lb = jax.random.key_data(la), jax.random.key_data(lb)
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb),
+                                      err_msg=jax.tree_util.keystr(pa))
+
+
+@pytest.mark.parametrize("strategy", list(AdversaryStrategy))
+def test_vote_pack_engines_bit_identical(strategy):
+    """`fused_vote_packs` == `legacy_vote_packs` on random inputs for every
+    adversary strategy (same key => same equivocation coins)."""
+    n, t, k = 37, 21, 8  # odd shapes: exercise the t%8 packing tail
+    cfg = AvalancheConfig(k=k, adversary_strategy=strategy,
+                          byzantine_fraction=0.3)
+    key = jax.random.key(3)
+    ks = jax.random.split(key, 5)
+    prefs = jax.random.bernoulli(ks[0], 0.5, (n, t))
+    packed = pack_bool_plane(prefs)
+    peers = jax.random.randint(ks[1], (n, k), 0, n, jnp.int32)
+    responded = jax.random.bernoulli(ks[2], 0.8, (n, k))
+    lie = jax.random.bernoulli(ks[3], 0.4, (n, k))
+    minority_t = jax.random.bernoulli(ks[4], 0.5, (t,))
+
+    args = (packed, peers, responded, lie, key, cfg, minority_t, t)
+    yes_f, con_f = exchange.fused_vote_packs(*args)
+    yes_l, con_l = exchange.legacy_vote_packs(*args)
+    np.testing.assert_array_equal(np.asarray(yes_f), np.asarray(yes_l))
+    np.testing.assert_array_equal(np.asarray(con_f), np.asarray(con_l))
+
+
+def test_gossip_engines_bit_identical_with_duplicate_draws():
+    """`fused_gossip_heard` == `legacy_gossip_heard`, including duplicate
+    (peer, draw) targets — scatter-max combines them exactly as the k
+    sequential scatter-ORs did."""
+    n, t, k = 29, 13, 8
+    key = jax.random.key(11)
+    # Few distinct peers => many duplicate scatter targets per round.
+    peers = jax.random.randint(key, (n, k), 0, 5, jnp.int32)
+    polled = jax.random.bernoulli(jax.random.fold_in(key, 1), 0.5,
+                                  (n, t)).astype(jnp.uint8)
+    np.testing.assert_array_equal(
+        np.asarray(exchange.fused_gossip_heard(peers, polled)),
+        np.asarray(exchange.legacy_gossip_heard(peers, polled)))
+
+
+def test_gather_vote_packs_dispatches_on_config_flag():
+    n, t, k = 8, 8, 4
+    cfg_f = AvalancheConfig(k=k)
+    cfg_l = dataclasses.replace(cfg_f, fused_exchange=False)
+    key = jax.random.key(0)
+    packed = pack_bool_plane(jax.random.bernoulli(key, 0.5, (n, t)))
+    peers = jax.random.randint(key, (n, k), 0, n, jnp.int32)
+    ones = jnp.ones((n, k), jnp.bool_)
+    minority = jnp.zeros((t,), jnp.bool_)
+    out_f = exchange.gather_vote_packs(packed, peers, ones, ~ones, key,
+                                       cfg_f, minority, t)
+    out_l = exchange.gather_vote_packs(packed, peers, ones, ~ones, key,
+                                       cfg_l, minority, t)
+    _assert_trees_equal(out_f, out_l)
+
+
+# Every config axis the tentpole requires parity on.  Each entry runs the
+# full round_step trajectory twice — fused vs legacy — from one init.
+PARITY_AXES = {
+    "gossip-on": dict(),
+    "gossip-off": dict(gossip=False),
+    "drop": dict(drop_probability=0.3),
+    "byz-flip": dict(byzantine_fraction=0.25,
+                     adversary_strategy=AdversaryStrategy.FLIP),
+    "byz-equivocate": dict(byzantine_fraction=0.25,
+                           adversary_strategy=AdversaryStrategy.EQUIVOCATE),
+    "byz-oppose": dict(byzantine_fraction=0.25,
+                       adversary_strategy=AdversaryStrategy.OPPOSE_MAJORITY),
+    "weighted": dict(weighted_sampling=True),
+    "clustered": dict(n_clusters=4, cluster_locality=0.9),
+    "vote-majority": dict(vote_mode=VoteMode.MAJORITY),
+    "distinct-draws": dict(sample_with_replacement=False),
+    "poll-capped": dict(max_element_poll=4),
+    "churn-skip-absent": dict(churn_probability=0.1, drop_probability=0.1,
+                              skip_absent_votes=True),
+}
+
+
+@pytest.mark.parametrize("axis", sorted(PARITY_AXES))
+def test_round_step_trajectory_parity(axis):
+    """Fused and legacy engines produce bit-identical `round_step`
+    trajectories — every state leaf and every telemetry field — on each
+    config axis."""
+    cfg_fused = AvalancheConfig(fused_exchange=True, **PARITY_AXES[axis])
+    cfg_legacy = dataclasses.replace(cfg_fused, fused_exchange=False)
+    n, t = 48, 12
+    sf = av.init(jax.random.key(42), n, t, cfg_fused)
+    sl = av.init(jax.random.key(42), n, t, cfg_legacy)
+    step_f = jax.jit(av.round_step, static_argnames="cfg")
+    step_l = jax.jit(av.round_step, static_argnames="cfg")
+    for _ in range(8):
+        sf, tel_f = step_f(sf, cfg_fused)
+        sl, tel_l = step_l(sl, cfg_legacy)
+        _assert_trees_equal(sf, sl)
+        _assert_trees_equal(tel_f, tel_l)
+
+
+@pytest.mark.parametrize("axis", ["gossip-on", "byz-equivocate", "drop"])
+def test_dag_round_step_trajectory_parity(axis):
+    """The conflict-DAG round consumes the same engine dispatch — parity
+    holds there too (per-set preferences feed the gather)."""
+    cfg_fused = AvalancheConfig(fused_exchange=True, **PARITY_AXES[axis])
+    cfg_legacy = dataclasses.replace(cfg_fused, fused_exchange=False)
+    conflict_set = jnp.repeat(jnp.arange(6, dtype=jnp.int32), 2)  # 6 pairs
+    sf = dag_model.init(jax.random.key(7), 32, conflict_set, cfg_fused)
+    sl = dag_model.init(jax.random.key(7), 32, conflict_set, cfg_legacy)
+    step = jax.jit(dag_model.round_step, static_argnames="cfg")
+    for _ in range(6):
+        sf, _ = step(sf, cfg_fused)
+        sl, _ = step(sl, cfg_legacy)
+        _assert_trees_equal(sf, sl)
+
+
+def test_run_donated_matches_undonated():
+    """`run(..., donate=True)` (in-place plane updates) settles to the
+    same bits as the undonated run."""
+    cfg = AvalancheConfig()
+    a = av.run(av.init(jax.random.key(5), 32, 6, cfg), cfg,
+               max_rounds=200, donate=True)
+    b = av.run(av.init(jax.random.key(5), 32, 6, cfg), cfg,
+               max_rounds=200, donate=False)
+    _assert_trees_equal(a, b)
+
+
+def test_fused_rejects_unpackable_k():
+    """k must fit a uint8 vote pack — the engine guards it statically."""
+    n, t, k = 4, 8, 9
+    packed = jnp.zeros((n, 1), jnp.uint8)
+    peers = jnp.zeros((n, k), jnp.int32)
+    ones = jnp.ones((n, k), jnp.bool_)
+    with pytest.raises(ValueError, match="k must be"):
+        exchange.fused_vote_packs(packed, peers, ones, ~ones,
+                                  jax.random.key(0), AvalancheConfig(k=8),
+                                  jnp.zeros((t,), jnp.bool_), t)

@@ -10,7 +10,7 @@ from go_avalanche_tpu.config import AvalancheConfig
 from go_avalanche_tpu.models import avalanche as av
 from go_avalanche_tpu.ops.bitops import pack_bool_plane, unpack_bool_plane
 from go_avalanche_tpu.parallel import sharded
-from go_avalanche_tpu.parallel.mesh import make_mesh
+from go_avalanche_tpu.parallel.mesh import make_mesh, shard_map
 
 
 @pytest.fixture(params=[(8, 1), (4, 2), (2, 4)])
@@ -123,7 +123,7 @@ def test_global_capped_poll_mask_matches_flat_oracle(mesh):
 
     flat = av.capped_poll_mask(pollable, rank, cap)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda p, r: sharded.global_capped_poll_mask(p, r, cap, n_tx),
         mesh=mesh, in_specs=(P("nodes", "txs"), P("txs")),
         out_specs=P("nodes", "txs"), check_vma=False)
@@ -156,9 +156,9 @@ def test_gossip_heard_packed_matches_unpacked_oracle(mesh):
         packed = sharded._gossip_heard_packed(peers_blk, polled_blk, n)
         return unpack_bool_plane(packed, t_local)
 
-    fn = jax.shard_map(local, mesh=mesh,
-                       in_specs=(P("nodes", None), P("nodes", "txs")),
-                       out_specs=P("nodes", "txs"), check_vma=False)
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(P("nodes", None), P("nodes", "txs")),
+                   out_specs=P("nodes", "txs"), check_vma=False)
     out = jax.jit(fn)(peers, polled)
     np.testing.assert_array_equal(np.asarray(out), expected)
 
