@@ -85,7 +85,7 @@ def flagship_program(cfg, n_rounds: int):
 
 def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
           repeats: int = 3, exchange: str = "fused",
-          profile: bool = False) -> dict:
+          ingest: str = "u8", profile: bool = False) -> dict:
     import dataclasses
 
     import jax
@@ -101,6 +101,8 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
     state, cfg = flagship_state(n_nodes, n_txs, k)
     if exchange != "fused":
         cfg = dataclasses.replace(cfg, fused_exchange=False)
+    if ingest != "u8":
+        cfg = dataclasses.replace(cfg, ingest_engine=ingest)
 
     # The round loop runs ON DEVICE (lax.scan inside one jit): dispatching
     # rounds one by one from Python pays a fixed per-call latency (~6ms
@@ -126,9 +128,10 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
     votes_per_sec = votes / best_dt
     # The metric string is part of the round-over-round delta contract
     # (`_attach_prev_delta` compares same-metric rounds only): unchanged
-    # for the default fused engine, tagged for the legacy engine so an A/B
-    # never masquerades as a regression/win against fused rounds.
+    # for the default engines, tagged for the A/B variants so an A/B
+    # never masquerades as a regression/win against default rounds.
     engine_tag = "" if exchange == "fused" else ", legacy-exchange"
+    engine_tag += "" if ingest == "u8" else f", {ingest}-ingest"
     result = {
         "metric": f"sustained vote ingest ({n_nodes} nodes x {n_txs} txs, "
                   f"k={k}, {n_rounds} rounds, "
@@ -168,7 +171,8 @@ def _worker_main(args: argparse.Namespace) -> None:
         import jax
         jax.config.update("jax_platforms", "cpu")
     result = bench(args.nodes, args.txs, args.rounds, args.k,
-                   exchange=args.exchange, profile=args.profile)
+                   exchange=args.exchange, ingest=args.ingest,
+                   profile=args.profile)
     if args.nonce:
         # Echoed back so the parent can verify this line belongs to THIS
         # run (the salvage path must never credit a stale line).
@@ -290,6 +294,13 @@ def main() -> None:
                              "(default, ops/exchange.py), 'legacy' = the "
                              "k-pass loops (A/B reference; tags the metric "
                              "so same-metric deltas never cross engines)")
+    parser.add_argument("--ingest", choices=("u8", "swar32"), default="u8",
+                        help="RegisterVotes ingest engine "
+                             "(cfg.ingest_engine): 'u8' = per-vote uint8 "
+                             "window updates (default), 'swar32' = SWAR "
+                             "lane-packed engine (ops/swar.py; tags the "
+                             "metric so same-metric deltas never cross "
+                             "engines)")
     parser.add_argument("--profile", action="store_true",
                         help="attach per-phase wall times (one eager round "
                              "under tracing.collect_phase_times) as a "
@@ -313,7 +324,7 @@ def main() -> None:
         _worker_main(args)
         return
 
-    flags = [f"--exchange={args.exchange}"] \
+    flags = [f"--exchange={args.exchange}", f"--ingest={args.ingest}"] \
         + (["--profile"] if args.profile else [])
     size = [f"--nodes={args.nodes}", f"--txs={args.txs}",
             f"--rounds={args.rounds}", f"--k={args.k}", *flags]

@@ -24,6 +24,94 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
+def count_hlo_ops(compiled_text: str) -> tuple:
+    """(instructions, element-ops) of an optimized-HLO module dump,
+    fusion bodies included, parameters/constants excluded.
+
+    Counting the POST-optimization module means CSE/alg-simp have
+    already run, so both numbers reflect what executes, not how the jnp
+    source was spelled.  The two answer different questions:
+
+      * `instructions` — how many HLO ops the module contains.  On the
+        CPU backend this is inflated by per-output-root outlining (no
+        multi-output fusion: producers shared by several roots are
+        re-emitted per root), so it measures program SIZE, not work;
+      * `element-ops` — each instruction weighted by its output element
+        count: the scalar-lane operations the vector units actually
+        execute.  This is the number the SWAR lane packing moves (a
+        quarter-width op counts a quarter of a full-width one).
+    """
+    import math
+    import re
+
+    instructions = 0
+    element_ops = 0
+    for line in compiled_text.splitlines():
+        s = line.strip()
+        if not (s.startswith("%") or s.startswith("ROOT ")):
+            continue
+        if " = " not in s:
+            continue
+        if re.search(r"= \S+ (parameter|constant)\(", s):
+            continue
+        instructions += 1
+        m = re.search(r"= (?:\(?)[a-z0-9]+\[([0-9,]*)\]", s)
+        if m:
+            dims = [int(d) for d in m.group(1).split(",") if d]
+            element_ops += math.prod(dims) if dims else 1
+    return instructions, element_ops
+
+
+def ingest_engine_rows(shape: str) -> list:
+    """The PR 2 acceptance measurement: the bare RegisterVotes program
+    (`voterecord.register_packed_votes_engine`) lowered abstractly at the
+    bench shape under each `cfg.ingest_engine`, reporting the optimized
+    module's HLO op count alongside the cost model's bytes/flops.  The
+    two programs are bit-identical in results (tests/test_swar.py); the
+    comparison is pure cost."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from benchmarks.workload import flagship_config
+    from go_avalanche_tpu.ops import voterecord as vr
+
+    n, t = (int(x) for x in shape.split(","))
+    base_cfg = flagship_config(t, 8)
+    rec_abs = vr.VoteRecordState(
+        votes=jax.ShapeDtypeStruct((n, t), jnp.uint8),
+        consider=jax.ShapeDtypeStruct((n, t), jnp.uint8),
+        confidence=jax.ShapeDtypeStruct((n, t), jnp.uint16))
+    plane_abs = jax.ShapeDtypeStruct((n, t), jnp.uint8)
+
+    rows = []
+    for engine in ("u8", "swar32"):
+        cfg = dataclasses.replace(base_cfg, ingest_engine=engine)
+
+        def ingest(recs, yes, con, cfg=cfg):
+            return vr.register_packed_votes_engine(recs, yes, con, cfg.k,
+                                                   cfg)[0]
+
+        compiled = jax.jit(ingest).lower(rec_abs, plane_abs,
+                                         plane_abs).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        instructions, element_ops = count_hlo_ops(compiled.as_text())
+        rows.append({
+            "program": f"ingest_{engine}",
+            "nodes": n,
+            "txs": t,
+            "hlo_instructions": instructions,
+            "hlo_element_gops": round(element_ops / 1e9, 2),
+            "bytes_accessed_mb": round(ca.get("bytes accessed", 0) / 1e6,
+                                       1),
+            "gflops": round(ca.get("flops", 0) / 1e9, 2),
+        })
+    return rows
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--nodes", type=int, default=4096)
@@ -43,12 +131,29 @@ def main() -> None:
     parser.add_argument("--out", type=str, default=None,
                         help="also write the rows to this path (how the "
                              "baseline file is refreshed)")
+    parser.add_argument("--ingest", action="store_true",
+                        help="ALSO emit the ingest-engine comparison: one "
+                             "row per cfg.ingest_engine ('u8' vs 'swar32') "
+                             "for the bare RegisterVotes program at "
+                             "--ingest-shape, with the optimized-HLO op "
+                             "count next to the cost model's bytes/flops "
+                             "(the PR 2 acceptance metric).  These rows "
+                             "are not part of the --check/--out baseline "
+                             "contract")
+    parser.add_argument("--ingest-shape", type=str, default="16384,16384",
+                        metavar="N,T",
+                        help="shape for the --ingest comparison (default: "
+                             "the flagship bench shape)")
     args = parser.parse_args()
 
     import jax
 
     jax.config.update("jax_platforms", "cpu")  # env var is overridden by
     # the accelerator sitecustomize; see tests/conftest.py
+
+    if args.ingest:
+        for row in ingest_engine_rows(args.ingest_shape):
+            print(json.dumps(row), flush=True)
 
     from benchmarks.workload import northstar_state
     from go_avalanche_tpu.models import dag as dag_model

@@ -1,23 +1,39 @@
-"""Machine-checked pin of the flagship bench program's StableHLO.
+"""Machine-checked pins of the hot-path programs' StableHLO.
 
 The r03->r05 "is the compiled program still the same?" comparison in
 `PERF_NOTES.md` was done by hand (eyeballing HLO dumps across rounds).
-This makes program drift machine-checked: lower the EXACT program
-`bench.py` times (`bench.flagship_program` — same builder, same donation,
-same scan) against abstract full-shape inputs (`jax.eval_shape`: no 4 GB
-state materializes, a CPU box pins the 16384x16384 program in ~1 s),
-strip source locations from the StableHLO text, and hash it.
+This makes program drift machine-checked: lower each pinned program
+against abstract full-shape inputs (`jax.eval_shape`: no multi-GB state
+materializes, a CPU box pins the 16384x16384 program in ~1 s), strip
+source locations from the StableHLO text, and hash it.
 
-The archive (`benchmarks/hlo_pin.json`) stores one hash per platform —
-lowering embeds platform-specific custom calls (e.g. the CPU PRNG FFI), so
-a CPU hash cannot check a TPU program.  The tier-1 test
-(`tests/test_bench.py::test_hlo_pin_flagship_hash_matches_archive`)
-recomputes the current platform's hash every run: an UNINTENDED program
-change fails CI; an intended one re-pins with `--update` and the diff of
-`hlo_pin.json` records that the program changed on purpose.
+Pinned programs (PR 2 extended the archive from the single flagship
+entry):
 
-    python benchmarks/hlo_pin.py             # check current platform
-    python benchmarks/hlo_pin.py --update    # re-pin after intended change
+  flagship         — the EXACT program `bench.py` times
+                     (`bench.flagship_program`: same builder, same
+                     donation, same scan), default engines;
+  flagship_swar32  — the same program under `cfg.ingest_engine =
+                     "swar32"` (the SWAR lane-packed ingest engine), so
+                     an A/B measurement always runs the program its
+                     label claims;
+  streaming_step   — one `models/streaming_dag.step` at the roofline's
+                     streaming shape (the north-star scheduler's inner
+                     program).
+
+The archive (`benchmarks/hlo_pin.json`) stores one hash per
+(program, platform) — lowering embeds platform-specific custom calls
+(e.g. the CPU PRNG FFI), so a CPU hash cannot check a TPU program.  The
+tier-1 test (`tests/test_bench.py::test_hlo_pin_hashes_match_archive`)
+recomputes every pinned program's hash for the current platform each
+run: an UNINTENDED program change fails CI; an intended one re-pins with
+`--update` and the diff of `hlo_pin.json` records that the program
+changed on purpose.
+
+    python benchmarks/hlo_pin.py                    # check all pins
+    python benchmarks/hlo_pin.py --list             # show pinned programs
+    python benchmarks/hlo_pin.py --update           # re-pin all programs
+    python benchmarks/hlo_pin.py --update flagship  # re-pin one program
 """
 
 from __future__ import annotations
@@ -36,10 +52,14 @@ ARCHIVE = Path(__file__).with_name("hlo_pin.json")
 
 # The flagship shape bench.py defaults to (its --nodes/--txs/--rounds/--k).
 FLAGSHIP = dict(nodes=16384, txs=16384, rounds=20, k=8)
+# The roofline's streaming shape (roofline.py's non-quick northstar_state).
+STREAMING = dict(nodes=4096, backlog_sets=20000, set_cap=2,
+                 window_sets=1024)
 
 
 def flagship_stablehlo(nodes: int, txs: int, rounds: int, k: int,
-                       exchange: str = "fused") -> str:
+                       exchange: str = "fused",
+                       ingest: str = "u8") -> str:
     """StableHLO text of the flagship bench program at the given shape.
 
     Abstract lowering: `jax.eval_shape` turns the state builder into
@@ -56,8 +76,40 @@ def flagship_stablehlo(nodes: int, txs: int, rounds: int, k: int,
     cfg = flagship_config(txs, k)
     if exchange != "fused":
         cfg = dataclasses.replace(cfg, fused_exchange=False)
+    if ingest != "u8":
+        cfg = dataclasses.replace(cfg, ingest_engine=ingest)
     state_abs = jax.eval_shape(lambda: flagship_state(nodes, txs, k)[0])
     return bench.flagship_program(cfg, rounds).lower(state_abs).as_text()
+
+
+def streaming_step_stablehlo(nodes: int, backlog_sets: int, set_cap: int,
+                             window_sets: int) -> str:
+    """StableHLO text of one north-star streaming-scheduler step
+    (`models/streaming_dag.step`) at the roofline's streaming shape,
+    abstractly lowered like the flagship."""
+    import jax
+
+    from benchmarks.workload import northstar_config, northstar_state
+    from go_avalanche_tpu.models import streaming_dag as sdg
+
+    cfg = northstar_config(window_sets, set_cap)
+    state_abs = jax.eval_shape(lambda: northstar_state(
+        nodes=nodes, backlog_sets=backlog_sets, set_cap=set_cap,
+        window_sets=window_sets, track_finality=False)[0])
+    return jax.jit(lambda s: sdg.step(s, cfg)[0]).lower(
+        state_abs).as_text()
+
+
+# program name -> (workload dict, builder).  Every entry is checked by
+# the tier-1 drift test; --update re-pins them all.
+PROGRAMS = {
+    "flagship": (dict(FLAGSHIP),
+                 lambda w: flagship_stablehlo(**w)),
+    "flagship_swar32": (dict(FLAGSHIP, ingest="swar32"),
+                        lambda w: flagship_stablehlo(**w)),
+    "streaming_step": (dict(STREAMING),
+                       lambda w: streaming_step_stablehlo(**w)),
+}
 
 
 def strip_locations(hlo_text: str) -> str:
@@ -75,48 +127,101 @@ def hlo_hash(hlo_text: str) -> str:
     return hashlib.sha256(strip_locations(hlo_text).encode()).hexdigest()
 
 
+def program_hash(name: str, workload: dict | None = None) -> str:
+    """Current hash of a pinned program (archive workload or default)."""
+    default_workload, builder = PROGRAMS[name]
+    return hlo_hash(builder(workload or default_workload))
+
+
 def _load_archive() -> dict:
-    if ARCHIVE.exists():
-        return json.loads(ARCHIVE.read_text())
-    return {"workload": dict(FLAGSHIP), "hashes": {}}
+    if not ARCHIVE.exists():
+        return {"programs": {}}
+    archive = json.loads(ARCHIVE.read_text())
+    if "programs" not in archive:
+        # PR 1 single-program schema: {"workload": ..., "hashes": ...}.
+        archive = {"programs": {"flagship": {
+            "workload": archive.get("workload", dict(FLAGSHIP)),
+            "hashes": archive.get("hashes", {})}},
+            "jax": archive.get("jax")}
+    return archive
 
 
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("--update", action="store_true",
-                        help="re-pin: write the current platform's hash "
-                             "into the archive instead of checking it")
+    parser.add_argument("--update", nargs="*", metavar="PROGRAM",
+                        default=None,
+                        help="re-pin: write the current platform's hashes "
+                             "into the archive instead of checking.  With "
+                             "names, re-pin only those programs; bare "
+                             "--update re-pins every known program")
+    parser.add_argument("--list", action="store_true",
+                        help="list pinned programs and their hashes")
     args = parser.parse_args()
+
+    archive = _load_archive()
+
+    if args.list:
+        for name, entry in sorted(archive.get("programs", {}).items()):
+            known = "" if name in PROGRAMS else "  [UNKNOWN PROGRAM]"
+            print(f"{name}{known}")
+            workload = json.dumps(entry.get("workload", {}),
+                                  sort_keys=True)
+            print(f"  workload: {workload}")
+            for platform, digest in sorted(entry.get("hashes",
+                                                     {}).items()):
+                print(f"  {platform}: {digest}")
+        return
 
     import jax
 
     platform = jax.default_backend()
-    archive = _load_archive()
-    workload = archive.get("workload", dict(FLAGSHIP))
-    current = hlo_hash(flagship_stablehlo(**workload))
 
-    if args.update:
-        archive["workload"] = workload
-        archive.setdefault("hashes", {})[platform] = current
+    if args.update is not None:
+        names = args.update or sorted(PROGRAMS)
+        unknown = [n for n in names if n not in PROGRAMS]
+        if unknown:
+            print(f"unknown program(s): {', '.join(unknown)}; known: "
+                  f"{', '.join(sorted(PROGRAMS))}", file=sys.stderr)
+            sys.exit(2)
+        for name in names:
+            entry = archive.setdefault("programs", {}).setdefault(
+                name, {"workload": dict(PROGRAMS[name][0]), "hashes": {}})
+            entry.setdefault("workload", dict(PROGRAMS[name][0]))
+            current = program_hash(name, entry["workload"])
+            entry.setdefault("hashes", {})[platform] = current
+            print(f"pinned {name} [{platform}]: {current}")
         archive["jax"] = jax.__version__
         ARCHIVE.write_text(json.dumps(archive, indent=2, sort_keys=True)
                            + "\n")
-        print(f"pinned {platform}: {current}")
         return
 
-    pinned = archive.get("hashes", {}).get(platform)
-    if pinned is None:
-        print(f"no pin for platform '{platform}' in {ARCHIVE.name}; "
-              f"run with --update to create one", file=sys.stderr)
-        sys.exit(2)
-    if pinned != current:
-        print(f"DRIFT: flagship bench program changed on {platform}\n"
-              f"  pinned:  {pinned}\n"
-              f"  current: {current}\n"
-              f"If intended, re-pin with: python benchmarks/hlo_pin.py "
-              f"--update", file=sys.stderr)
+    failures = []
+    checked = 0
+    for name, entry in sorted(archive.get("programs", {}).items()):
+        if name not in PROGRAMS:
+            failures.append(f"{name}: archived but unknown to hlo_pin.py")
+            continue
+        pinned = entry.get("hashes", {}).get(platform)
+        if pinned is None:
+            print(f"skip {name}: no {platform} pin (run --update "
+                  f"{name} to create one)")
+            continue
+        current = program_hash(name, entry.get("workload"))
+        checked += 1
+        if pinned != current:
+            failures.append(f"{name}: pinned {pinned} != current {current}")
+        else:
+            print(f"ok: {name} [{platform}] matches pin "
+                  f"({current[:12]}...)")
+    if failures:
+        print("DRIFT:\n  " + "\n  ".join(failures)
+              + "\nIf intended, re-pin with: python benchmarks/hlo_pin.py "
+              "--update", file=sys.stderr)
         sys.exit(1)
-    print(f"ok: {platform} flagship program matches pin ({current[:12]}...)")
+    if not checked:
+        print(f"no pins for platform '{platform}' in {ARCHIVE.name}; "
+              f"run with --update to create them", file=sys.stderr)
+        sys.exit(2)
 
 
 if __name__ == "__main__":

@@ -280,6 +280,31 @@ def main() -> None:
     measure("ingest_kernel", ingest_probe, scan_factory(ingest_step),
             (state.records, yes0, con0))
 
+    # --- phase: the SAME ingest workload on the SWAR lane-packed engine
+    # (ops/swar.py: 4 tx columns per uint32 word, closed-form confidence
+    # fold).  Identical bits to ingest_kernel (tests/test_swar.py);
+    # comparing the two rows is the on-hardware A/B of the PR 2 engine.
+    import dataclasses as _dc
+
+    swar_cfg = _dc.replace(cfg, ingest_engine="swar32")
+
+    def ingest_swar_step(carry, i=jnp.int32(1)):
+        recs, yes, con = carry
+        y = yes ^ i.astype(jnp.uint8)
+        return (vr.register_packed_votes_engine(recs, y, con, swar_cfg.k,
+                                                swar_cfg)[0], yes, con)
+
+    def ingest_swar_probe(carry):
+        # Bytes-probe twin of ingest_probe: records-only output so
+        # cost_analysis() does not count pass-through plane copies.
+        recs, yes, con = carry
+        y = yes ^ jnp.uint8(1)
+        return vr.register_packed_votes_engine(recs, y, con, swar_cfg.k,
+                                               swar_cfg)[0]
+
+    measure("ingest_swar", ingest_swar_probe, scan_factory(ingest_swar_step),
+            (state.records, yes0, con0))
+
     # --- phase: preference pack + k row-gathers (the vote-exchange
     # collective's single-chip form).
     sink0 = pack_bool_plane(vr.is_accepted(state.records.confidence))

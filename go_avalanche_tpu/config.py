@@ -122,6 +122,36 @@ class AvalancheConfig:
                                       #   Bit-exact either way — pinned by
                                       #   tests/test_exchange.py golden
                                       #   parity across every config axis.
+    ingest_engine: str = "u8"         # RegisterVotes ingest engine
+                                      #   (ops/voterecord.py
+                                      #   register_packed_votes_engine).
+                                      #   "u8": per-vote uint8 window
+                                      #   updates + per-vote confidence
+                                      #   fold — the golden-parity
+                                      #   reference.  "swar32": 4 tx
+                                      #   columns lane-packed per uint32
+                                      #   word (ops/swar.py) with the
+                                      #   closed-form confidence
+                                      #   transition — native i32 VPU
+                                      #   width, zero u8 widening.
+                                      #   Bit-exact either way — pinned
+                                      #   by tests/test_swar.py across
+                                      #   every config axis.
+    fused_sharded_gossip: bool = False
+                                      # sharded gossip-admission scatter
+                                      #   (parallel/sharded.py
+                                      #   _gossip_heard_packed): False =
+                                      #   8 serial per-bit scatter-maxes
+                                      #   on the packed plane; True = ONE
+                                      #   batched scatter over an
+                                      #   [8, N, T/8] per-bit stack (same
+                                      #   ICI traffic — the OR-fold
+                                      #   precedes the all_to_all — at 8x
+                                      #   the scatter scratch).  Opt-in
+                                      #   until a hardware A/B prices the
+                                      #   dispatch-vs-scratch trade
+                                      #   (ROADMAP).  Bit-exact either
+                                      #   way (tests/test_sharding.py).
     strict_validation: bool = False
     stream_retire_cap: Optional[int] = None
                                       # streaming_dag scheduler: cap the
@@ -190,6 +220,10 @@ class AvalancheConfig:
             raise ValueError("cluster_locality must be in [0, 1]")
         if not (0.5 < self.alpha <= 1.0):
             raise ValueError("alpha must be in (0.5, 1.0]")
+        if self.ingest_engine not in ("u8", "swar32"):
+            raise ValueError(
+                f"ingest_engine must be 'u8' or 'swar32', "
+                f"got {self.ingest_engine!r}")
         if self.stream_retire_cap is not None and self.stream_retire_cap < 1:
             raise ValueError("stream_retire_cap must be >= 1 (None "
                              "disables the cap)")

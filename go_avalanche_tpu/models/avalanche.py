@@ -317,9 +317,11 @@ def round_step(
 
     # --- ingest: k fused window updates on polled records only
     # (RegisterVotes, `processor.go:92-117`); finalized records freeze.
+    # `cfg.ingest_engine` selects the u8 reference or the SWAR
+    # lane-packed engine (ops/swar.py) — identical bits either way.
     with annotate("ingest_votes"):
         if cfg.vote_mode is VoteMode.SEQUENTIAL:
-            records, changed = vr.register_packed_votes(
+            records, changed = vr.register_packed_votes_engine(
                 state.records, yes_pack, consider_pack, cfg.k, cfg,
                 update_mask=polled)
             votes_applied = (popcnt_plane(consider_pack) * polled).sum()

@@ -231,7 +231,7 @@ def round_step(
     yes_pack, consider_pack = exchange.gather_vote_packs(
         packed_prefs, peers, responded, lie, k_byz, cfg, minority_t, t)
 
-    records, changed = vr.register_packed_votes(
+    records, changed = vr.register_packed_votes_engine(
         base.records, yes_pack, consider_pack, cfg.k, cfg, update_mask=polled)
 
     fin_after = vr.has_finalized(records.confidence, cfg)

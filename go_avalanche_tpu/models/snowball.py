@@ -113,7 +113,7 @@ def round_step(
             axis=1).astype(jnp.uint8)
         consider_pack = (responded.astype(jnp.uint8) << shifts).sum(
             axis=1).astype(jnp.uint8)
-        records, changed = vr.register_packed_votes(
+        records, changed = vr.register_packed_votes_engine(
             state.records, yes_pack, consider_pack, cfg.k, cfg, update_mask)
     else:
         # Paper-style majority chit: one conclusive vote per round when

@@ -1,0 +1,129 @@
+"""SWAR lane packing: 4 uint8 records per 32-bit word for the VPU.
+
+The r03 Pallas post-mortem (`ops/pallas_vote.py` docstring) and the r05
+roofline (PERF_NOTES.md) agree on the ingest kernel's bottleneck: the
+TPU VPU vectorizes i32 (and i16) arithmetic only, so every uint8 plane
+the window update touches is widened 4x before any work happens.  The
+SIMD-within-a-register answer is to make the widening the LAYOUT: pack
+4 *adjacent tx columns'* uint8 values into one uint32 word, one byte
+lane per column, and run the hot loop's shifts/counts/compares
+lane-parallel on native i32 words — zero widening, a quarter of the
+elements.
+
+Lane layout (little-endian byte order, pinned by
+`tests/test_swar.py::test_pack_lane_order_is_little_endian`):
+
+      u32 word w                      uint8 columns
+      bits [ 0:  8)  = lane 0  <->  column 4*w + 0
+      bits [ 8: 16)  = lane 1  <->  column 4*w + 1
+      bits [16: 24)  = lane 2  <->  column 4*w + 2
+      bits [24: 32)  = lane 3  <->  column 4*w + 3
+
+Pack/unpack are pure `lax.bitcast_convert_type` + reshape — layout
+moves, not arithmetic — so the engine boundary costs nothing the
+surrounding fusion doesn't already pay.  The arithmetic primitives
+below are the classic SWAR idioms, each documented with its lane-safety
+precondition (when a plain 32-bit op is guaranteed not to carry/borrow
+across lane boundaries).
+
+Ragged tails: a trailing axis not divisible by 4 is zero-padded at pack
+time and sliced at unpack time; all-zero lanes are inert through every
+primitive here (shift-in of 0, counters stay 0, compares stay false).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+LANES = 4
+
+# Per-lane replicated constants (one byte value in every lane).
+_LSB = 0x01010101   # bit 0 of every lane
+_MSB = 0x80808080   # bit 7 of every lane
+_NOCARRY = 0xFEFEFEFE  # everything but bit 0: masks the <<1 inter-lane carry
+
+
+def lane_const(byte: int) -> np.uint32:
+    """uint32 scalar with `byte` replicated into all 4 lanes.
+
+    A NUMPY scalar on purpose (as are `LANE_LSB`/`LANE_MSB` below): a
+    module-level or closure-level `jnp` scalar materializes through the
+    trace machinery, so a first import that happens INSIDE a jit trace
+    (e.g. `hlo_pin.py`'s abstract lowering) would leak a tracer into
+    every later caller.  numpy scalars are inert constants everywhere."""
+    if not (0 <= byte <= 0xFF):
+        raise ValueError("lane_const takes one byte")
+    return np.uint32(byte * _LSB)
+
+
+def pack_u8_lanes(x: jax.Array) -> jax.Array:
+    """uint8 ``[..., t]`` -> uint32 ``[..., ceil(t/4)]``, column ``4w + b``
+    in byte lane ``b`` of word ``w`` (layout above).  Zero-pads a ragged
+    tail; a pure bitcast otherwise."""
+    x = jnp.asarray(x, jnp.uint8)
+    *lead, t = x.shape
+    tp = -(-t // LANES) * LANES
+    if tp != t:
+        x = jnp.pad(x, [(0, 0)] * len(lead) + [(0, tp - t)])
+    return lax.bitcast_convert_type(
+        x.reshape(*lead, tp // LANES, LANES), jnp.uint32)
+
+
+def unpack_u8_lanes(w: jax.Array, t: int) -> jax.Array:
+    """Inverse of `pack_u8_lanes`: uint32 ``[..., ceil(t/4)]`` -> uint8
+    ``[..., t]`` (pad columns dropped)."""
+    b = lax.bitcast_convert_type(w, jnp.uint8)       # [..., W, 4]
+    return b.reshape(*w.shape[:-1], -1)[..., :t]
+
+
+def expand_lane_mask(mask_w: jax.Array, t: int) -> jax.Array:
+    """Per-lane mask word (any nonzero byte = hit) -> bool ``[..., t]``."""
+    return unpack_u8_lanes(mask_w, t) != 0
+
+
+def popcount8_lanes(w: jax.Array) -> jax.Array:
+    """Per-BYTE-LANE popcount of a uint32 word array.
+
+    The `bitops.popcount8` SWAR ladder on 4 lanes at once; the masks keep
+    every partial sum inside its lane, so no step can carry across."""
+    w = w - ((w >> 1) & jnp.uint32(0x55555555))
+    w = (w & jnp.uint32(0x33333333)) + ((w >> 2) & jnp.uint32(0x33333333))
+    return (w + (w >> 4)) & jnp.uint32(0x0F0F0F0F)
+
+
+def lane_shl1(w: jax.Array, in_bits: jax.Array) -> jax.Array:
+    """Per-lane ``(lane << 1) | in_bit``: the window shift.
+
+    The 32-bit shift moves every lane's bit 7 into its neighbor's bit 0;
+    masking with 0xFEFEFEFE drops exactly those carried bits.  `in_bits`
+    must only occupy lane bit 0 (an ``& _LSB``-shaped value)."""
+    return ((w << 1) & jnp.uint32(_NOCARRY)) | in_bits
+
+
+def lane_gt(w: jax.Array, threshold: int) -> jax.Array:
+    """Per-lane unsigned ``lane > threshold``, as an 0x80-per-hit-lane
+    mask word.
+
+    Bias-to-MSB compare: lane bit 7 of ``w + (0x7F - threshold)`` is set
+    iff ``lane >= threshold + 1``.  Lane-safe while
+    ``lane + 0x7F - threshold <= 0xFF`` i.e. ``lane <= 0x80 + threshold``
+    — window counters (<= 8) and quorum thresholds (0..7) sit far
+    inside it."""
+    if not (0 <= threshold <= 0x7F):
+        raise ValueError("lane_gt threshold must be in [0, 0x7F]")
+    return (w + lane_const(0x7F - threshold)) & jnp.uint32(_MSB)
+
+
+def lane_fill(bits: jax.Array) -> jax.Array:
+    """Lane-LSB bits (an ``& _LSB``-shaped value) -> 0xFF-filled lanes.
+
+    ``bit * 0xFF`` per lane: each product occupies exactly its own lane
+    (0 or 0xFF), so the 32-bit multiply never carries between them."""
+    return bits * jnp.uint32(0xFF)
+
+
+LANE_LSB = np.uint32(_LSB)   # numpy, not jnp — see lane_const
+LANE_MSB = np.uint32(_MSB)

@@ -44,6 +44,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
+from go_avalanche_tpu.ops import swar
 from go_avalanche_tpu.ops.bitops import popcount8
 
 
@@ -302,6 +303,253 @@ def register_packed_votes(
         votes &= window_mask
         consider &= window_mask
     new_state = VoteRecordState(votes, consider, confidence)
+    if update_mask is not None:
+        update_mask = jnp.asarray(update_mask, jnp.bool_)
+        new_state = VoteRecordState(
+            jnp.where(update_mask, new_state.votes, state.votes),
+            jnp.where(update_mask, new_state.consider, state.consider),
+            jnp.where(update_mask, new_state.confidence, state.confidence),
+        )
+        any_changed = any_changed & update_mask
+    return new_state, any_changed
+
+
+def register_packed_votes_engine(
+    state: VoteRecordState,
+    yes_pack: jax.Array,
+    consider_pack: jax.Array,
+    k: int,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    update_mask: jax.Array | None = None,
+    absent_is_skip: bool | None = None,
+) -> Tuple[VoteRecordState, jax.Array]:
+    """The ingest-engine dispatch every round implementation calls
+    (`models/avalanche`, `models/dag`, `models/snowball`,
+    `parallel/sharded*` — the streaming/backlog schedulers inherit it
+    through those rounds): `cfg.ingest_engine` selects
+
+      "u8"      — `register_packed_votes`, the golden-parity reference
+                  (per-vote uint8 window updates, per-vote confidence
+                  fold);
+      "swar32"  — `register_packed_votes_swar`, the lane-packed engine
+                  (4 tx columns per uint32 word, closed-form confidence
+                  transition).
+
+    Both return identical bits on every config axis — pinned by
+    tests/test_swar.py the way tests/test_exchange.py pins the
+    `cfg.fused_exchange` pair.
+    """
+    engine = (register_packed_votes_swar if cfg.ingest_engine == "swar32"
+              else register_packed_votes)
+    return engine(state, yes_pack, consider_pack, k, cfg, update_mask,
+                  absent_is_skip)
+
+
+def _confidence_closed_form(
+    confidence: jax.Array,
+    outcome16: jax.Array,
+    cfg: AvalancheConfig,
+) -> Tuple[jax.Array, jax.Array]:
+    """The k-vote confidence fold, collapsed to ONE full-width pass.
+
+    `outcome16` is the uint16 combined outcome plane: low byte = the
+    yes pack, high byte = the conclusive pack, bit j of each = vote j's
+    threshold-yes / conclusiveness — exactly the per-vote `yes` /
+    `conclusive` bools of the reference fold (`vote.go:57-75` iterated).
+    One combined plane rather than two u8 planes on purpose: XLA's CPU
+    backend outlines each output root's backward slice into its own
+    parallel fusion WITHOUT multi-output fusion, so a two-plane frontier
+    recomputes the whole SWAR vote loop once per plane (measured +25%
+    ingest wall at 4096²); a single consumer plane keeps one copy.
+    The fold is a run-length process, so it has a closed form:
+
+      * a vote FLIPS iff it is conclusive and disagrees with the current
+        preference; since every conclusive vote sets the preference to
+        its own `yes`, a trajectory flips at all iff some conclusive
+        vote's yes differs from the INITIAL accepted bit a0 — no prefix
+        scan needed;
+      * the final preference is the LAST conclusive vote's yes (a0 if
+        none);
+      * the final counter counts the trailing conclusive votes agreeing
+        with the final preference: with no flip that run extends the
+        incoming counter; with a flip the run's first vote is the flip
+        itself (counter := 0) and the rest add one each;
+      * `changed` is flips OR a finalization crossing; crossings in a
+        post-flip run would need run length >= finalization_score, and
+        whenever a post-flip run exists `changed` is already true via
+        the flip — so only the no-flip crossing
+        ``c0 < score <= c0 + popcount(conclusive)`` is ever decisive.
+
+    Saturation (`counter >= 0x7FFF` stops bumping) is a terminal `min`;
+    the one observable corner — finalization_score == 0x7FFF, where the
+    reference fold re-reports `changed` on every agreeing vote of an
+    already-saturated record — is handled by a statically-gated term.
+    Bit-exactness vs the per-vote fold is pinned by the
+    tests/test_swar.py property matrix (saturated confidences, tiny and
+    maximal finalization scores included).
+    """
+    u16 = jnp.uint16
+    a0 = confidence & 1                       # initial accepted bit, 0/1
+    c0 = confidence >> 1                      # incoming counter
+    concl = outcome16 >> 8
+    yes = (outcome16 & u16(0xFF)) & concl     # only conclusive yes bits count
+    has_concl = concl != 0
+
+    # Flip detection: any conclusive yes != a0.
+    flips = (concl & (yes ^ (a0 * u16(0xFF)))) != 0
+
+    # Final preference: yes at the highest conclusive bit.
+    f = concl | (concl >> 1)
+    f |= f >> 2
+    f |= f >> 4
+    high = f ^ (f >> 1)                       # highest set bit of concl
+    a_fin = jnp.where(has_concl, (yes & high) != 0, a0 != 0)
+
+    # Trailing agree-run length: conclusive bits above the last
+    # disagreement with the final preference.  D == 0 floods to 0, whose
+    # complement is the all-bits mask — the no-disagreement case needs
+    # no special path.
+    disagree = concl & (yes ^ (a_fin.astype(u16) * u16(0xFF)))
+    d = disagree | (disagree >> 1)
+    d |= d >> 2
+    d |= d >> 4
+
+    def pc8(x):  # popcount of a byte value held in uint16 lanes
+        x = x - ((x >> 1) & u16(0x55))
+        x = (x & u16(0x33)) + ((x >> 2) & u16(0x33))
+        return (x + (x >> 4)) & u16(0x0F)
+
+    run = pc8(concl & (jnp.bitwise_not(d) & u16(0xFF)))
+    pc = pc8(concl)
+
+    counter = jnp.where(
+        flips,
+        run - u16(1),                         # run starts at the flip (:= 0)
+        jnp.minimum(c0 + pc, u16(0x7FFF)),    # saturating extension
+    )
+    new_conf = (counter << 1) | a_fin.astype(u16)
+
+    score = u16(cfg.finalization_score)
+    crossed = (c0 < score) & ((c0 + pc) >= score)
+    if cfg.finalization_score == 0x7FFF:
+        # Saturated records re-report finalization on every agreeing
+        # conclusive vote when the score sits AT the saturation ceiling.
+        crossed = crossed | ((c0 == u16(0x7FFF)) & (pc > 0))
+    changed = flips | crossed
+    return new_conf, changed
+
+
+def register_packed_votes_swar(
+    state: VoteRecordState,
+    yes_pack: jax.Array,
+    consider_pack: jax.Array,
+    k: int,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    update_mask: jax.Array | None = None,
+    absent_is_skip: bool | None = None,
+) -> Tuple[VoteRecordState, jax.Array]:
+    """`register_packed_votes` on SWAR lanes: 4 tx columns per uint32.
+
+    Same contract and bit-identical results (tests/test_swar.py); the
+    restructuring is pure layout + algebra:
+
+      * `votes`/`consider`/the vote packs and the incremental
+        `yes_cnt`/`cons_cnt` counters live as 4 byte lanes per uint32
+        word (`ops/swar.py` layout) — the window shift, counter updates
+        and quorum compares run lane-parallel at native i32 width, a
+        quarter of the elements and ZERO u8->i32 widening on the VPU
+        (the exact loss mode of the r03 Pallas kernel);
+      * the per-vote quorum outcomes accumulate into two packed outcome
+        words (bit j of each lane = vote j), merge into ONE u16 combined
+        plane at the engine boundary, and the uint16 confidence plane —
+        which cannot lane-pack: its 15-bit counter outgrows a byte lane,
+        see PERF_NOTES.md PR 2 — is touched ONCE, by the closed-form
+        fold (`_confidence_closed_form`), instead of k times.
+
+    `absent_is_skip` follows `register_packed_votes` exactly; the skip
+    mode gates shift/counter/outcome per lane with fill masks instead of
+    taking a separate code path.
+    """
+    if not (0 < k <= 8):
+        raise ValueError("k must be in (0, 8] for uint8 packing")
+    if absent_is_skip is None:
+        absent_is_skip = cfg.skip_absent_votes
+
+    t = state.votes.shape[-1]
+    votes_w = swar.pack_u8_lanes(state.votes)
+    cons_w = swar.pack_u8_lanes(state.consider)
+    yes_w = swar.pack_u8_lanes(jnp.broadcast_to(jnp.asarray(yes_pack),
+                                                state.votes.shape))
+    pack_w = swar.pack_u8_lanes(jnp.broadcast_to(jnp.asarray(consider_pack),
+                                                 state.votes.shape))
+
+    lsb = swar.LANE_LSB
+    window_lanes = swar.lane_const((1 << cfg.window) - 1)
+    full_window = cfg.window == 8
+    top_bit = cfg.window - 1
+    threshold = cfg.quorum - 1
+
+    yes_cnt = swar.popcount8_lanes(votes_w & cons_w)
+    cons_cnt = swar.popcount8_lanes(cons_w)
+    out_yes = jnp.zeros_like(votes_w)
+    out_concl = jnp.zeros_like(votes_w)
+
+    for j in range(k):  # unrolled: k is a static config constant
+        in_yes_raw = (yes_w >> j) & lsb
+        in_cons = (pack_w >> j) & lsb
+
+        if absent_is_skip:
+            # Absent slots register NOTHING: gate every delta on the
+            # present bit and lane-select the shifted windows.  Present
+            # votes shift a set consider bit (every batched responder
+            # commits), as in `_register_packed_votes_skip`.
+            present = in_cons
+            evict_yes = ((votes_w & cons_w) >> top_bit) & present
+            evict_cons = (cons_w >> top_bit) & present
+            yes_cnt = yes_cnt + (in_yes_raw & present) - evict_yes
+            cons_cnt = cons_cnt + present - evict_cons
+
+            shifted_v = swar.lane_shl1(votes_w, in_yes_raw)
+            shifted_c = swar.lane_shl1(cons_w, present)
+            if not full_window:
+                shifted_v &= window_lanes
+                shifted_c &= window_lanes
+            keep = swar.lane_fill(present)
+            votes_w = (shifted_v & keep) | (votes_w & ~keep)
+            cons_w = (shifted_c & keep) | (cons_w & ~keep)
+
+            yes_m = swar.lane_gt(yes_cnt, threshold)
+            no_m = swar.lane_gt(cons_cnt - yes_cnt, threshold)
+            concl_m = (yes_m | no_m) & (present << 7)
+        else:
+            in_yes = in_yes_raw & in_cons  # counted iff considered
+            evict_yes = ((votes_w & cons_w) >> top_bit) & lsb
+            evict_cons = (cons_w >> top_bit) & lsb
+            yes_cnt = yes_cnt + in_yes - evict_yes
+            cons_cnt = cons_cnt + in_cons - evict_cons
+
+            votes_w = swar.lane_shl1(votes_w, in_yes_raw)
+            cons_w = swar.lane_shl1(cons_w, in_cons)
+            if not full_window:
+                votes_w &= window_lanes
+                cons_w &= window_lanes
+
+            yes_m = swar.lane_gt(yes_cnt, threshold)
+            no_m = swar.lane_gt(cons_cnt - yes_cnt, threshold)
+            concl_m = yes_m | no_m
+
+        # Outcome packs: lane MSB masks land on lane bit j.
+        out_yes |= yes_m >> (7 - j)
+        out_concl |= concl_m >> (7 - j)
+
+    new_votes = swar.unpack_u8_lanes(votes_w, t)
+    new_consider = swar.unpack_u8_lanes(cons_w, t)
+    outcome16 = ((swar.unpack_u8_lanes(out_concl, t).astype(jnp.uint16) << 8)
+                 | swar.unpack_u8_lanes(out_yes, t))
+    confidence, any_changed = _confidence_closed_form(
+        state.confidence, outcome16, cfg)
+
+    new_state = VoteRecordState(new_votes, new_consider, confidence)
     if update_mask is not None:
         update_mask = jnp.asarray(update_mask, jnp.bool_)
         new_state = VoteRecordState(

@@ -169,6 +169,7 @@ def _gossip_heard_packed(
     peers: jax.Array,
     polled: jax.Array,
     n_global: int,
+    fused: bool = False,
 ) -> jax.Array:
     """uint8 ``[n_local, ceil(t_local/8)]`` — this shard's rows' heard bits.
 
@@ -185,6 +186,17 @@ def _gossip_heard_packed(
       * **cross-shard or-reduce**: `psum_scatter` would carry across packed
         bits, so exchange shard contributions with `all_to_all` (same ICI
         volume as reduce-scatter) and OR the n_node_shards blocks locally.
+
+    `fused` (cfg.fused_sharded_gossip) folds the 8 serial per-bit
+    scatter-maxes into ONE batched scatter over an ``[8, N*k, t8]``
+    per-bit update stack: the bit planes carry disjoint bits, so the OR
+    over the bit axis is an exact byte sum.  The ICI leg is unchanged —
+    the fold happens before the `all_to_all`, which still moves the
+    packed ``[n_global, t8]`` plane — but the scatter scratch grows 8x
+    (== one UNPACKED plane), which is why the per-bit loop stays the
+    default until a hardware A/B prices dispatch count against scratch
+    (ROADMAP).  Bit-exact either way
+    (tests/test_sharding.py::test_sharded_gossip_scatter_engines_parity).
     """
     n_local, t_local = polled.shape
     k = peers.shape[1]
@@ -192,11 +204,18 @@ def _gossip_heard_packed(
     polled_packed = pack_bool_plane(polled)             # [n_local, t8]
     t8 = polled_packed.shape[1]
     idx = peers.reshape(-1)                             # [n_local*k]
-    heard = jnp.zeros((n_global, t8), jnp.uint8)
-    for b in range(8):
-        src = polled_packed & jnp.uint8(1 << b)
-        upd = jnp.repeat(src, k, axis=0)                # rows match idx order
-        heard |= jnp.zeros((n_global, t8), jnp.uint8).at[idx].max(upd)
+    if fused:
+        upd = jnp.repeat(polled_packed, k, axis=0)      # rows match idx order
+        bit = jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8)
+        upd8 = upd[None, :, :] & bit[:, None, None]     # [8, N*k, t8]
+        planes = jnp.zeros((8, n_global, t8), jnp.uint8).at[:, idx].max(upd8)
+        heard = planes.sum(axis=0, dtype=jnp.uint8)     # disjoint bits: +==|
+    else:
+        heard = jnp.zeros((n_global, t8), jnp.uint8)
+        for b in range(8):
+            src = polled_packed & jnp.uint8(1 << b)
+            upd = jnp.repeat(src, k, axis=0)            # rows match idx order
+            heard |= jnp.zeros((n_global, t8), jnp.uint8).at[idx].max(upd)
     if n_shards == 1:
         return heard
     parts = lax.all_to_all(heard, NODES_AXIS, split_axis=0, concat_axis=0,
@@ -258,7 +277,8 @@ def _local_round(
     added = state.added
     admissions = jnp.int32(0)
     if cfg.gossip:
-        heard_packed = _gossip_heard_packed(peers, polled, n_global)
+        heard_packed = _gossip_heard_packed(peers, polled, n_global,
+                                            fused=cfg.fused_sharded_gossip)
         heard = unpack_bool_plane(heard_packed, t_local)
         new_adds = (heard & jnp.logical_not(added)
                     & alive_local[:, None] & state.valid[None, :])
@@ -290,7 +310,7 @@ def _local_round(
 
     # --- ingest.
     if cfg.vote_mode is VoteMode.SEQUENTIAL:
-        records, changed = vr.register_packed_votes(
+        records, changed = vr.register_packed_votes_engine(
             state.records, yes_pack, consider_pack, cfg.k, cfg,
             update_mask=polled)
         votes_applied = (popcnt_plane(consider_pack) * polled).sum()

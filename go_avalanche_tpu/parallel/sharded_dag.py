@@ -183,7 +183,7 @@ def _local_round(
         packed_global, peers, responded, lie, k_vote, cfg, minority_t,
         t_local)
 
-    records, changed = vr.register_packed_votes(
+    records, changed = vr.register_packed_votes_engine(
         base.records, yes_pack, consider_pack, cfg.k, cfg, update_mask=polled)
 
     fin_after = vr.has_finalized(records.confidence, cfg)

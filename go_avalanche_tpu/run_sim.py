@@ -59,6 +59,7 @@ def build_config(args: argparse.Namespace) -> AvalancheConfig:
         churn_probability=args.churn,
         skip_absent_votes=args.skip_absent_votes,
         stream_retire_cap=getattr(args, "stream_retire_cap", None),
+        ingest_engine=getattr(args, "ingest_engine", "u8"),
     )
 
 
@@ -113,7 +114,8 @@ def run_avalanche(args, cfg: AvalancheConfig) -> Dict:
         mesh = _parse_mesh(args.mesh)
         state = sharded.shard_state(state, mesh)
         state = sharded.run_sharded(mesh, state, cfg,
-                                    max_rounds=args.max_rounds)
+                                    max_rounds=args.max_rounds,
+                                    donate=args.donate)
     else:
         # av.run jits itself (static cfg/max_rounds); donate frees the
         # double-buffered [N, T] planes — the init state is not reused.
@@ -141,7 +143,8 @@ def run_dag(args, cfg: AvalancheConfig) -> Dict:
         mesh = _parse_mesh(args.mesh)
         state = sharded_dag.shard_dag_state(state, mesh)
         state = sharded_dag.run_sharded_dag(mesh, state, cfg,
-                                            max_rounds=args.max_rounds)
+                                            max_rounds=args.max_rounds,
+                                            donate=args.donate)
     else:
         state = jax.jit(dag.run, static_argnames=("cfg", "max_rounds"))(
             state, cfg, args.max_rounds)
@@ -221,7 +224,8 @@ def run_streaming_dag(args, cfg: AvalancheConfig) -> Dict:
         mesh = _parse_mesh(args.mesh)
         state = ssd.shard_streaming_dag_state(state, mesh)
         final = ssd.run_sharded_streaming_dag(mesh, state, cfg,
-                                              max_rounds=args.max_rounds)
+                                              max_rounds=args.max_rounds,
+                                              donate=args.donate)
     elif args.chunk:
         # Host-chunked dispatch (bit-identical to the single dispatch):
         # long runs survive runtime dispatch watchdogs, and --checkpoint
@@ -267,7 +271,8 @@ def run_backlog(args, cfg: AvalancheConfig) -> Dict:
         mesh = _parse_mesh(args.mesh)
         state = sharded_backlog.shard_backlog_state(state, mesh)
         final = sharded_backlog.run_sharded_backlog(
-            mesh, state, cfg, max_rounds=args.max_rounds)
+            mesh, state, cfg, max_rounds=args.max_rounds,
+            donate=args.donate)
     else:
         final = jax.jit(bl.run, static_argnames=("cfg", "max_rounds"))(
             state, cfg, args.max_rounds)
@@ -353,6 +358,22 @@ def main(argv=None) -> Dict:
                         help="run the sharded backend over an "
                              "(n node shards, t tx shards) device mesh "
                              "(models: avalanche, dag, backlog)")
+    parser.add_argument("--donate", action="store_true",
+                        help="with --mesh: donate the sharded state into "
+                             "the while-loop drivers so the [N, T] planes "
+                             "update in place instead of double-buffering "
+                             "in HBM.  Opt-in until a hardware soak "
+                             "confirms no shard_map aliasing surprises "
+                             "(ROADMAP); the single-chip avalanche path "
+                             "already donates unconditionally")
+    parser.add_argument("--ingest-engine", choices=["u8", "swar32"],
+                        default="u8",
+                        help="RegisterVotes ingest engine "
+                             "(cfg.ingest_engine): 'u8' = per-vote uint8 "
+                             "window updates (reference), 'swar32' = 4 tx "
+                             "columns lane-packed per uint32 word with the "
+                             "closed-form confidence fold (ops/swar.py). "
+                             "Bit-exact either way")
     parser.add_argument("--chunk", type=int, default=0, metavar="ROUNDS",
                         help="streaming_dag: dispatch the run in host-driven "
                              "chunks of this many rounds (0 = one device "
@@ -382,6 +403,9 @@ def main(argv=None) -> Dict:
                                         "streaming_dag"):
         parser.error(f"--mesh supports models avalanche/dag/backlog/"
                      f"streaming_dag, not {args.model}")
+    if args.donate and not args.mesh:
+        parser.error("--donate is a --mesh option (the single-chip "
+                     "avalanche path already donates unconditionally)")
     if args.chunk and args.model != "streaming_dag":
         parser.error("--chunk is a streaming_dag option")
     if args.chunk < 0:

@@ -59,12 +59,16 @@ def test_parse_result_contract():
     assert bench._parse_result("not json\n") is None
 
 
-@pytest.mark.parametrize("exchange", ["fused", "legacy"])
-def test_bench_one_line_json_contract_both_engines(exchange):
-    """End-to-end bench.py smoke on CPU at 128x128 x 2 rounds: BOTH
-    exchange engines must satisfy the contract — exactly one stdout line,
-    it parses as the result dict, value > 0, exit 0.  The fused run also
-    carries the --profile phase breakdown without breaking the line."""
+@pytest.mark.parametrize("exchange,ingest",
+                         [("fused", "u8"), ("legacy", "u8"),
+                          ("fused", "swar32")])
+def test_bench_one_line_json_contract_both_engines(exchange, ingest):
+    """End-to-end bench.py smoke on CPU at 128x128 x 2 rounds: every
+    engine combination must satisfy the contract — exactly one stdout
+    line, it parses as the result dict, value > 0, exit 0 — and the
+    metric tags must name exactly the non-default engines (an A/B must
+    run the program its label claims).  The default run also carries
+    the --profile phase breakdown without breaking the line."""
     import os
     import subprocess
     import sys
@@ -74,8 +78,8 @@ def test_bench_one_line_json_contract_both_engines(exchange):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     argv = [sys.executable, str(repo / "bench.py"), "--nodes", "128",
             "--txs", "128", "--rounds", "2", "--attempts", "1",
-            f"--exchange={exchange}"]
-    if exchange == "fused":
+            f"--exchange={exchange}", f"--ingest={ingest}"]
+    if exchange == "fused" and ingest == "u8":
         argv.append("--profile")
     proc = subprocess.run(argv, capture_output=True, text=True,
                           timeout=560, cwd=str(repo), env=env)
@@ -85,9 +89,9 @@ def test_bench_one_line_json_contract_both_engines(exchange):
     parsed = json.loads(lines[0])
     assert parsed["unit"] == "votes/sec"
     assert parsed["value"] > 0
-    tagged = "legacy-exchange" in parsed["metric"]
-    assert tagged == (exchange == "legacy")
-    if exchange == "fused":
+    assert ("legacy-exchange" in parsed["metric"]) == (exchange == "legacy")
+    assert ("swar32-ingest" in parsed["metric"]) == (ingest == "swar32")
+    if exchange == "fused" and ingest == "u8":
         # --profile attaches the per-phase breakdown (annotate spans of
         # the flagship round: gossip off => no gossip_admission span).
         prof = parsed["profile_ms"]
@@ -96,26 +100,59 @@ def test_bench_one_line_json_contract_both_engines(exchange):
         assert all(v >= 0 for v in prof.values())
 
 
-def test_hlo_pin_flagship_hash_matches_archive():
-    """The flagship bench program's location-stripped StableHLO hash must
-    match the archived pin (benchmarks/hlo_pin.json) — the machine-checked
-    form of the hand-run r03->r05 bench-program comparison.  Abstract
-    lowering (`jax.eval_shape`): the full 16384^2 shape pins in ~1 s with
-    no allocation.  On drift: if the program changed ON PURPOSE, re-pin
-    with `python benchmarks/hlo_pin.py --update` and commit the new hash."""
+def test_hlo_pin_hashes_match_archive():
+    """EVERY pinned program's location-stripped StableHLO hash must match
+    the archive (benchmarks/hlo_pin.json) — the machine-checked form of
+    the hand-run r03->r05 bench-program comparison, extended in PR 2 to
+    the flagship, its swar32-ingest variant, and the streaming step.
+    Abstract lowering (`jax.eval_shape`): the full 16384^2 shape pins in
+    ~1 s with no allocation.  On drift: if a program changed ON PURPOSE,
+    re-pin with `python benchmarks/hlo_pin.py --update` and commit the
+    new hashes."""
     import jax
 
     from benchmarks import hlo_pin
 
-    archive = json.loads(hlo_pin.ARCHIVE.read_text())
-    pinned = archive["hashes"].get(jax.default_backend())
-    if pinned is None:
-        pytest.skip(f"no {jax.default_backend()} pin archived yet")
-    current = hlo_pin.hlo_hash(
-        hlo_pin.flagship_stablehlo(**archive["workload"]))
-    assert current == pinned, (
-        "flagship bench program drifted from benchmarks/hlo_pin.json; "
-        "if intended, re-pin with `python benchmarks/hlo_pin.py --update`")
+    archive = hlo_pin._load_archive()
+    platform = jax.default_backend()
+    checked = 0
+    for name, entry in sorted(archive["programs"].items()):
+        assert name in hlo_pin.PROGRAMS, (
+            f"archived program {name!r} is unknown to hlo_pin.py")
+        pinned = entry["hashes"].get(platform)
+        if pinned is None:
+            continue
+        current = hlo_pin.program_hash(name, entry.get("workload"))
+        assert current == pinned, (
+            f"{name} program drifted from benchmarks/hlo_pin.json; if "
+            f"intended, re-pin with `python benchmarks/hlo_pin.py "
+            f"--update`")
+        checked += 1
+    if not checked:
+        pytest.skip(f"no {platform} pins archived yet")
+
+
+def test_hlo_pin_list_and_check_cli(tmp_path):
+    """`--list` names every archived program without touching jax, and
+    the check mode exits 0 against the committed archive (the CLI twin
+    of test_hlo_pin_hashes_match_archive's in-process loop)."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    env = dict(__import__("os").environ, JAX_PLATFORMS="cpu")
+    listing = subprocess.run(
+        [sys.executable, str(repo / "benchmarks" / "hlo_pin.py"), "--list"],
+        capture_output=True, text=True, timeout=120, cwd=str(repo), env=env)
+    assert listing.returncode == 0, listing.stderr[-2000:]
+    for name in ("flagship", "flagship_swar32", "streaming_step"):
+        assert name in listing.stdout
+    check = subprocess.run(
+        [sys.executable, str(repo / "benchmarks" / "hlo_pin.py")],
+        capture_output=True, text=True, timeout=300, cwd=str(repo), env=env)
+    assert check.returncode == 0, (check.stdout + check.stderr)[-2000:]
+    assert check.stdout.count("ok:") >= 3, check.stdout
 
 
 def test_hlo_pin_strip_locations_is_edit_invariant():
@@ -151,8 +188,8 @@ def test_roofline_quick_emits_parseable_rows(tmp_path):
     rows = [json.loads(l) for l in out.read_text().splitlines()]
     phases = {r["phase"] for r in rows}
     assert {"dispatch_floor", "round_step_full", "ingest_kernel",
-            "pref_gathers", "exchange_fused", "peer_sampling",
-            "streaming_step"} <= phases
+            "ingest_swar", "pref_gathers", "exchange_fused",
+            "peer_sampling", "streaming_step"} <= phases
     for r in rows:
         assert r["bytes_mb_per_round"] >= 0
         assert r["scan_length"] >= 1

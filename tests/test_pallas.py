@@ -14,6 +14,7 @@ from go_avalanche_tpu.ops import voterecord as vr
 from go_avalanche_tpu.ops.pallas_vote import (
     register_packed_votes_fused,
     register_packed_votes_pallas,
+    register_packed_votes_pallas_swar,
 )
 
 
@@ -84,3 +85,57 @@ def test_pallas_rejects_untileable_shape():
         register_packed_votes_pallas(
             state, jnp.zeros((65, 512), jnp.uint8),
             jnp.zeros((65, 512), jnp.uint8), 8)
+
+
+@pytest.mark.parametrize("seed", range(2))
+@pytest.mark.parametrize("k", [1, 5, 8])
+def test_pallas_swar_matches_jnp_path(seed, k):
+    """The SWAR-input kernel (pre-packed u32 planes, per-lane closed-form
+    confidence fold) == the u8 reference engine, bit for bit, in
+    interpreter mode."""
+    state, yes, cons, mask = random_case(seed + 10)
+    ref_s, ref_ch = vr.register_packed_votes(state, yes, cons, k,
+                                             update_mask=mask)
+    pal_s, pal_ch = register_packed_votes_pallas_swar(state, yes, cons, k,
+                                                      update_mask=mask)
+    for a, b in zip(list(ref_s) + [ref_ch], list(pal_s) + [pal_ch]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pallas_swar_custom_config():
+    cfg = AvalancheConfig(window=6, quorum=4, finalization_score=16)
+    state, yes, cons, mask = random_case(19)
+    ref_s, ref_ch = vr.register_packed_votes(state, yes, cons, 8, cfg, mask)
+    pal_s, pal_ch = register_packed_votes_pallas_swar(state, yes, cons, 8,
+                                                      cfg, mask)
+    np.testing.assert_array_equal(np.asarray(ref_s.confidence),
+                                  np.asarray(pal_s.confidence))
+    np.testing.assert_array_equal(np.asarray(ref_ch), np.asarray(pal_ch))
+
+
+def test_fused_dispatch_swar_engine():
+    """`register_packed_votes_fused` routes the swar32 engine to the
+    SWAR kernel under prefer_pallas, and falls back to the jnp engine
+    dispatch for untileable shapes — same bits everywhere."""
+    state, yes, cons, mask = random_case(2)
+    cfg = AvalancheConfig(ingest_engine="swar32")
+    a_s, _ = register_packed_votes_fused(state, yes, cons, 8, cfg,
+                                         update_mask=mask)
+    b_s, _ = register_packed_votes_fused(state, yes, cons, 8, cfg,
+                                         update_mask=mask,
+                                         prefer_pallas=True)
+    np.testing.assert_array_equal(np.asarray(a_s.confidence),
+                                  np.asarray(b_s.confidence))
+    small = vr.init_state(jnp.zeros((3, 6), jnp.bool_))
+    s, _ = register_packed_votes_fused(
+        small, jnp.zeros((3, 6), jnp.uint8), jnp.zeros((3, 6), jnp.uint8),
+        8, cfg, prefer_pallas=True)
+    assert s.votes.shape == (3, 6)
+
+
+def test_pallas_swar_rejects_bad_shapes():
+    state = vr.init_state(jnp.zeros((64, 510), jnp.bool_))
+    with pytest.raises(ValueError, match="divide by 4"):
+        register_packed_votes_pallas_swar(
+            state, jnp.zeros((64, 510), jnp.uint8),
+            jnp.zeros((64, 510), jnp.uint8), 8)

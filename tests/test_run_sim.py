@@ -202,3 +202,31 @@ def test_cli_clustered_topology(capsys):
                    "--finalization-score", "16", "--clusters", "4",
                    "--cluster-locality", "0.9", "--json"])
     assert result["finalized_fraction"] == 1.0
+
+
+def test_cli_ingest_engine_swar32_matches_u8(capsys):
+    """`--ingest-engine swar32` threads cfg.ingest_engine through
+    build_config; the run must be bit-identical to the default engine
+    (same rounds, same finality stats)."""
+    args = ["--model", "avalanche", "--nodes", "32", "--txs", "8",
+            "--finalization-score", "16", "--json"]
+    u8 = main(args)
+    sw = main(args + ["--ingest-engine", "swar32"])
+    assert sw == {**u8, "elapsed_s": sw["elapsed_s"]}
+
+
+def test_cli_donate_requires_mesh():
+    """--donate without --mesh is a usage error (the single-chip path
+    already donates unconditionally)."""
+    with pytest.raises(SystemExit):
+        main(["--model", "avalanche", "--nodes", "16", "--txs", "8",
+              "--donate"])
+
+
+def test_cli_sharded_donate(capsys):
+    """--mesh with --donate drives the donated sharded while-loop path
+    end-to-end on the virtual mesh and still fully finalizes."""
+    result = main(["--model", "avalanche", "--nodes", "16", "--txs", "8",
+                   "--finalization-score", "16", "--mesh", "4,2",
+                   "--donate", "--json"])
+    assert result["finalized_fraction"] == 1.0

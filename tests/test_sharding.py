@@ -163,6 +163,58 @@ def test_gossip_heard_packed_matches_unpacked_oracle(mesh):
     np.testing.assert_array_equal(np.asarray(out), expected)
 
 
+def test_sharded_gossip_scatter_engines_parity(mesh):
+    """The fused single-batched-scatter gossip admission
+    (`cfg.fused_sharded_gossip`, the [8, N*k, t8] per-bit stack) must
+    equal the legacy 8-pass per-bit scatter bit-for-bit, duplicate draws
+    included."""
+    from jax.sharding import PartitionSpec as P
+
+    n, t, k = 32, 24, 8
+    rng = np.random.default_rng(5)
+    # Few distinct peers => many duplicate scatter targets.
+    peers = jnp.asarray(rng.integers(0, 5, (n, k)), jnp.int32)
+    polled = jnp.asarray(rng.random((n, t)) < 0.5)
+
+    def local(peers_blk, polled_blk, fused):
+        return sharded._gossip_heard_packed(peers_blk, polled_blk, n,
+                                            fused=fused)
+
+    outs = []
+    for fused in (False, True):
+        fn = shard_map(lambda p, q, f=fused: local(p, q, f), mesh=mesh,
+                       in_specs=(P("nodes", None), P("nodes", "txs")),
+                       out_specs=P("nodes", "txs"), check_vma=False)
+        outs.append(np.asarray(jax.jit(fn)(peers, polled)))
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+
+def test_sharded_round_fused_gossip_trajectory_parity():
+    """Whole sharded rounds under cfg.fused_sharded_gossip=True match the
+    legacy scatter rounds on every leaf."""
+    mesh = make_mesh(n_node_shards=4, n_tx_shards=2)
+    cfg_legacy = AvalancheConfig()
+    import dataclasses
+
+    cfg_fused = dataclasses.replace(cfg_legacy, fused_sharded_gossip=True)
+    # Sparse initial adds so gossip admission has work to do.
+    added = jnp.zeros((16, 8), jnp.bool_).at[:, :2].set(True)
+    make = lambda cfg: sharded.shard_state(
+        av.init(jax.random.key(2), 16, 8, cfg, added=added), mesh)
+    step_l = sharded.make_sharded_round_step(mesh, cfg_legacy)
+    step_f = sharded.make_sharded_round_step(mesh, cfg_fused)
+    sl, sf = make(cfg_legacy), make(cfg_fused)
+    for _ in range(4):
+        sl, tel_l = step_l(sl)
+        sf, tel_f = step_f(sf)
+        for a, b in zip(jax.tree_util.tree_leaves((sl, tel_l)),
+                        jax.tree_util.tree_leaves((sf, tel_f))):
+            if jax.dtypes.issubdtype(getattr(a, "dtype", None),
+                                     jax.dtypes.prng_key):
+                a, b = jax.random.key_data(a), jax.random.key_data(b)
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_sharded_track_finality_off():
     """A state built with track_finality=False (no finalized_at plane)
     shards, steps, and converges on the mesh; consensus leaves match the

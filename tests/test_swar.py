@@ -1,0 +1,328 @@
+"""Golden bit-parity of the SWAR lane-packed ingest engine.
+
+The swar32 engine (`ops/swar.py` + `voterecord.register_packed_votes_swar`)
+must produce EXACTLY the bits of the u8 reference engine — and both must
+match the `register_votes_sequence` scan oracle — on every config axis;
+that equivalence is what makes `cfg.ingest_engine` a pure performance
+knob.  Mirrors `tests/test_exchange.py`'s three layers:
+
+  * unit pins of the `ops/swar.py` lane primitives (the little-endian
+    lane order is load-bearing: `lax.bitcast_convert_type` defines it,
+    and the closed-form confidence fold assumes the outcome-bit layout);
+  * randomized property parity of the engines against each other and
+    against the scan oracle over random shapes / k / window / quorum /
+    masks / saturated confidences / extreme finalization scores;
+  * whole-trajectory parity of the avalanche, DAG, and snowball rounds
+    (every state leaf, bit-for-bit) across the full config-axis matrix,
+    plus sharded-vs-sharded parity on the virtual mesh.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_avalanche_tpu.config import (
+    AdversaryStrategy,
+    AvalancheConfig,
+    VoteMode,
+)
+from go_avalanche_tpu.models import avalanche as av
+from go_avalanche_tpu.models import dag as dag_model
+from go_avalanche_tpu.models import snowball as sb
+from go_avalanche_tpu.ops import swar
+from go_avalanche_tpu.ops import voterecord as vr
+
+
+def _assert_trees_equal(a, b) -> None:
+    """Bit-exact leaf compare (PRNG keys via their raw key data)."""
+    paths_a = jax.tree_util.tree_flatten_with_path(a)[0]
+    paths_b = jax.tree_util.tree_flatten_with_path(b)[0]
+    assert len(paths_a) == len(paths_b)
+    for (pa, la), (_, lb) in zip(paths_a, paths_b):
+        if jax.dtypes.issubdtype(getattr(la, "dtype", np.dtype("O")),
+                                 jax.dtypes.prng_key):
+            la, lb = jax.random.key_data(la), jax.random.key_data(lb)
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb),
+                                      err_msg=jax.tree_util.keystr(pa))
+
+
+# ---------------------------------------------------------------------------
+# ops/swar.py primitives
+# ---------------------------------------------------------------------------
+
+def test_pack_lane_order_is_little_endian():
+    """Column 4w + b must land in byte lane b (bits [8b, 8b+8)) of word w
+    — the layout every primitive and the Pallas kernel assume."""
+    w = swar.pack_u8_lanes(jnp.array([1, 2, 3, 4, 5, 6, 7, 8], jnp.uint8))
+    np.testing.assert_array_equal(np.asarray(w),
+                                  np.array([0x04030201, 0x08070605],
+                                           np.uint32))
+
+
+@pytest.mark.parametrize("t", [1, 3, 4, 7, 8, 13])
+def test_pack_unpack_roundtrip_ragged(t):
+    rng = np.random.default_rng(t)
+    x = jnp.asarray(rng.integers(0, 256, (5, t), dtype=np.uint8))
+    w = swar.pack_u8_lanes(x)
+    assert w.shape == (5, -(-t // 4))
+    np.testing.assert_array_equal(np.asarray(swar.unpack_u8_lanes(w, t)),
+                                  np.asarray(x))
+
+
+def test_popcount8_lanes_matches_per_byte_popcount():
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 1 << 32, 256, dtype=np.uint64).astype(np.uint32)
+    got = np.asarray(swar.popcount8_lanes(jnp.asarray(x)))
+    lanes = x.view(np.uint8).reshape(-1, 4)
+    want = np.unpackbits(lanes, axis=-1).reshape(len(x), 4, 8).sum(
+        axis=-1).astype(np.uint8)
+    np.testing.assert_array_equal(got.view(np.uint8).reshape(-1, 4), want)
+
+
+@pytest.mark.parametrize("threshold", [0, 3, 6, 7])
+def test_lane_gt_per_lane_unsigned_compare(threshold):
+    # Lane values in the counters' range [0, 8].
+    rng = np.random.default_rng(threshold)
+    lanes = rng.integers(0, 9, (64, 4), dtype=np.uint8)
+    w = jnp.asarray(lanes.view(np.uint32).reshape(-1))
+    got = np.asarray(swar.lane_gt(w, threshold)).view(np.uint8).reshape(-1, 4)
+    np.testing.assert_array_equal(got, np.where(lanes > threshold, 0x80, 0))
+
+
+def test_lane_fill_and_shl1():
+    bits = jnp.asarray(np.array([0x00010001], np.uint32))
+    np.testing.assert_array_equal(np.asarray(swar.lane_fill(bits)),
+                                  np.array([0x00FF00FF], np.uint32))
+    # lane MSBs must NOT carry into the neighbor lane on the shift.
+    w = jnp.asarray(np.array([0x80808080], np.uint32))
+    np.testing.assert_array_equal(
+        np.asarray(swar.lane_shl1(w, bits)),
+        np.array([0x00010001], np.uint32))
+
+
+# ---------------------------------------------------------------------------
+# engine parity: property-based vs the u8 engine and the scan oracle
+# ---------------------------------------------------------------------------
+
+def _random_case(rng, ndim=2):
+    shape = (tuple(int(x) for x in rng.integers(1, 28, ndim))
+             if ndim == 2 else (int(rng.integers(1, 40)),))
+    window = int(rng.integers(1, 9))
+    cfg = AvalancheConfig(
+        window=window,
+        quorum=int(rng.integers(1, window + 1)),
+        finalization_score=int(rng.choice([1, 2, 16, 128, 0x7FFE, 0x7FFF])),
+        k=int(rng.integers(1, 9)),
+    )
+    conf = rng.integers(0, 1 << 16, shape).astype(np.uint16)
+    # Force a slice of records to the saturation boundary: the closed
+    # form's `min` clamp and the F == 0x7FFF corner must stay exercised.
+    conf[rng.random(shape) < 0.2] = (np.uint16(0xFFFC)
+                                     + rng.integers(0, 4)).astype(np.uint16)
+    state = vr.VoteRecordState(
+        votes=jnp.asarray(rng.integers(0, 1 << window, shape)
+                          .astype(np.uint8)),
+        consider=jnp.asarray(rng.integers(0, 1 << window, shape)
+                             .astype(np.uint8)),
+        confidence=jnp.asarray(conf),
+    )
+    yes = rng.integers(0, 256, shape).astype(np.uint8)
+    cons = rng.integers(0, 256, shape).astype(np.uint8)
+    mask = (jnp.asarray(rng.random(shape) < 0.8)
+            if rng.integers(0, 2) else None)
+    return state, yes, cons, mask, cfg
+
+
+@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize("skip", [False, True])
+def test_swar_matches_u8_randomized(seed, skip):
+    """Property parity: the swar32 engine == the u8 engine, leaf for leaf,
+    on random shapes (2-D and 1-D, ragged txs), k, window/quorum, masks,
+    saturated confidences, and extreme finalization scores — both
+    consider-bit semantics."""
+    rng = np.random.default_rng(100 * seed + skip)
+    state, yes, cons, mask, cfg = _random_case(rng, ndim=2 - (seed % 2))
+    a_s, a_ch = vr.register_packed_votes(
+        state, jnp.asarray(yes), jnp.asarray(cons), cfg.k, cfg, mask,
+        absent_is_skip=skip)
+    b_s, b_ch = vr.register_packed_votes_swar(
+        state, jnp.asarray(yes), jnp.asarray(cons), cfg.k, cfg, mask,
+        absent_is_skip=skip)
+    _assert_trees_equal((a_s, a_ch), (b_s, b_ch))
+
+
+@pytest.mark.parametrize("engine", ["u8", "swar32"])
+def test_engines_match_sequence_oracle(engine):
+    """Both packed engines replay the `register_votes_sequence` scan
+    oracle bit-for-bit (the packed-bit errs derivation of
+    test_voterecord_golden.py), changed flags OR-reduced."""
+    rng = np.random.default_rng(7)
+    batch, rounds, k = 23, 25, 8
+    cfg = AvalancheConfig(k=k, ingest_engine=engine)
+    errs = rng.choice(np.array([0, 0, 1, -1], np.int32),
+                      size=(rounds, k, batch))
+    seq_state = vr.init_state(jnp.zeros((batch,), jnp.bool_))
+    pack_state = vr.init_state(jnp.zeros((batch,), jnp.bool_))
+    for r in range(rounds):
+        any_changed_seq = jnp.zeros((batch,), jnp.bool_)
+        for j in range(k):
+            seq_state, ch = vr.register_vote(seq_state,
+                                             jnp.asarray(errs[r, j]))
+            any_changed_seq |= ch
+        yes_pack = np.zeros((batch,), np.uint8)
+        consider_pack = np.zeros((batch,), np.uint8)
+        for j in range(k):
+            yes_pack |= ((errs[r, j] == 0).astype(np.uint8) << j)
+            consider_pack |= ((errs[r, j] >= 0).astype(np.uint8) << j)
+        pack_state, ch_pack = vr.register_packed_votes_engine(
+            pack_state, jnp.asarray(yes_pack), jnp.asarray(consider_pack),
+            k, cfg)
+        np.testing.assert_array_equal(np.asarray(any_changed_seq),
+                                      np.asarray(ch_pack))
+    _assert_trees_equal(seq_state, pack_state)
+
+
+def test_closed_form_finalization_crossing_corners():
+    """The exact `== finalization_score` crossing (`vote.go:68`), the
+    saturation clamp, and the F == 0x7FFF 're-report every agreeing
+    vote' corner — the three spots where the closed form could diverge
+    from the per-vote fold."""
+    full = jnp.uint8(0xFF)
+    for score, counter0, votes_yes, want_changed in [
+        (16, 15, True, True),     # crosses exactly
+        (16, 16, True, False),    # already past: bumps straight over
+        (16, 4, True, False),     # not reached
+        (0x7FFF, 0x7FFF, True, True),   # saturated at F: re-reports
+        (0x7FFF, 0x7FFF, False, True),  # flip still reports
+    ]:
+        cfg = AvalancheConfig(finalization_score=score, k=1)
+        conf = jnp.asarray([np.uint16((counter0 << 1) | 1)])
+        state = vr.VoteRecordState(votes=jnp.asarray([full]),
+                                   consider=jnp.asarray([full]),
+                                   confidence=conf)
+        yes = jnp.asarray([np.uint8(0xFF if votes_yes else 0x00)])
+        for engine in ("u8", "swar32"):
+            ecfg = dataclasses.replace(cfg, ingest_engine=engine)
+            new_state, changed = vr.register_packed_votes_engine(
+                state, yes, jnp.asarray([full]), 1, ecfg)
+            assert bool(changed[0]) == want_changed, (engine, score,
+                                                      counter0, votes_yes)
+        a, ch_a = vr.register_packed_votes(state, yes, jnp.asarray([full]),
+                                           1, cfg)
+        b, ch_b = vr.register_packed_votes_swar(state, yes,
+                                                jnp.asarray([full]), 1, cfg)
+        _assert_trees_equal((a, ch_a), (b, ch_b))
+
+
+def test_engine_dispatch_and_validation():
+    """`register_packed_votes_engine` dispatches on `cfg.ingest_engine`;
+    the config rejects unknown engines statically."""
+    state = vr.init_state(jnp.zeros((4,), jnp.bool_))
+    yes = jnp.uint8(0xFF)
+    cons = jnp.uint8(0xFF)
+    out_u8 = vr.register_packed_votes_engine(
+        state, yes, cons, 8, AvalancheConfig(ingest_engine="u8"))
+    out_sw = vr.register_packed_votes_engine(
+        state, yes, cons, 8, AvalancheConfig(ingest_engine="swar32"))
+    _assert_trees_equal(out_u8, out_sw)
+    with pytest.raises(ValueError, match="ingest_engine"):
+        AvalancheConfig(ingest_engine="u4")
+    with pytest.raises(ValueError, match="k must be"):
+        vr.register_packed_votes_swar(state, yes, cons, 9)
+
+
+# ---------------------------------------------------------------------------
+# whole-trajectory parity across the config-axis matrix
+# ---------------------------------------------------------------------------
+
+# The same axes the fused-exchange tentpole pinned (tests/test_exchange.py),
+# plus the sub-window / custom-quorum axis the ingest engines care about.
+PARITY_AXES = {
+    "gossip-on": dict(),
+    "gossip-off": dict(gossip=False),
+    "drop": dict(drop_probability=0.3),
+    "byz-flip": dict(byzantine_fraction=0.25,
+                     adversary_strategy=AdversaryStrategy.FLIP),
+    "byz-equivocate": dict(byzantine_fraction=0.25,
+                           adversary_strategy=AdversaryStrategy.EQUIVOCATE),
+    "byz-oppose": dict(byzantine_fraction=0.25,
+                       adversary_strategy=AdversaryStrategy.OPPOSE_MAJORITY),
+    "weighted": dict(weighted_sampling=True),
+    "vote-majority": dict(vote_mode=VoteMode.MAJORITY),
+    "poll-capped": dict(max_element_poll=4),
+    "churn-skip-absent": dict(churn_probability=0.1, drop_probability=0.1,
+                              skip_absent_votes=True),
+    "small-window": dict(window=5, quorum=4, finalization_score=8),
+}
+
+
+@pytest.mark.parametrize("axis", sorted(PARITY_AXES))
+def test_avalanche_trajectory_parity(axis):
+    """u8 and swar32 ingest engines produce bit-identical
+    `models/avalanche.round_step` trajectories — every state leaf and
+    telemetry field — on each config axis."""
+    cfg_u8 = AvalancheConfig(ingest_engine="u8", **PARITY_AXES[axis])
+    cfg_sw = dataclasses.replace(cfg_u8, ingest_engine="swar32")
+    n, t = 32, 10  # ragged txs: the lane-pad path stays under test
+    su = av.init(jax.random.key(21), n, t, cfg_u8)
+    ss = av.init(jax.random.key(21), n, t, cfg_sw)
+    step = jax.jit(av.round_step, static_argnames="cfg")
+    for _ in range(6):
+        su, tel_u = step(su, cfg_u8)
+        ss, tel_s = step(ss, cfg_sw)
+        _assert_trees_equal(su, ss)
+        _assert_trees_equal(tel_u, tel_s)
+
+
+@pytest.mark.parametrize("axis", ["gossip-on", "byz-equivocate",
+                                  "small-window"])
+def test_dag_trajectory_parity(axis):
+    cfg_u8 = AvalancheConfig(ingest_engine="u8", **PARITY_AXES[axis])
+    cfg_sw = dataclasses.replace(cfg_u8, ingest_engine="swar32")
+    conflict_set = jnp.repeat(jnp.arange(5, dtype=jnp.int32), 2)
+    su = dag_model.init(jax.random.key(3), 24, conflict_set, cfg_u8)
+    ss = dag_model.init(jax.random.key(3), 24, conflict_set, cfg_sw)
+    step = jax.jit(dag_model.round_step, static_argnames="cfg")
+    for _ in range(5):
+        su, _ = step(su, cfg_u8)
+        ss, _ = step(ss, cfg_sw)
+        _assert_trees_equal(su, ss)
+
+
+def test_snowball_trajectory_parity():
+    """The 1-D single-decree model rides the same dispatch: the swar
+    engine must handle [N] states (lane packing along nodes)."""
+    cfg_u8 = AvalancheConfig(ingest_engine="u8", byzantine_fraction=0.2)
+    cfg_sw = dataclasses.replace(cfg_u8, ingest_engine="swar32")
+    su = sb.init(jax.random.key(9), 33, cfg_u8, yes_fraction=0.5)
+    ss = sb.init(jax.random.key(9), 33, cfg_sw, yes_fraction=0.5)
+    step = jax.jit(sb.round_step, static_argnames="cfg")
+    for _ in range(8):
+        su, tel_u = step(su, cfg_u8)
+        ss, tel_s = step(ss, cfg_sw)
+        _assert_trees_equal(su, ss)
+        _assert_trees_equal(tel_u, tel_s)
+
+
+def test_sharded_trajectory_parity():
+    """The sharded round consumes the same dispatch: swar32 == u8 on the
+    virtual mesh, every leaf (same driver both sides, so none of the
+    documented sharded-vs-unsharded skip leaves apply)."""
+    from go_avalanche_tpu.parallel import sharded
+    from go_avalanche_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(n_node_shards=4, n_tx_shards=2)
+    cfg_u8 = AvalancheConfig(ingest_engine="u8")
+    cfg_sw = dataclasses.replace(cfg_u8, ingest_engine="swar32")
+    su = sharded.shard_state(av.init(jax.random.key(4), 16, 8, cfg_u8), mesh)
+    ss = sharded.shard_state(av.init(jax.random.key(4), 16, 8, cfg_sw), mesh)
+    step_u = sharded.make_sharded_round_step(mesh, cfg_u8)
+    step_s = sharded.make_sharded_round_step(mesh, cfg_sw)
+    for _ in range(4):
+        su, tel_u = step_u(su)
+        ss, tel_s = step_s(ss)
+        _assert_trees_equal(su, ss)
+        _assert_trees_equal(tel_u, tel_s)
