@@ -85,7 +85,8 @@ def flagship_program(cfg, n_rounds: int):
 
 def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
           repeats: int = 3, exchange: str = "fused",
-          ingest: str = "u8", profile: bool = False) -> dict:
+          ingest: str = "u8", latency: int = 0,
+          profile: bool = False) -> dict:
     import dataclasses
 
     import jax
@@ -98,7 +99,7 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
     # max_element_poll >= n_txs so the poll cap never freezes records the
     # vote count below assumes are live.  Shared builder: roofline.py
     # measures phase bandwidth on this exact construction.
-    state, cfg = flagship_state(n_nodes, n_txs, k)
+    state, cfg = flagship_state(n_nodes, n_txs, k, latency)
     if exchange != "fused":
         cfg = dataclasses.replace(cfg, fused_exchange=False)
     if ingest != "u8":
@@ -132,6 +133,7 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
     # never masquerades as a regression/win against default rounds.
     engine_tag = "" if exchange == "fused" else ", legacy-exchange"
     engine_tag += "" if ingest == "u8" else f", {ingest}-ingest"
+    engine_tag += "" if latency == 0 else f", latency{latency}"
     result = {
         "metric": f"sustained vote ingest ({n_nodes} nodes x {n_txs} txs, "
                   f"k={k}, {n_rounds} rounds, "
@@ -172,7 +174,7 @@ def _worker_main(args: argparse.Namespace) -> None:
         jax.config.update("jax_platforms", "cpu")
     result = bench(args.nodes, args.txs, args.rounds, args.k,
                    exchange=args.exchange, ingest=args.ingest,
-                   profile=args.profile)
+                   latency=args.latency, profile=args.profile)
     if args.nonce:
         # Echoed back so the parent can verify this line belongs to THIS
         # run (the salvage path must never credit a stale line).
@@ -301,6 +303,16 @@ def main() -> None:
                              "lane-packed engine (ops/swar.py; tags the "
                              "metric so same-metric deltas never cross "
                              "engines)")
+    parser.add_argument("--latency", type=int, default=0,
+                        help="A/B lane for the async query engine "
+                             "(ops/inflight.py): fixed per-draw response "
+                             "latency in ROUNDS through the in-flight "
+                             "ring (0 = the synchronous flagship "
+                             "program; tags the metric so same-metric "
+                             "deltas never cross engines).  The timeout "
+                             "sits at 2*latency+2 rounds, so the timed "
+                             "window is pure delayed delivery — no "
+                             "expiry traffic")
     parser.add_argument("--profile", action="store_true",
                         help="attach per-phase wall times (one eager round "
                              "under tracing.collect_phase_times) as a "
@@ -324,7 +336,8 @@ def main() -> None:
         _worker_main(args)
         return
 
-    flags = [f"--exchange={args.exchange}", f"--ingest={args.ingest}"] \
+    flags = [f"--exchange={args.exchange}", f"--ingest={args.ingest}",
+             f"--latency={args.latency}"] \
         + (["--profile"] if args.profile else [])
     size = [f"--nodes={args.nodes}", f"--txs={args.txs}",
             f"--rounds={args.rounds}", f"--k={args.k}", *flags]

@@ -321,9 +321,15 @@ def _render_hardware_evidence() -> list:
             return None
 
     bench = REPO / "benchmarks"
+    # fullmatch-filter the glob: stems like `bench_tpu_recovery` match the
+    # glob but carry no round number, and an unattended refresh must skip
+    # such artifacts instead of crashing on `.group(1)` of a None
+    # (ADVICE.md round 5).
     bench_files = sorted(
-        bench.glob("bench_tpu_r*.json"),
-        key=lambda p: int(re.search(r"r(\d+)", p.stem).group(1)))
+        (p for p in bench.glob("bench_tpu_r*.json")
+         if re.fullmatch(r"bench_tpu_r(\d+)", p.stem)),
+        key=lambda p: int(re.fullmatch(r"bench_tpu_r(\d+)",
+                                       p.stem).group(1)))
     candidates = []
     if bench_files:
         candidates.append((bench_files[-1].name, lambda b:

@@ -17,6 +17,11 @@ entry):
                      "swar32"` (the SWAR lane-packed ingest engine), so
                      an A/B measurement always runs the program its
                      label claims;
+  flagship_async   — the same program through the in-flight query
+                     engine (`bench.py --latency 2`: fixed 2-round
+                     response latency, `ops/inflight.py` ring +
+                     delivery walk) — the `--latency` A/B lane's
+                     program (PR 3);
   streaming_step   — one `models/streaming_dag.step` at the roofline's
                      streaming shape (the north-star scheduler's inner
                      program).
@@ -59,7 +64,8 @@ STREAMING = dict(nodes=4096, backlog_sets=20000, set_cap=2,
 
 def flagship_stablehlo(nodes: int, txs: int, rounds: int, k: int,
                        exchange: str = "fused",
-                       ingest: str = "u8") -> str:
+                       ingest: str = "u8",
+                       latency: int = 0) -> str:
     """StableHLO text of the flagship bench program at the given shape.
 
     Abstract lowering: `jax.eval_shape` turns the state builder into
@@ -73,12 +79,13 @@ def flagship_stablehlo(nodes: int, txs: int, rounds: int, k: int,
     import bench
     from benchmarks.workload import flagship_config, flagship_state
 
-    cfg = flagship_config(txs, k)
+    cfg = flagship_config(txs, k, latency)
     if exchange != "fused":
         cfg = dataclasses.replace(cfg, fused_exchange=False)
     if ingest != "u8":
         cfg = dataclasses.replace(cfg, ingest_engine=ingest)
-    state_abs = jax.eval_shape(lambda: flagship_state(nodes, txs, k)[0])
+    state_abs = jax.eval_shape(
+        lambda: flagship_state(nodes, txs, k, latency)[0])
     return bench.flagship_program(cfg, rounds).lower(state_abs).as_text()
 
 
@@ -107,6 +114,8 @@ PROGRAMS = {
                  lambda w: flagship_stablehlo(**w)),
     "flagship_swar32": (dict(FLAGSHIP, ingest="swar32"),
                         lambda w: flagship_stablehlo(**w)),
+    "flagship_async": (dict(FLAGSHIP, latency=2),
+                       lambda w: flagship_stablehlo(**w)),
     "streaming_step": (dict(STREAMING),
                        lambda w: streaming_step_stablehlo(**w)),
 }

@@ -26,19 +26,30 @@ QUICK = dict(nodes=64, backlog_sets=1024, set_cap=2, window_sets=32)
 _SCORE_SEED, _SIM_SEED, _SCORE_MAX = 1, 0, 1 << 20
 
 
-def flagship_config(txs: int, k: int = 8):
+def flagship_config(txs: int, k: int = 8, latency: int = 0):
     """The flagship bench config alone — buildable without materializing
     state (how `benchmarks/hlo_pin.py` lowers the full-shape program
     abstractly): finalization unreachable within the timed window
     (0x7FFE), gossip off (pre-seeded feed, matching the reference example
-    `main.go:49-53`), poll cap covering every tx."""
+    `main.go:49-53`), poll cap covering every tx.
+
+    `latency > 0` selects the ASYNC variant (`bench.py --latency`): fixed
+    per-draw response latency of that many rounds through the in-flight
+    engine (`ops/inflight.py`), with the timeout at ``2*latency + 2``
+    rounds so nothing expires during the timed window (pure
+    delayed-delivery throughput, no expiry traffic)."""
     from go_avalanche_tpu.config import AvalancheConfig
 
+    async_kw = {}
+    if latency > 0:
+        async_kw = dict(latency_mode="fixed", latency_rounds=latency,
+                        time_step_s=1.0,
+                        request_timeout_s=float(2 * latency + 1))
     return AvalancheConfig(finalization_score=0x7FFE, k=k, gossip=False,
-                           max_element_poll=max(4096, txs))
+                           max_element_poll=max(4096, txs), **async_kw)
 
 
-def flagship_state(nodes: int, txs: int, k: int = 8):
+def flagship_state(nodes: int, txs: int, k: int = 8, latency: int = 0):
     """The `bench.py` flagship workload: (state, cfg) for sustained vote
     ingest on `models/avalanche.round_step`.
 
@@ -50,7 +61,7 @@ def flagship_state(nodes: int, txs: int, k: int = 8):
 
     from go_avalanche_tpu.models import avalanche as av
 
-    cfg = flagship_config(txs, k)
+    cfg = flagship_config(txs, k, latency)
     return av.init(jax.random.key(0), nodes, txs, cfg), cfg
 
 

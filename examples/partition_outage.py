@@ -1,0 +1,165 @@
+"""Partition outage: stalled-then-recovered finality under the async engine.
+
+The asynchronous query lifecycle (`ops/inflight.py`, PR 3) exists to ask
+availability questions the synchronous ideal cannot express.  This study
+asks the canonical one: **what does finality do through a network
+partition?**  A 50/50 cluster-aligned split is scheduled for rounds
+``[start, end)`` (`cfg.partition_spec`); during it every cross-partition
+query TIMES OUT — the query sits in the querier's in-flight ring for
+`cfg.timeout_rounds()` rounds and then expires unanswered, exactly the
+host Processor's reaping (`processor.py:262-269`) — rather than silently
+vanishing.  After `end` the partition heals, but queries issued just
+before the heal still expire: recovery trails the heal by the timeout,
+the tail a memoryless drop model cannot produce.
+
+What the measurement shows (RESULTS-style summary printed per mode):
+
+* **default (delivered-neutral) semantics** — an expired query shifts the
+  vote window with its consider bit off, so during the partition every
+  node sees only ~half its window considered and the 7-of-8 quorum rule
+  (`vote.go:58`) almost never fires: finalization STALLS (the ~8 a^7
+  availability filter of the churn study, here with a ~= 0.5), then
+  recovers after heal + timeout.
+* **skip semantics** (`cfg.skip_absent_votes=True`, the reference-HOST
+  reading where an expired response never reaches RegisterVotes) — the
+  cost is linear dilution: finality slows through the partition instead
+  of stalling, because each side's intra-side quorums still fire.
+
+Liveness under partial synchrony is exactly where Snowball's behavior
+diverges from the synchronous analysis ("Quantifying Liveness and Safety
+of Avalanche's Snowball", arXiv:2409.02217); this script is the minimal
+reproduction of that divergence on the batched simulator.
+
+    python examples/partition_outage.py
+    python examples/partition_outage.py --nodes 2048 --txs 256 \
+        --partition-start 10 --partition-end 60 --timeout-rounds 6
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def measure(
+    nodes: int = 512,
+    txs: int = 64,
+    partition_start: int = 5,
+    partition_end: int = 60,
+    timeout_rounds: int = 4,
+    latency_rounds: int = 1,
+    finalization_score: int = 48,
+    n_rounds: int = 130,
+    skip_absent: bool = False,
+    seed: int = 0,
+) -> dict:
+    """One partition-outage run; returns per-round finalizations + summary.
+
+    Contested priors (per-node 50/50) so the network must genuinely
+    converge per tx; fixed `latency_rounds` response latency inside each
+    side; the partition splits the nodes 50/50 for
+    ``[partition_start, partition_end)``.
+    """
+    import jax
+    import numpy as np
+
+    from go_avalanche_tpu.config import AvalancheConfig
+    from go_avalanche_tpu.models import avalanche as av
+    from go_avalanche_tpu.ops import voterecord as vr
+
+    cfg = AvalancheConfig(
+        finalization_score=finalization_score,
+        latency_mode="fixed",
+        latency_rounds=latency_rounds,
+        partition_spec=(partition_start, partition_end, 0.5),
+        time_step_s=1.0,
+        request_timeout_s=float(timeout_rounds - 1),
+        skip_absent_votes=skip_absent,
+    )
+    state = av.init(jax.random.key(seed), nodes, txs, cfg,
+                    init_pref=av.contested_init_pref(seed, nodes, txs))
+    final, tel = av.run_scan(state, cfg, n_rounds=n_rounds)
+    fins = np.asarray(jax.device_get(tel.finalizations))       # [rounds]
+    fin_frac = float(np.asarray(jax.device_get(vr.has_finalized(
+        final.records.confidence, cfg))).mean())
+
+    # The stall window: expiry semantics take one timeout to kick in
+    # after the cut, and recovery trails the heal by the timeout too.
+    stall_lo = partition_start + cfg.timeout_rounds()
+    stall_hi = partition_end
+    cum = np.cumsum(fins) / (nodes * txs)
+    return {
+        "mode": "skip" if skip_absent else "neutral",
+        "per_round_finalizations": fins.tolist(),
+        "finalized_fraction_final": fin_frac,
+        "finalized_fraction_at_cut": float(cum[partition_start - 1]),
+        "finalized_fraction_at_heal": float(cum[stall_hi - 1]),
+        "stall_window_finalizations": int(fins[stall_lo:stall_hi].sum()),
+        "post_heal_finalizations": int(fins[stall_hi:].sum()),
+        "timeout_rounds": cfg.timeout_rounds(),
+        "config": {
+            "nodes": nodes, "txs": txs,
+            "partition": [partition_start, partition_end, 0.5],
+            "latency_rounds": latency_rounds,
+            "finalization_score": finalization_score,
+            "rounds": n_rounds,
+        },
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--nodes", type=int, default=512)
+    parser.add_argument("--txs", type=int, default=64)
+    parser.add_argument("--partition-start", type=int, default=5)
+    parser.add_argument("--partition-end", type=int, default=60)
+    parser.add_argument("--timeout-rounds", type=int, default=4)
+    parser.add_argument("--latency-rounds", type=int, default=1)
+    parser.add_argument("--finalization-score", type=int, default=48)
+    parser.add_argument("--rounds", type=int, default=130)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--json", action="store_true",
+                        help="emit the raw per-mode dicts as JSON")
+    args = parser.parse_args()
+
+    results = []
+    for skip in (False, True):
+        r = measure(nodes=args.nodes, txs=args.txs,
+                    partition_start=args.partition_start,
+                    partition_end=args.partition_end,
+                    timeout_rounds=args.timeout_rounds,
+                    latency_rounds=args.latency_rounds,
+                    finalization_score=args.finalization_score,
+                    n_rounds=args.rounds, skip_absent=skip,
+                    seed=args.seed)
+        results.append(r)
+
+    if args.json:
+        print(json.dumps(results))
+        return
+
+    for r in results:
+        fins = r["per_round_finalizations"]
+        print(f"\n== {r['mode']} absence semantics "
+              f"(timeout {r['timeout_rounds']} rounds) ==")
+        print(f"finalized fraction: at cut {r['finalized_fraction_at_cut']:.3f}"
+              f" | at heal {r['finalized_fraction_at_heal']:.3f}"
+              f" | final {r['finalized_fraction_final']:.3f}")
+        print(f"finalizations inside stall window: "
+              f"{r['stall_window_finalizations']}; after heal: "
+              f"{r['post_heal_finalizations']}")
+        # Coarse per-round strip chart: one char per round.
+        peak = max(max(fins), 1)
+        strip = "".join(
+            " .:-=+*#@"[min(8, (9 * f) // (peak + 1))] for f in fins)
+        ps, pe = r["config"]["partition"][0], r["config"]["partition"][1]
+        print(f"rounds 0..{len(fins) - 1} (partition [{ps}, {pe})):")
+        print(f"|{strip}|")
+
+
+if __name__ == "__main__":
+    main()

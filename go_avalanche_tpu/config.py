@@ -15,7 +15,8 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-from typing import Optional
+import math
+from typing import Optional, Tuple
 
 
 class VoteMode(enum.Enum):
@@ -153,6 +154,64 @@ class AvalancheConfig:
                                       #   (ROADMAP).  Bit-exact either
                                       #   way (tests/test_sharding.py).
     strict_validation: bool = False
+    latency_mode: str = "none"        # asynchronous query lifecycle
+                                      #   (ops/inflight.py).  "none": the
+                                      #   synchronous ideal — every poll
+                                      #   resolves within its issuing
+                                      #   round, request_timeout_s is
+                                      #   inert (the pre-PR-3 scale
+                                      #   path).  Any other mode turns on
+                                      #   the in-flight engine: each
+                                      #   (querier, draw) gets a response
+                                      #   latency in ROUNDS —
+                                      #   "fixed":     every draw takes
+                                      #                latency_rounds;
+                                      #   "geometric": iid geometric with
+                                      #                mean
+                                      #                latency_rounds;
+                                      #   "weighted":  coupled to the
+                                      #                latency_weight
+                                      #                plane — the
+                                      #                highest-weight
+                                      #                (nearest) peer
+                                      #                answers in 0
+                                      #                rounds, the lowest
+                                      #                in latency_rounds,
+                                      #                linear in between
+                                      #   — and responses older than
+                                      #   timeout_rounds() expire
+                                      #   UNANSWERED (host Processor
+                                      #   reaping semantics,
+                                      #   processor.py:262-269), flowing
+                                      #   into skip_absent_votes
+                                      #   exactly like drops.
+                                      #   SEQUENTIAL vote mode only.
+    latency_rounds: int = 0           # see latency_mode; 0 with mode
+                                      #   "fixed" is bit-exact with the
+                                      #   synchronous round (pinned by
+                                      #   tests/test_inflight.py)
+    partition_spec: Optional[Tuple[int, int, float]] = None
+                                      # (round_start, round_end,
+                                      #   split_frac): a network
+                                      #   partition active for rounds
+                                      #   [start, end).  Nodes split at
+                                      #   floor(split_frac * N) —
+                                      #   cluster-aligned when
+                                      #   n_clusters > 1 (the cut lands
+                                      #   on a cluster boundary, so no
+                                      #   cluster straddles it).
+                                      #   Cross-partition queries TIME
+                                      #   OUT (expire unanswered at
+                                      #   timeout_rounds()) rather than
+                                      #   silently vanishing; after
+                                      #   `end` the partition heals and
+                                      #   in-flight cross-cut entries
+                                      #   still expire (the queries were
+                                      #   lost, not delayed).  Setting
+                                      #   this turns on the in-flight
+                                      #   engine even with latency_mode
+                                      #   "none" semantics (latency 0
+                                      #   within each side).
     stream_retire_cap: Optional[int] = None
                                       # streaming_dag scheduler: cap the
                                       #   set-slots retired+refilled per
@@ -189,6 +248,34 @@ class AvalancheConfig:
                                       #   response.go expiry) — cost
                                       #   becomes linear dilution.
                                       #   SEQUENTIAL vote mode only.
+
+    # ------------------------------------------------------- derived (async)
+
+    def async_queries(self) -> bool:
+        """True when the in-flight query engine (`ops/inflight.py`) is on:
+        a latency distribution is selected or a partition fault is
+        scheduled.  False = the synchronous ideal, the exact pre-async
+        code path (flagship `hlo_pin` program unchanged)."""
+        return self.latency_mode != "none" or self.partition_spec is not None
+
+    def timeout_rounds(self) -> int:
+        """First round-AGE at which an outstanding query is expired.
+
+        Host parity: `RequestRecord.is_expired` is ``timestamp +
+        timeout_s < now`` (types.py:119-125, strict), so a response
+        arriving at age ``a`` is accepted iff ``a * time_step_s <=
+        request_timeout_s``; the smallest non-deliverable age is
+        ``floor(timeout/dt) + 1`` when the ratio is integral and
+        ``ceil(timeout/dt)`` otherwise — both spelled here as one
+        floor+1 (the epsilon absorbs float division noise like
+        ``60/0.01 = 5999.999...``).  The in-flight ring buffer holds
+        ages ``0 .. timeout_rounds()`` inclusive, so async configs must
+        keep this small (validated <= 64): pick ``request_timeout_s``
+        and ``time_step_s`` together, e.g. ``time_step_s=1.0,
+        request_timeout_s=7.0`` for an 8-round timeout.
+        """
+        return int(math.floor(self.request_timeout_s / self.time_step_s
+                              + 1e-9)) + 1
 
     def __post_init__(self) -> None:
         if not (0 < self.window <= 8):
@@ -227,6 +314,48 @@ class AvalancheConfig:
         if self.stream_retire_cap is not None and self.stream_retire_cap < 1:
             raise ValueError("stream_retire_cap must be >= 1 (None "
                              "disables the cap)")
+        if self.latency_mode not in ("none", "fixed", "geometric",
+                                     "weighted"):
+            raise ValueError(
+                f"latency_mode must be 'none', 'fixed', 'geometric' or "
+                f"'weighted', got {self.latency_mode!r}")
+        if self.latency_rounds < 0:
+            raise ValueError("latency_rounds must be >= 0")
+        if self.partition_spec is not None:
+            if len(self.partition_spec) != 3:
+                raise ValueError("partition_spec is (round_start, "
+                                 "round_end, split_frac)")
+            start, end, frac = self.partition_spec
+            if not (0 <= start < end):
+                raise ValueError("partition_spec rounds must satisfy "
+                                 "0 <= start < end")
+            if not (0.0 < frac < 1.0):
+                raise ValueError("partition_spec split_frac must be in "
+                                 "(0, 1)")
+        if self.async_queries():
+            if self.vote_mode is not VoteMode.SEQUENTIAL:
+                raise ValueError(
+                    "the async query engine applies to the SEQUENTIAL "
+                    "vote mode only (MAJORITY reduces all k draws at "
+                    "once, which has no per-draw delivery time)")
+            if self.timeout_rounds() < 1:
+                raise ValueError(
+                    f"async queries need timeout_rounds() >= 1, got "
+                    f"{self.timeout_rounds()} from request_timeout_s="
+                    f"{self.request_timeout_s} / time_step_s="
+                    f"{self.time_step_s}: a non-positive timeout makes "
+                    f"EVERY query expire before any response can "
+                    f"deliver, so a run-until-settled driver spins "
+                    f"forever")
+            if self.timeout_rounds() > 64:
+                raise ValueError(
+                    f"async queries need timeout_rounds() <= 64 (the "
+                    f"in-flight ring depth), got "
+                    f"{self.timeout_rounds()} from request_timeout_s="
+                    f"{self.request_timeout_s} / time_step_s="
+                    f"{self.time_step_s}; lower request_timeout_s or "
+                    f"raise time_step_s (e.g. time_step_s=1.0, "
+                    f"request_timeout_s=7.0 for an 8-round timeout)")
 
 
 DEFAULT_CONFIG = AvalancheConfig()

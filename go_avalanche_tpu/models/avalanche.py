@@ -36,7 +36,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG, VoteMode
-from go_avalanche_tpu.ops import adversary, exchange, voterecord as vr
+from go_avalanche_tpu.ops import adversary, exchange, inflight
+from go_avalanche_tpu.ops import voterecord as vr
 from go_avalanche_tpu.ops.bitops import pack_bool_plane, popcount8
 from go_avalanche_tpu.ops.sampling import draw_peers
 from go_avalanche_tpu.utils.tracing import annotate
@@ -81,6 +82,14 @@ class AvalancheSimState(NamedTuple):
                                  # `SetOutputs`) can drop it
     round: jax.Array             # int32 scalar
     key: jax.Array               # PRNG key
+    inflight: Optional[inflight.InflightState] = None
+                                 # pending-query ring buffer
+                                 # (ops/inflight.py) — present iff
+                                 # cfg.async_queries(): response
+                                 # latency / timeout expiry / partition
+                                 # faults.  None = the synchronous
+                                 # ideal, statically absent from the
+                                 # trace (flagship hlo_pin unchanged)
 
 
 class SimTelemetry(NamedTuple):
@@ -216,6 +225,8 @@ def init(
                       if track_finality else None),
         round=jnp.int32(0),
         key=key,
+        inflight=(inflight.init_ring(cfg, n_nodes, n_txs)
+                  if inflight.enabled(cfg) else None),
     )
 
 
@@ -312,15 +323,32 @@ def round_step(
         prefs = vr.is_accepted(state.records.confidence)   # [N, T]
         packed_prefs = pack_bool_plane(prefs)              # [N, ceil(T/8)]
         minority_t = adversary.minority_plane(prefs)       # [T]
-        yes_pack, consider_pack = exchange.gather_vote_packs(
-            packed_prefs, peers, responded, lie, k_byz, cfg, minority_t, t)
+        if not inflight.enabled(cfg):
+            yes_pack, consider_pack = exchange.gather_vote_packs(
+                packed_prefs, peers, responded, lie, k_byz, cfg,
+                minority_t, t)
 
     # --- ingest: k fused window updates on polled records only
     # (RegisterVotes, `processor.go:92-117`); finalized records freeze.
     # `cfg.ingest_engine` selects the u8 reference or the SWAR
     # lane-packed engine (ops/swar.py) — identical bits either way.
+    ring = state.inflight
     with annotate("ingest_votes"):
-        if cfg.vote_mode is VoteMode.SEQUENTIAL:
+        if inflight.enabled(cfg):
+            # Async query lifecycle (ops/inflight.py): stamp this round's
+            # polls with per-draw latencies (+ partition cuts), enqueue
+            # them, then run the delivery/expiry pass over the whole
+            # ring.  SEQUENTIAL-only (config-validated).
+            lat = inflight.draw_latency(k_sample, cfg, peers,
+                                        state.latency_weight)
+            lat = inflight.apply_partition(lat, cfg, state.round, 0,
+                                           peers, n)
+            ring = inflight.enqueue(state.inflight, state.round, peers,
+                                    lat, responded, lie, polled)
+            records, changed, votes_applied = inflight.deliver_multi(
+                ring, state.records, cfg, packed_prefs, minority_t,
+                k_byz, state.round, t, live_rows=state.alive)
+        elif cfg.vote_mode is VoteMode.SEQUENTIAL:
             records, changed = vr.register_packed_votes_engine(
                 state.records, yes_pack, consider_pack, cfg.k, cfg,
                 update_mask=polled)
@@ -367,6 +395,7 @@ def round_step(
         finalized_at=finalized_at,
         round=state.round + 1,
         key=k_next,
+        inflight=ring,
     )
     return new_state, telemetry
 

@@ -36,6 +36,7 @@ from jax import lax
 
 from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
 from go_avalanche_tpu.models import avalanche as av
+from go_avalanche_tpu.ops import inflight
 from go_avalanche_tpu.ops import voterecord as vr
 
 NO_TX = -1  # empty-slot sentinel, in the spirit of NoNode (`avalanche.go:28`)
@@ -233,6 +234,10 @@ def _retire_and_refill(
         poll_order=poll_order,
         poll_order_inv=poll_order_inv,
         finalized_at=finalized_at,
+        # Responses still in flight for a retired slot must not land on
+        # its NEW occupant: drop the freed columns from every pending
+        # ring entry's poll mask (no-op when the async engine is off).
+        inflight=inflight.clear_columns(sim.inflight, settled | take),
     )
     return BacklogSimState(
         sim=new_sim,

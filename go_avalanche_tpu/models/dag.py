@@ -35,7 +35,8 @@ from jax import lax
 
 from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
 from go_avalanche_tpu.models import avalanche as av
-from go_avalanche_tpu.ops import adversary, exchange, voterecord as vr
+from go_avalanche_tpu.ops import adversary, exchange, inflight
+from go_avalanche_tpu.ops import voterecord as vr
 from go_avalanche_tpu.ops.bitops import pack_bool_plane
 from go_avalanche_tpu.ops.sampling import draw_peers
 
@@ -228,11 +229,27 @@ def round_step(
     # flattened gather by default (`ops/exchange.gather_vote_packs`).
     minority_t = adversary.minority_plane(prefs)
     packed_prefs = pack_bool_plane(prefs)
-    yes_pack, consider_pack = exchange.gather_vote_packs(
-        packed_prefs, peers, responded, lie, k_byz, cfg, minority_t, t)
+    ring = base.inflight
+    if inflight.enabled(cfg):
+        # Async query lifecycle (ops/inflight.py): responses vote the
+        # responder's preferred-in-set plane AS OF the delivery round's
+        # start (the synchronous round's own observation convention).
+        lat = inflight.draw_latency(k_sample, cfg, peers,
+                                    base.latency_weight)
+        lat = inflight.apply_partition(lat, cfg, base.round, 0, peers, n)
+        ring = inflight.enqueue(base.inflight, base.round, peers, lat,
+                                responded, lie, polled)
+        records, changed, votes_applied = inflight.deliver_multi(
+            ring, base.records, cfg, packed_prefs, minority_t, k_byz,
+            base.round, t, live_rows=base.alive)
+    else:
+        yes_pack, consider_pack = exchange.gather_vote_packs(
+            packed_prefs, peers, responded, lie, k_byz, cfg, minority_t, t)
 
-    records, changed = vr.register_packed_votes_engine(
-        base.records, yes_pack, consider_pack, cfg.k, cfg, update_mask=polled)
+        records, changed = vr.register_packed_votes_engine(
+            base.records, yes_pack, consider_pack, cfg.k, cfg,
+            update_mask=polled)
+        votes_applied = (av.popcnt_plane(consider_pack) * polled).sum()
 
     fin_after = vr.has_finalized(records.confidence, cfg)
     newly_final = fin_after & jnp.logical_not(fin)
@@ -246,8 +263,7 @@ def round_step(
 
     telemetry = av.SimTelemetry(
         polls=polled.sum().astype(jnp.int32),
-        votes_applied=(av.popcnt_plane(consider_pack)
-                       * polled).sum().astype(jnp.int32),
+        votes_applied=votes_applied.astype(jnp.int32),
         flips=(changed & jnp.logical_not(newly_final)).sum().astype(jnp.int32),
         finalizations=newly_final.sum().astype(jnp.int32),
         admissions=jnp.int32(0),
@@ -265,6 +281,7 @@ def round_step(
         finalized_at=finalized_at,
         round=base.round + 1,
         key=k_next,
+        inflight=ring,
     )
     return DagSimState(new_base, state.conflict_set, state.n_sets,
                        state.set_size), telemetry

@@ -21,14 +21,14 @@ its delete-then-gossip plumbing, not of the protocol.
 from __future__ import annotations
 
 import math
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG, VoteMode
-from go_avalanche_tpu.ops import adversary, voterecord as vr
+from go_avalanche_tpu.ops import adversary, inflight, voterecord as vr
 from go_avalanche_tpu.ops.sampling import sample_peers_uniform
 
 
@@ -41,6 +41,9 @@ class SnowballState(NamedTuple):
     finalized_at: jax.Array       # int32 [N]; -1 until finalized
     round: jax.Array              # int32 scalar
     key: jax.Array                # PRNG key
+    inflight: Optional[inflight.InflightState] = None
+                                  # pending-query ring (ops/inflight.py);
+                                  # present iff cfg.async_queries()
 
 
 class RoundTelemetry(NamedTuple):
@@ -70,6 +73,8 @@ def init(
         finalized_at=jnp.full((n_nodes,), -1, jnp.int32),
         round=jnp.int32(0),
         key=k_next,
+        inflight=(inflight.init_ring(cfg, n_nodes)
+                  if inflight.enabled(cfg) else None),
     )
 
 
@@ -87,13 +92,11 @@ def round_step(
     peers = sample_peers_uniform(k_sample, n, cfg.k, cfg.exclude_self,
                                  with_replacement=cfg.sample_with_replacement)
     prefs = vr.is_accepted(state.records.confidence)
-    peer_votes = prefs[peers]                               # [N, k] bool
 
     # --- adversary: byzantine peers lie with `flip_probability` per draw;
     # what the lie says is `cfg.adversary_strategy` (ops/adversary.py — the
     # reference hook at `main.go:184-187` is strategy FLIP).
     lie = adversary.lie_mask(k_byz, peers, state.byzantine, cfg)
-    peer_votes = adversary.apply_1d(k_byz, peer_votes, lie, cfg, prefs)
 
     # --- failure model: dropped responses and dead peers are abstentions
     # (neutral votes model non-responsive peers, `vote.go:56`).
@@ -105,9 +108,26 @@ def round_step(
     fin_before = vr.has_finalized(state.records.confidence, cfg)
     update_mask = jnp.logical_not(fin_before) & state.alive
 
-    if cfg.vote_mode is VoteMode.SEQUENTIAL:
+    ring = state.inflight
+    if inflight.enabled(cfg):
+        # Async query lifecycle (ops/inflight.py): the response gather and
+        # adversary transform move to DELIVERY time inside `deliver_1d`;
+        # this round only stamps latencies and enqueues.  Snowball carries
+        # no latency_weight plane, so the "weighted" mode degenerates to
+        # uniform weights (all-zero latency).
+        lat = inflight.draw_latency(k_sample, cfg, peers,
+                                    jnp.ones((n,), jnp.float32))
+        lat = inflight.apply_partition(lat, cfg, state.round, 0, peers, n)
+        ring = inflight.enqueue(state.inflight, state.round, peers, lat,
+                                responded, lie, update_mask)
+        records, changed = inflight.deliver_1d(ring, state.records, cfg,
+                                               prefs, k_byz, state.round,
+                                               live_rows=state.alive)
+    elif cfg.vote_mode is VoteMode.SEQUENTIAL:
         # Faithful per-vote window semantics: pack the k votes into uint8 bit
         # planes and run k fused window updates (`processor.go:94-117`).
+        peer_votes = adversary.apply_1d(k_byz, prefs[peers], lie, cfg,
+                                        prefs)
         shifts = jnp.arange(cfg.k, dtype=jnp.uint8)
         yes_pack = (peer_votes.astype(jnp.uint8) << shifts).sum(
             axis=1).astype(jnp.uint8)
@@ -118,6 +138,8 @@ def round_step(
     else:
         # Paper-style majority chit: one conclusive vote per round when
         # >= ceil(alpha*k) of the sampled peers agree, else neutral.
+        peer_votes = adversary.apply_1d(k_byz, prefs[peers], lie, cfg,
+                                        prefs)
         thresh = math.ceil(cfg.alpha * cfg.k)
         yes_cnt = (peer_votes & responded).sum(axis=1)
         no_cnt = (jnp.logical_not(peer_votes) & responded).sum(axis=1)
@@ -153,6 +175,7 @@ def round_step(
         finalized_at=finalized_at,
         round=state.round + 1,
         key=k_next,
+        inflight=ring,
     )
     return new_state, telemetry
 

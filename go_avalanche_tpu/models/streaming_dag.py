@@ -51,6 +51,7 @@ from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
 from go_avalanche_tpu.models import avalanche as av
 from go_avalanche_tpu.models import dag as dag_model
 from go_avalanche_tpu.models.backlog import NO_TX
+from go_avalanche_tpu.ops import inflight
 from go_avalanche_tpu.ops import voterecord as vr
 
 NO_SET = NO_TX  # empty set-slot sentinel (-1), NoNode spirit (`avalanche.go:28`)
@@ -355,6 +356,11 @@ def _retire_and_refill(
         poll_order=poll_order,
         poll_order_inv=poll_order_inv,
         finalized_at=finalized_at,
+        # Responses still in flight for a retired set-slot must not land
+        # on its NEW occupant: drop the freed columns from every pending
+        # ring entry's poll mask (no-op when the async engine is off).
+        inflight=inflight.clear_columns(base.inflight,
+                                        jnp.repeat(settled | take, c)),
     )
     return StreamingDagState(
         dag=dag_model.DagSimState(new_base, state.dag.conflict_set,

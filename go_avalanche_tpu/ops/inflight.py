@@ -1,0 +1,398 @@
+"""The in-flight query engine: response latency, timeout expiry, and
+partition faults for the batched simulators.
+
+The reference Processor is fundamentally ASYNCHRONOUS: `event_loop` records
+an outstanding query per poll (`processor.go:235-243`), responses arrive
+whenever the network delivers them, and requests older than
+`request_timeout_s` are reaped unanswered (`processor.go:61-122`,
+`response.go:49-51` — honored today by the host twin `processor.py` only).
+Every batched model, by contrast, resolved its k polls instantaneously
+within the issuing round, so `cfg.request_timeout_s` was dead config on
+the scale path and the only network fault was a memoryless drop.  Liveness
+under message delay is qualitatively different from the synchronous ideal
+(arXiv:2409.02217 quantifies Snowball liveness under partial synchrony;
+TangleSim, arXiv:2305.01232, treats network latency as a first-class
+simulation axis) — this module gives the `[N, T]` models that axis.
+
+Mechanics — everything fixed-shape, `lax.scan`/`while_loop`-compatible,
+no host round-trips:
+
+  * each round's k polls per node are ENQUEUED into a depth-
+    ``timeout_rounds() + 1`` ring buffer of pending-query planes carried
+    in the sim state (`InflightState`), stamped with a per-(querier,
+    draw) latency in rounds drawn by `draw_latency`
+    (`cfg.latency_mode`: fixed / geometric / coupled to the
+    `latency_weight` plane);
+  * the DELIVERY pass (`deliver_multi` / `deliver_1d`) walks the ring
+    oldest-age-first each round: an entry whose latency equals its age
+    gathers the responder's CURRENT preference (responses reflect
+    responder state at answer time; the query/transmission leg is
+    instantaneous, which keeps gossip-on-poll at issue time) and ingests
+    through the three-plane kernel
+    (`voterecord.register_packed_votes_present`);
+  * entries still undelivered at age `cfg.timeout_rounds()` EXPIRE
+    UNANSWERED — exactly the host Processor's reaping
+    (`processor.py:262-269`): under `cfg.skip_absent_votes` they
+    register nothing (reference-host semantics, an expired response
+    never reaches RegisterVotes), otherwise they shift the window as a
+    delivered neutral, the same absence semantics drops get;
+  * a partition fault (`cfg.partition_spec`) marks cross-cut draws
+    undeliverable at ISSUE time — those queries time out rather than
+    silently vanishing, so a healed partition shows the timeout tail,
+    not an instant recovery.
+
+Latency-0 (`latency_mode="fixed"`, `latency_rounds=0`) is bit-exact with
+the synchronous round on every model and config axis
+(tests/test_inflight.py golden parity): the just-enqueued entry delivers
+in the same round, reading the same pre-round preference plane with the
+same PRNG keys.  With `cfg.async_queries()` False the engine is
+statically absent (state leaf None, zero trace impact — the flagship
+`hlo_pin` hash is unchanged).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from go_avalanche_tpu.config import AvalancheConfig
+from go_avalanche_tpu.ops import adversary, exchange, voterecord as vr
+from go_avalanche_tpu.ops.bitops import popcount8
+
+# fold_in constant deriving the latency stream from the round's sampling
+# key: the latency draw must not perturb any existing stream (latency-0
+# trajectories are pinned bit-exact against the synchronous round).
+_LAT_FOLD = 0x1A7E
+
+
+class InflightState(NamedTuple):
+    """Ring buffer of pending queries; a pytree of ``[D, rows, ...]``
+    planes (D = ``cfg.timeout_rounds() + 1``; rows = N, or n_local on a
+    shard).  Slot ``r % D`` holds the queries ISSUED in round r; an
+    entry's age in round ``r'`` is ``r' - r``, and the slot is
+    overwritten exactly one round after its entries expire.
+
+    `polled` is the issue-time update mask: bool ``[D, rows, T]`` for
+    the multi-target models (unpacked on purpose — a bit-packed plane
+    cannot shard over the txs axis at byte granularity when the
+    per-shard width is not a multiple of 8; packing it per shard is a
+    ROADMAP item for the hardware window), bool ``[D, rows]`` for
+    single-decree Snowball.  `lat` is clipped to ``[0,
+    timeout_rounds()]``; the top value is the NEVER-delivers sentinel
+    (expires unanswered).
+    """
+
+    peers: jax.Array      # int32 [D, rows, k] — global peer ids
+    lat: jax.Array        # int32 [D, rows, k] — delivery age; == timeout
+                          #   sentinel means "expires unanswered"
+    responded: jax.Array  # bool [D, rows, k] — issue-time alive/drop/self
+    lie: jax.Array        # bool [D, rows, k] — issue-time adversary mask
+    polled: jax.Array     # bool [D, rows, T], or bool [D, rows]
+                          #   (snowball)
+
+
+def enabled(cfg: AvalancheConfig) -> bool:
+    """Static: is the in-flight engine on for this config?"""
+    return cfg.async_queries()
+
+
+def ring_depth(cfg: AvalancheConfig) -> int:
+    """Slots in the ring: ages ``0 .. timeout_rounds()`` inclusive."""
+    return cfg.timeout_rounds() + 1
+
+
+def init_ring(cfg: AvalancheConfig, rows: int,
+              t: Optional[int] = None) -> InflightState:
+    """Empty ring: every slot pre-expired (lat = sentinel) with an
+    all-zero update mask, so untouched slots never register anything."""
+    d = ring_depth(cfg)
+    k = cfg.k
+    if t is None:            # single-decree: per-node bool mask
+        polled = jnp.zeros((d, rows), jnp.bool_)
+    else:                    # multi-target: per-(node, tx) bool mask
+        polled = jnp.zeros((d, rows, t), jnp.bool_)
+    return InflightState(
+        peers=jnp.zeros((d, rows, k), jnp.int32),
+        lat=jnp.full((d, rows, k), cfg.timeout_rounds(), jnp.int32),
+        responded=jnp.zeros((d, rows, k), jnp.bool_),
+        lie=jnp.zeros((d, rows, k), jnp.bool_),
+        polled=polled,
+    )
+
+
+def draw_latency(
+    key: jax.Array,
+    cfg: AvalancheConfig,
+    peers: jax.Array,
+    latency_weight: jax.Array,
+) -> jax.Array:
+    """Per-(querier, draw) response latency in rounds; int32 ``[rows, k]``
+    clipped to ``[0, timeout_rounds()]`` (the top value never delivers).
+
+    fixed     — every draw takes `cfg.latency_rounds`.
+    geometric — iid Geometric on {0, 1, ...} with mean `latency_rounds`
+                (success prob p = 1/(1+mean), inverse-CDF draw); the tail
+                beyond the timeout expires unanswered — the natural
+                timeout-vs-straggler study.
+    weighted  — coupled to the `latency_weight` sampling-propensity
+                plane: the max-weight (nearest) peer answers in 0
+                rounds, the min-weight peer in `latency_rounds`, linear
+                in the weight in between.  Uniform weights give all-0 —
+                bit-exact with the synchronous round.
+
+    `key` is the round's SAMPLING key: the latency stream derives from it
+    by an internal fold, so turning latency on never perturbs the peer /
+    fault draws (the latency-0 parity pin depends on this).
+    """
+    key = jax.random.fold_in(key, _LAT_FOLD)
+    timeout = cfg.timeout_rounds()
+    if cfg.latency_mode in ("none", "fixed"):
+        # "none" reaches here only when partition_spec turned the engine
+        # on: latency 0 within each side of the cut.
+        base = cfg.latency_rounds if cfg.latency_mode == "fixed" else 0
+        return jnp.full(peers.shape, min(base, timeout), jnp.int32)
+    if cfg.latency_mode == "geometric":
+        if cfg.latency_rounds == 0:
+            return jnp.zeros(peers.shape, jnp.int32)
+        p = 1.0 / (1.0 + cfg.latency_rounds)
+        u = jax.random.uniform(key, peers.shape)
+        lat = jnp.floor(jnp.log1p(-u) / math.log1p(-p)).astype(jnp.int32)
+        return jnp.clip(lat, 0, timeout)
+    # weighted: lat = latency_rounds * (wmax - w[peer]) / (wmax - wmin).
+    w = latency_weight[peers]
+    wmax = latency_weight.max()
+    wmin = latency_weight.min()
+    scale = (wmax - w) / jnp.maximum(wmax - wmin, jnp.float32(1e-9))
+    lat = jnp.round(cfg.latency_rounds * scale).astype(jnp.int32)
+    return jnp.clip(lat, 0, timeout)
+
+
+def apply_partition(
+    lat: jax.Array,
+    cfg: AvalancheConfig,
+    round_: jax.Array,
+    row_offset,
+    peers: jax.Array,
+    n_global: int,
+) -> jax.Array:
+    """Mark cross-partition draws undeliverable while the cut is active.
+
+    During rounds ``[start, end)`` of `cfg.partition_spec`, a query whose
+    querier and sampled peer sit on opposite sides of the split never
+    delivers — its latency becomes the timeout sentinel, so it EXPIRES
+    unanswered at age `timeout_rounds()` (the host Processor's reap),
+    including entries issued just before the heal.  The split point is
+    ``floor(split_frac * N)``, snapped to a cluster boundary when
+    `cfg.n_clusters > 1` (contiguous-block clusters, `ops/sampling.py`).
+    """
+    if cfg.partition_spec is None:
+        return lat
+    start, end, frac = cfg.partition_spec
+    if cfg.n_clusters > 1:
+        # Snap to the nearest INTERIOR cluster boundary: at least one
+        # cluster on each side (a 0- or n_clusters-cluster "split" is no
+        # partition at all, and clamping at node granularity would break
+        # the no-cluster-straddles-the-cut contract).  floor(x+0.5), not
+        # round(): banker's rounding would turn a 0.5 frac at odd
+        # cluster counts into an off-by-one split.
+        csize = n_global // cfg.n_clusters
+        split_cluster = int(math.floor(frac * cfg.n_clusters + 0.5))
+        split_cluster = max(1, min(split_cluster, cfg.n_clusters - 1))
+        split = split_cluster * csize
+    else:
+        split = max(1, min(int(math.floor(frac * n_global)), n_global - 1))
+    rows = peers.shape[0]
+    active = (round_ >= start) & (round_ < end)
+    qside = (jnp.arange(rows, dtype=jnp.int32)
+             + jnp.asarray(row_offset, jnp.int32)) < split
+    pside = peers < split
+    cut = active & (qside[:, None] != pside)
+    return jnp.where(cut, jnp.int32(cfg.timeout_rounds()), lat)
+
+
+def enqueue(
+    ring: InflightState,
+    round_: jax.Array,
+    peers: jax.Array,
+    lat: jax.Array,
+    responded: jax.Array,
+    lie: jax.Array,
+    polled: jax.Array,
+) -> InflightState:
+    """Write this round's queries into slot ``round_ % D``."""
+    d = ring.peers.shape[0]
+    slot = jnp.mod(round_, d).astype(jnp.int32)
+
+    def upd(plane, entry):
+        return lax.dynamic_update_index_in_dim(plane, entry.astype(
+            plane.dtype), slot, 0)
+
+    return InflightState(
+        peers=upd(ring.peers, peers),
+        lat=upd(ring.lat, lat),
+        responded=upd(ring.responded, responded),
+        lie=upd(ring.lie, lie),
+        polled=upd(ring.polled, polled),
+    )
+
+
+def _delivery_key(key: jax.Array, d: jax.Array) -> jax.Array:
+    """Per-age adversary key: age 0 uses the round key VERBATIM (latency-0
+    bit-parity with the synchronous round's equivocation coins), older
+    ages fold the age in for an independent stream."""
+    return lax.cond(d == 0, lambda: key,
+                    lambda: jax.random.fold_in(key, d))
+
+
+def _pack_bits(bits: jax.Array) -> jax.Array:
+    """bool ``[rows, k]`` -> uint8 ``[rows]``, bit j = draw j."""
+    k = bits.shape[1]
+    shifts = jnp.arange(k, dtype=jnp.uint8)
+    return (bits.astype(jnp.uint8) << shifts).sum(axis=1).astype(jnp.uint8)
+
+
+def deliver_multi(
+    ring: InflightState,
+    records: vr.VoteRecordState,
+    cfg: AvalancheConfig,
+    packed_prefs: jax.Array,
+    minority_t: jax.Array,
+    key: jax.Array,
+    round_: jax.Array,
+    t: int,
+    live_rows: Optional[jax.Array] = None,
+) -> Tuple[vr.VoteRecordState, jax.Array, jax.Array]:
+    """One round's delivery+expiry pass for the multi-target models.
+
+    Walks ring ages oldest-first (``timeout_rounds() .. 0``) in a
+    `fori_loop` — compiled size is O(1) in the ring depth.  Per age:
+    entries whose latency matches deliver (gather via the
+    `cfg.fused_exchange` engine dispatch against `packed_prefs`, the
+    PRE-ROUND preference plane — all of a round's responses observe the
+    round-start state, the synchronous round's own convention); entries
+    at the timeout age with the never-delivers latency expire unanswered.
+    Both ingest through `register_packed_votes_present` with the stored
+    issue-time poll mask, further masked by records that finalized while
+    the query was in flight (the reference deletes finalized records, so
+    late votes never reach them, `processor.go:114-116`) and — when
+    `live_rows` (bool ``[rows]``, the round-start alive slice) is given —
+    by queriers that churned DEAD while their query was in flight: a dead
+    node's records stay frozen, the same invariant the synchronous
+    round's ``polled & alive`` mask maintains.
+
+    Returns ``(records, changed, votes_applied)`` — `changed` OR-reduced
+    over ages, `votes_applied` the delivered non-neutral ingest count
+    (same accounting as the synchronous round's telemetry).
+    """
+    timeout = cfg.timeout_rounds()
+    depth = timeout + 1
+
+    def body(i, carry):
+        records, changed, votes_applied = carry
+        d = jnp.int32(timeout) - i
+        slot = jnp.mod(round_ - d + depth, depth)
+        peers = lax.dynamic_index_in_dim(ring.peers, slot, 0, False)
+        lat = lax.dynamic_index_in_dim(ring.lat, slot, 0, False)
+        responded = lax.dynamic_index_in_dim(ring.responded, slot, 0, False)
+        lie = lax.dynamic_index_in_dim(ring.lie, slot, 0, False)
+        polled = lax.dynamic_index_in_dim(ring.polled, slot, 0, False)
+
+        deliver = (lat == d[None, None]) & (d != timeout)
+        expire = (lat >= timeout) & (d == timeout)
+        consider = responded & deliver
+        present = deliver | expire
+        if cfg.skip_absent_votes:
+            present = present & consider
+
+        yes_pack, consider_pack = exchange.gather_vote_packs(
+            packed_prefs, peers, consider, lie,
+            _delivery_key(key, d), cfg, minority_t, t)
+        present_pack = jnp.broadcast_to(
+            _pack_bits(present)[:, None], consider_pack.shape)
+        update_mask = polled & jnp.logical_not(
+            vr.has_finalized(records.confidence, cfg))
+        if live_rows is not None:
+            update_mask = update_mask & live_rows[:, None]
+        records, ch = vr.register_packed_votes_present(
+            records, yes_pack, consider_pack, present_pack, cfg.k, cfg,
+            update_mask=update_mask)
+        changed = changed | ch
+        votes_applied = votes_applied + (
+            popcount8(consider_pack).astype(jnp.int32) * update_mask).sum()
+        return records, changed, votes_applied
+
+    changed0 = jnp.zeros(records.votes.shape, jnp.bool_)
+    return lax.fori_loop(0, depth, body,
+                         (records, changed0, jnp.int32(0)))
+
+
+def deliver_1d(
+    ring: InflightState,
+    records: vr.VoteRecordState,
+    cfg: AvalancheConfig,
+    prefs: jax.Array,
+    key: jax.Array,
+    round_: jax.Array,
+    live_rows: Optional[jax.Array] = None,
+) -> Tuple[vr.VoteRecordState, jax.Array]:
+    """`deliver_multi` for single-decree Snowball (``[N]`` records).
+
+    Same age walk, expiry semantics, and dead-querier freeze
+    (`live_rows`); the response gather is a plain row gather of the
+    pre-round ``[N]`` preference plane plus the 1-D adversary transform.
+    Returns ``(records, changed)``.
+    """
+    timeout = cfg.timeout_rounds()
+    depth = timeout + 1
+
+    def body(i, carry):
+        records, changed = carry
+        d = jnp.int32(timeout) - i
+        slot = jnp.mod(round_ - d + depth, depth)
+        peers = lax.dynamic_index_in_dim(ring.peers, slot, 0, False)
+        lat = lax.dynamic_index_in_dim(ring.lat, slot, 0, False)
+        responded = lax.dynamic_index_in_dim(ring.responded, slot, 0, False)
+        lie = lax.dynamic_index_in_dim(ring.lie, slot, 0, False)
+        mask = lax.dynamic_index_in_dim(ring.polled, slot, 0, False)
+
+        votes = adversary.apply_1d(_delivery_key(key, d), prefs[peers],
+                                   lie, cfg, prefs)
+        deliver = (lat == d[None, None]) & (d != timeout)
+        expire = (lat >= timeout) & (d == timeout)
+        consider = responded & deliver
+        present = deliver | expire
+        if cfg.skip_absent_votes:
+            present = present & consider
+
+        update_mask = mask & jnp.logical_not(
+            vr.has_finalized(records.confidence, cfg))
+        if live_rows is not None:
+            update_mask = update_mask & live_rows
+        records, ch = vr.register_packed_votes_present(
+            records, _pack_bits(votes), _pack_bits(consider),
+            _pack_bits(present), cfg.k, cfg, update_mask=update_mask)
+        return records, changed | ch
+
+    changed0 = jnp.zeros(records.votes.shape, jnp.bool_)
+    return lax.fori_loop(0, depth, body, (records, changed0))
+
+
+def clear_columns(ring: Optional[InflightState],
+                  cols: jax.Array) -> Optional[InflightState]:
+    """Drop pending updates for window columns being retired/refilled.
+
+    The streaming schedulers (`models/backlog`, `models/streaming_dag`
+    and their sharded twins) reuse window columns for NEW txs; a response
+    still in flight for the old occupant must not land on its
+    replacement, so every ring slot's stored poll mask drops the refilled
+    columns.  `cols` is bool ``[W]`` (True = column re-assigned); None
+    ring (engine off) passes through.
+    """
+    if ring is None:
+        return None
+    return ring._replace(
+        polled=ring.polled & jnp.logical_not(cols)[None, None, :])

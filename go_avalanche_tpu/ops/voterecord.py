@@ -561,6 +561,69 @@ def register_packed_votes_swar(
     return new_state, any_changed
 
 
+def register_packed_votes_present(
+    state: VoteRecordState,
+    yes_pack: jax.Array,
+    consider_pack: jax.Array,
+    present_pack: jax.Array,
+    k: int,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    update_mask: jax.Array | None = None,
+) -> Tuple[VoteRecordState, jax.Array]:
+    """Three-plane ingest for the async query engine (`ops/inflight.py`).
+
+    Per vote slot j the PRESENT bit selects among three outcomes the
+    two-plane form cannot express at once:
+
+      present off              — the slot registers NOTHING (the query is
+                                 still in flight, already delivered in an
+                                 earlier round, or was never issued);
+      present on, consider off — a delivered ABSENCE (a non-response
+                                 observed at its scheduled delivery
+                                 round, or a timeout expiry under the
+                                 delivered-neutral semantics): shifts the
+                                 window with its consider bit off,
+                                 exactly `vote.go:54-75`;
+      present on, consider on  — a real delivered vote.
+
+    With present all-ones this is bit-identical to
+    ``register_packed_votes(..., absent_is_skip=False)`` (the fused
+    two-plane kernel — pinned transitively by the latency-0 golden
+    parity matrix, tests/test_inflight.py); callers wanting
+    reference-host skip semantics AND the two-plane present==consider
+    collapse simply pass ``present_pack = consider_pack``, which matches
+    `_register_packed_votes_skip` (present votes commit a set consider
+    bit).  Plain per-slot `_apply_vote_bits` + select: this path runs
+    only for async configs, never the flagship bench — clarity over the
+    incremental-counter fusion.
+    """
+    if not (0 < k <= 8):
+        raise ValueError("k must be in (0, 8] for uint8 packing")
+    votes, consider, confidence = state
+    any_changed = jnp.zeros(state.votes.shape, jnp.bool_)
+    for j in range(k):
+        bit = jnp.uint8(1 << j)
+        present = (present_pack & bit) != 0
+        yes_bit = (yes_pack & bit) != 0
+        cons_bit = (consider_pack & bit) != 0
+        v2, c2, conf2, ch2 = _apply_vote_bits(
+            votes, consider, confidence, yes_bit, cons_bit, cfg)
+        votes = jnp.where(present, v2, votes)
+        consider = jnp.where(present, c2, consider)
+        confidence = jnp.where(present, conf2, confidence)
+        any_changed |= ch2 & present
+    new_state = VoteRecordState(votes, consider, confidence)
+    if update_mask is not None:
+        update_mask = jnp.asarray(update_mask, jnp.bool_)
+        new_state = VoteRecordState(
+            jnp.where(update_mask, new_state.votes, state.votes),
+            jnp.where(update_mask, new_state.consider, state.consider),
+            jnp.where(update_mask, new_state.confidence, state.confidence),
+        )
+        any_changed = any_changed & update_mask
+    return new_state, any_changed
+
+
 def _register_packed_votes_skip(
     state: VoteRecordState,
     yes_pack: jax.Array,

@@ -51,21 +51,33 @@ from go_avalanche_tpu.models.avalanche import (
     popcnt_plane,
     stamp_finality,
 )
-from go_avalanche_tpu.ops import adversary, exchange, voterecord as vr
+from go_avalanche_tpu.ops import adversary, exchange, inflight
+from go_avalanche_tpu.ops import voterecord as vr
 from go_avalanche_tpu.ops.bitops import pack_bool_plane, unpack_bool_plane
 from go_avalanche_tpu.ops.sampling import draw_peers
 from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS, shard_map
 
 
-def state_specs(track_finality: bool = True) -> AvalancheSimState:
+def state_specs(track_finality: bool = True,
+                with_inflight: bool = False) -> AvalancheSimState:
     """PartitionSpecs for every leaf of `AvalancheSimState`.
 
     `track_finality=False` mirrors a state whose `finalized_at` leaf is
     None (see `models/avalanche.init`): the spec tree must carry None in
-    the same slot or tree-structure checks fail.
+    the same slot or tree-structure checks fail.  `with_inflight=True`
+    adds specs for the async-query ring buffer (`ops/inflight.py`): the
+    per-draw planes shard with the node rows (leading ring-depth axis
+    replicated), the poll-mask plane with both axes.
     """
-    if not track_finality:
-        return state_specs()._replace(finalized_at=None)
+    inflight_specs = None
+    if with_inflight:
+        inflight_specs = inflight.InflightState(
+            peers=P(None, NODES_AXIS, None),
+            lat=P(None, NODES_AXIS, None),
+            responded=P(None, NODES_AXIS, None),
+            lie=P(None, NODES_AXIS, None),
+            polled=P(None, NODES_AXIS, TXS_AXIS),
+        )
     return AvalancheSimState(
         records=vr.VoteRecordState(
             votes=P(NODES_AXIS, TXS_AXIS),
@@ -80,9 +92,10 @@ def state_specs(track_finality: bool = True) -> AvalancheSimState:
         byzantine=P(),           # replicated [N]: peer lookups need all rows
         alive=P(),
         latency_weight=P(),      # replicated [N]: global sampling CDF
-        finalized_at=P(NODES_AXIS, TXS_AXIS),
+        finalized_at=(P(NODES_AXIS, TXS_AXIS) if track_finality else None),
         round=P(),
         key=P(),
+        inflight=inflight_specs,
     )
 
 
@@ -95,7 +108,8 @@ def shard_state(state: AvalancheSimState, mesh) -> AvalancheSimState:
     """
     return jax.tree.map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
-        state, state_specs(state.finalized_at is not None))
+        state, state_specs(state.finalized_at is not None,
+                           state.inflight is not None))
 
 
 def _global_minority_plane(prefs_local: jax.Array,
@@ -301,20 +315,37 @@ def _local_round(
     if cfg.adversary_strategy is AdversaryStrategy.EQUIVOCATE:
         k_vote = jax.random.fold_in(k_byz, lax.axis_index(TXS_AXIS))
 
-    # Engine dispatch (`ops/exchange.gather_vote_packs`): global peer ids
-    # index the replicated packed plane — one flattened gather (fused,
-    # default) or k row-gathers (legacy).
-    yes_pack, consider_pack = exchange.gather_vote_packs(
-        packed_global, peers, responded, lie, k_vote, cfg, minority_t,
-        t_local)
-
     # --- ingest.
-    if cfg.vote_mode is VoteMode.SEQUENTIAL:
+    ring = state.inflight
+    if inflight.enabled(cfg):
+        # Async query lifecycle (ops/inflight.py): delivery gathers index
+        # the round's replicated packed plane exactly like the
+        # synchronous gather; the ring's per-draw planes are node-row
+        # sharded, so the whole pass stays collective-free.
+        lat = inflight.draw_latency(k_sample, cfg, peers,
+                                    state.latency_weight)
+        lat = inflight.apply_partition(lat, cfg, state.round, offset,
+                                       peers, n_global)
+        ring = inflight.enqueue(state.inflight, state.round, peers, lat,
+                                responded, lie, polled)
+        records, changed, votes_applied = inflight.deliver_multi(
+            ring, state.records, cfg, packed_global, minority_t, k_vote,
+            state.round, t_local, live_rows=alive_local)
+    elif cfg.vote_mode is VoteMode.SEQUENTIAL:
+        # Engine dispatch (`ops/exchange.gather_vote_packs`): global peer
+        # ids index the replicated packed plane — one flattened gather
+        # (fused, default) or k row-gathers (legacy).
+        yes_pack, consider_pack = exchange.gather_vote_packs(
+            packed_global, peers, responded, lie, k_vote, cfg, minority_t,
+            t_local)
         records, changed = vr.register_packed_votes_engine(
             state.records, yes_pack, consider_pack, cfg.k, cfg,
             update_mask=polled)
         votes_applied = (popcnt_plane(consider_pack) * polled).sum()
     else:
+        yes_pack, consider_pack = exchange.gather_vote_packs(
+            packed_global, peers, responded, lie, k_vote, cfg, minority_t,
+            t_local)
         thresh = math.ceil(cfg.alpha * cfg.k)
         yes_cnt = popcnt_plane(yes_pack & consider_pack)
         no_cnt = popcnt_plane(~yes_pack & consider_pack)
@@ -363,6 +394,7 @@ def _local_round(
         finalized_at=finalized_at,
         round=state.round + 1,
         key=k_next,
+        inflight=ring,
     )
     return new_state, telemetry
 
@@ -374,8 +406,9 @@ def _donate(donate: bool) -> tuple:
     return (0,) if donate else ()
 
 
-def _shard_mapped(mesh, fn, track_finality: bool = True):
-    specs = state_specs(track_finality)
+def _shard_mapped(mesh, fn, track_finality: bool = True,
+                  with_inflight: bool = False):
+    specs = state_specs(track_finality, with_inflight)
     tel_specs = SimTelemetry(*([P()] * len(SimTelemetry._fields)))
     return shard_map(fn, mesh=mesh, in_specs=(specs,),
                      out_specs=(specs, tel_specs), check_vma=False)
@@ -395,11 +428,13 @@ def make_sharded_round_step(mesh, cfg: AvalancheConfig = DEFAULT_CONFIG,
     def step(state: AvalancheSimState):
         n_global = state.records.votes.shape[0]
         track = state.finalized_at is not None
-        if (n_global, track) not in cache:
-            cache[(n_global, track)] = jax.jit(_shard_mapped(
+        asyncq = state.inflight is not None
+        if (n_global, track, asyncq) not in cache:
+            cache[(n_global, track, asyncq)] = jax.jit(_shard_mapped(
                 mesh, lambda s: _local_round(s, cfg, n_global, n_tx),
-                track_finality=track), donate_argnums=_donate(donate))
-        return cache[(n_global, track)](state)
+                track_finality=track, with_inflight=asyncq),
+                donate_argnums=_donate(donate))
+        return cache[(n_global, track, asyncq)](state)
 
     return step
 
@@ -423,7 +458,8 @@ def run_scan_sharded(
 
     return jax.jit(_shard_mapped(
         mesh, local_scan,
-        track_finality=state.finalized_at is not None),
+        track_finality=state.finalized_at is not None,
+        with_inflight=state.inflight is not None),
         donate_argnums=_donate(donate))(state)
 
 
@@ -462,7 +498,8 @@ def run_sharded(
         final, _ = lax.while_loop(cond, body, (s, unsettled(s)))
         return final
 
-    specs = state_specs(state.finalized_at is not None)
+    specs = state_specs(state.finalized_at is not None,
+                        state.inflight is not None)
     fn = shard_map(local_run, mesh=mesh, in_specs=(specs,),
                    out_specs=specs, check_vma=False)
     return jax.jit(fn, donate_argnums=_donate(donate))(state)

@@ -44,15 +44,17 @@ from go_avalanche_tpu.models.backlog import (
     BacklogSimState,
     BacklogTelemetry,
 )
+from go_avalanche_tpu.ops import inflight
 from go_avalanche_tpu.ops import voterecord as vr
 from go_avalanche_tpu.parallel import sharded
 from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS, shard_map
 
 
-def backlog_state_specs(track_finality: bool = True) -> BacklogSimState:
+def backlog_state_specs(track_finality: bool = True,
+                        with_inflight: bool = False) -> BacklogSimState:
     """PartitionSpecs for every leaf of `BacklogSimState`."""
     return BacklogSimState(
-        sim=sharded.state_specs(track_finality),
+        sim=sharded.state_specs(track_finality, with_inflight),
         slot_tx=P(TXS_AXIS),
         slot_admit_round=P(TXS_AXIS),
         backlog=Backlog(score=P(), init_pref=P(), valid=P()),
@@ -66,7 +68,8 @@ def shard_backlog_state(state: BacklogSimState, mesh) -> BacklogSimState:
     """Place a host-built backlog state onto the mesh."""
     return jax.tree.map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
-        state, backlog_state_specs(state.sim.finalized_at is not None))
+        state, backlog_state_specs(state.sim.finalized_at is not None,
+                                   state.sim.inflight is not None))
 
 
 def _merge_write(old, idx, value, b):
@@ -189,6 +192,9 @@ def _local_retire_and_refill(
         poll_order=poll_order,
         poll_order_inv=poll_order_inv,
         finalized_at=finalized_at,
+        # In-flight responses for a retired slot must not land on its
+        # NEW occupant (see models/backlog); columns are shard-local.
+        inflight=inflight.clear_columns(sim.inflight, settled | take),
     )
     retired = lax.psum(settled.sum().astype(jnp.int32), TXS_AXIS)
     return BacklogSimState(
@@ -221,8 +227,9 @@ def _local_step(
     return state._replace(sim=new_sim), tel
 
 
-def _shard_mapped(mesh, fn, with_tel=True, track_finality: bool = True):
-    specs = backlog_state_specs(track_finality)
+def _shard_mapped(mesh, fn, with_tel=True, track_finality: bool = True,
+                  with_inflight: bool = False):
+    specs = backlog_state_specs(track_finality, with_inflight)
     if with_tel:
         tel_specs = BacklogTelemetry(
             round=av.SimTelemetry(
@@ -245,12 +252,13 @@ def make_sharded_backlog_step(mesh, cfg: AvalancheConfig = DEFAULT_CONFIG,
     def step(state: BacklogSimState):
         n_global = state.sim.records.votes.shape[0]
         track = state.sim.finalized_at is not None
-        if (n_global, track) not in cache:
-            cache[(n_global, track)] = jax.jit(_shard_mapped(
+        asyncq = state.sim.inflight is not None
+        if (n_global, track, asyncq) not in cache:
+            cache[(n_global, track, asyncq)] = jax.jit(_shard_mapped(
                 mesh, lambda s: _local_step(s, cfg, n_global, n_tx),
-                track_finality=track),
+                track_finality=track, with_inflight=asyncq),
                 donate_argnums=sharded._donate(donate))
-        return cache[(n_global, track)](state)
+        return cache[(n_global, track, asyncq)](state)
 
     return step
 
@@ -274,7 +282,8 @@ def run_scan_sharded_backlog(
 
     return jax.jit(_shard_mapped(
         mesh, local_scan,
-        track_finality=state.sim.finalized_at is not None),
+        track_finality=state.sim.finalized_at is not None,
+        with_inflight=state.sim.inflight is not None),
         donate_argnums=sharded._donate(donate))(state)
 
 
@@ -316,5 +325,6 @@ def run_sharded_backlog(
 
     return jax.jit(_shard_mapped(
         mesh, local_run, with_tel=False,
-        track_finality=state.sim.finalized_at is not None),
+        track_finality=state.sim.finalized_at is not None,
+        with_inflight=state.sim.inflight is not None),
         donate_argnums=sharded._donate(donate))(state)

@@ -38,7 +38,8 @@ from go_avalanche_tpu.config import (
 from go_avalanche_tpu.models import avalanche as av
 from go_avalanche_tpu.models import dag as dag_model
 from go_avalanche_tpu.models.dag import DagSimState
-from go_avalanche_tpu.ops import adversary, exchange, voterecord as vr
+from go_avalanche_tpu.ops import adversary, exchange, inflight
+from go_avalanche_tpu.ops import voterecord as vr
 from go_avalanche_tpu.ops.bitops import pack_bool_plane
 from go_avalanche_tpu.ops.sampling import draw_peers
 from go_avalanche_tpu.parallel import sharded
@@ -47,15 +48,18 @@ from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS, shard_map
 
 def dag_state_specs(n_sets: int,
                     set_size: Optional[int] = None,
-                    track_finality: bool = True) -> DagSimState:
+                    track_finality: bool = True,
+                    with_inflight: bool = False) -> DagSimState:
     """PartitionSpecs for every leaf of `DagSimState`.
 
     `n_sets` and `set_size` ride along as the pytree's static aux data so
     the spec tree and the value tree unflatten identically;
     `track_finality=False` mirrors a base state whose `finalized_at` leaf
-    is None (`models/avalanche.init`).
+    is None (`models/avalanche.init`); `with_inflight=True` adds the
+    async-query ring specs (`sharded.state_specs`).
     """
-    return DagSimState(base=sharded.state_specs(track_finality),
+    return DagSimState(base=sharded.state_specs(track_finality,
+                                                with_inflight),
                        conflict_set=P(TXS_AXIS), n_sets=n_sets,
                        set_size=set_size)
 
@@ -86,7 +90,8 @@ def shard_dag_state(state: DagSimState, mesh) -> DagSimState:
     return jax.tree.map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
         state, dag_state_specs(state.n_sets, state.set_size,
-                               state.base.finalized_at is not None))
+                               state.base.finalized_at is not None,
+                               state.base.inflight is not None))
 
 
 def _local_sets(conflict_set_local: jax.Array) -> jax.Array:
@@ -179,12 +184,29 @@ def _local_round(
     if cfg.adversary_strategy is AdversaryStrategy.EQUIVOCATE:
         k_vote = jax.random.fold_in(k_byz, lax.axis_index(TXS_AXIS))
 
-    yes_pack, consider_pack = exchange.gather_vote_packs(
-        packed_global, peers, responded, lie, k_vote, cfg, minority_t,
-        t_local)
+    ring = base.inflight
+    if inflight.enabled(cfg):
+        # Async query lifecycle (ops/inflight.py): delivery gathers index
+        # the all-gathered preferred-in-set plane — same observation
+        # convention as the synchronous round.
+        lat = inflight.draw_latency(k_sample, cfg, peers,
+                                    base.latency_weight)
+        lat = inflight.apply_partition(lat, cfg, base.round, offset,
+                                       peers, n_global)
+        ring = inflight.enqueue(base.inflight, base.round, peers, lat,
+                                responded, lie, polled)
+        records, changed, votes_applied = inflight.deliver_multi(
+            ring, base.records, cfg, packed_global, minority_t, k_vote,
+            base.round, t_local, live_rows=alive_local)
+    else:
+        yes_pack, consider_pack = exchange.gather_vote_packs(
+            packed_global, peers, responded, lie, k_vote, cfg, minority_t,
+            t_local)
 
-    records, changed = vr.register_packed_votes_engine(
-        base.records, yes_pack, consider_pack, cfg.k, cfg, update_mask=polled)
+        records, changed = vr.register_packed_votes_engine(
+            base.records, yes_pack, consider_pack, cfg.k, cfg,
+            update_mask=polled)
+        votes_applied = (av.popcnt_plane(consider_pack) * polled).sum()
 
     fin_after = vr.has_finalized(records.confidence, cfg)
     newly_final = fin_after & jnp.logical_not(fin)
@@ -207,8 +229,7 @@ def _local_round(
 
     telemetry = av.SimTelemetry(
         polls=_global_sum(polled.sum()),
-        votes_applied=_global_sum((av.popcnt_plane(consider_pack)
-                                   * polled).sum()),
+        votes_applied=_global_sum(votes_applied),
         flips=_global_sum((changed & jnp.logical_not(newly_final)).sum()),
         finalizations=_global_sum(newly_final.sum()),
         admissions=jnp.int32(0),
@@ -218,15 +239,18 @@ def _local_round(
         score_rank=base.score_rank, poll_order=base.poll_order,
         poll_order_inv=base.poll_order_inv, byzantine=base.byzantine,
         alive=alive, latency_weight=base.latency_weight,
-        finalized_at=finalized_at, round=base.round + 1, key=k_next)
+        finalized_at=finalized_at, round=base.round + 1, key=k_next,
+        inflight=ring)
     return DagSimState(new_base, state.conflict_set, state.n_sets,
                        state.set_size), telemetry
 
 
 def _shard_mapped(mesh, n_sets: int, fn, tel: bool = True,
                   set_size: Optional[int] = None,
-                  track_finality: bool = True):
-    specs = dag_state_specs(n_sets, set_size, track_finality)
+                  track_finality: bool = True,
+                  with_inflight: bool = False):
+    specs = dag_state_specs(n_sets, set_size, track_finality,
+                            with_inflight)
     if tel:
         tel_specs = av.SimTelemetry(*([P()] * len(av.SimTelemetry._fields)))
         out_specs = (specs, tel_specs)
@@ -247,13 +271,15 @@ def make_sharded_dag_round_step(mesh, cfg: AvalancheConfig = DEFAULT_CONFIG,
 
     def step(state: DagSimState):
         key = (state.base.records.votes.shape[0], state.n_sets,
-               state.set_size, state.base.finalized_at is not None)
+               state.set_size, state.base.finalized_at is not None,
+               state.base.inflight is not None)
         if key not in cache:
             n_global = key[0]
             cache[key] = jax.jit(_shard_mapped(
                 mesh, state.n_sets,
                 lambda s: _local_round(s, cfg, n_global, n_tx),
-                set_size=state.set_size, track_finality=key[3]),
+                set_size=state.set_size, track_finality=key[3],
+                with_inflight=key[4]),
                 donate_argnums=sharded._donate(donate))
         return cache[key](state)
 
@@ -308,5 +334,6 @@ def run_sharded_dag(
 
     fn = _shard_mapped(mesh, state.n_sets, local_run, tel=False,
                        set_size=state.set_size,
-                       track_finality=state.base.finalized_at is not None)
+                       track_finality=state.base.finalized_at is not None,
+                       with_inflight=state.base.inflight is not None)
     return jax.jit(fn, donate_argnums=sharded._donate(donate))(state)

@@ -50,6 +50,7 @@ from go_avalanche_tpu.models.streaming_dag import (
     StreamingDagState,
     StreamingDagTelemetry,
 )
+from go_avalanche_tpu.ops import inflight
 from go_avalanche_tpu.ops import voterecord as vr
 from go_avalanche_tpu.parallel import sharded, sharded_dag
 from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS, shard_map
@@ -58,10 +59,12 @@ from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS, shard_map
 def streaming_dag_state_specs(n_sets: int,
                               set_size=None,
                               track_finality: bool = True,
+                              with_inflight: bool = False,
                               ) -> StreamingDagState:
     """PartitionSpecs for every leaf of `StreamingDagState`."""
     return StreamingDagState(
-        dag=sharded_dag.dag_state_specs(n_sets, set_size, track_finality),
+        dag=sharded_dag.dag_state_specs(n_sets, set_size, track_finality,
+                                        with_inflight),
         slot_set=P(TXS_AXIS),
         slot_admit_round=P(TXS_AXIS),
         backlog=SetBacklog(score=P(), init_pref=P(), valid=P()),
@@ -92,7 +95,8 @@ def shard_streaming_dag_state(state: StreamingDagState,
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
         state, streaming_dag_state_specs(
             state.dag.n_sets, state.dag.set_size,
-            state.dag.base.finalized_at is not None))
+            state.dag.base.finalized_at is not None,
+            state.dag.base.inflight is not None))
 
 
 def _merge_rows(old, row_idx, rows, s_b):
@@ -296,6 +300,10 @@ def _local_retire_and_refill(
         poll_order=poll_order,
         poll_order_inv=poll_order_inv,
         finalized_at=finalized_at,
+        # In-flight responses for a retired set-slot must not land on its
+        # NEW occupant (see models/streaming_dag); columns are shard-local.
+        inflight=inflight.clear_columns(base.inflight,
+                                        jnp.repeat(settled | take, c)),
     )
     retired = lax.psum(settled.sum().astype(jnp.int32), TXS_AXIS)
     return StreamingDagState(
@@ -332,8 +340,10 @@ def _local_step(
 
 
 def _shard_mapped(mesh, n_sets: int, fn, with_tel=True, set_size=None,
-                  track_finality: bool = True):
-    specs = streaming_dag_state_specs(n_sets, set_size, track_finality)
+                  track_finality: bool = True,
+                  with_inflight: bool = False):
+    specs = streaming_dag_state_specs(n_sets, set_size, track_finality,
+                                      with_inflight)
     if with_tel:
         tel_specs = StreamingDagTelemetry(
             round=av.SimTelemetry(*([P()] * len(av.SimTelemetry._fields))),
@@ -357,13 +367,15 @@ def make_sharded_streaming_dag_step(mesh,
         c = state.backlog.score.shape[1]
         key = (state.dag.base.records.votes.shape[0], state.dag.n_sets, c,
                state.dag.set_size,
-               state.dag.base.finalized_at is not None)
+               state.dag.base.finalized_at is not None,
+               state.dag.base.inflight is not None)
         if key not in cache:
             n_global = key[0]
             cache[key] = jax.jit(_shard_mapped(
                 mesh, state.dag.n_sets,
                 lambda s: _local_step(s, cfg, c, n_global, n_tx),
-                set_size=state.dag.set_size, track_finality=key[4]),
+                set_size=state.dag.set_size, track_finality=key[4],
+                with_inflight=key[5]),
                 donate_argnums=sharded._donate(donate))
         return cache[key](state)
 
@@ -410,7 +422,8 @@ def run_sharded_streaming_dag(
     fn = _shard_mapped(mesh, state.dag.n_sets, local_run, with_tel=False,
                        set_size=state.dag.set_size,
                        track_finality=state.dag.base.finalized_at
-                       is not None)
+                       is not None,
+                       with_inflight=state.dag.base.inflight is not None)
     return jax.jit(fn, donate_argnums=sharded._donate(donate))(state)
 
 
@@ -434,5 +447,6 @@ def run_scan_sharded_streaming_dag(
 
     return jax.jit(_shard_mapped(
         mesh, state.dag.n_sets, local_scan, set_size=state.dag.set_size,
-        track_finality=state.dag.base.finalized_at is not None),
+        track_finality=state.dag.base.finalized_at is not None,
+        with_inflight=state.dag.base.inflight is not None),
         donate_argnums=sharded._donate(donate))(state)

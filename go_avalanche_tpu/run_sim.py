@@ -39,9 +39,33 @@ from go_avalanche_tpu.utils import metrics, tracing
 
 
 def build_config(args: argparse.Namespace) -> AvalancheConfig:
+    # Async axes: --timeout-rounds R maps to (time_step_s=1.0,
+    # request_timeout_s=R-1), which makes cfg.timeout_rounds() == R
+    # exactly; the seconds-based fields stay at reference defaults when
+    # the async engine is off so the synchronous configs are unchanged.
+    async_on = (args.latency_mode != "none" or args.partition is not None)
+    timing = {}
+    if async_on:
+        if args.timeout_rounds < 1:
+            raise SystemExit("--timeout-rounds must be >= 1 (a query "
+                             "needs at least one round to be answerable)")
+        timing = dict(time_step_s=1.0,
+                      request_timeout_s=float(args.timeout_rounds - 1))
+    partition = None
+    if args.partition is not None:
+        try:
+            start_s, end_s, frac_s = args.partition.split(",")
+            partition = (int(start_s), int(end_s), float(frac_s))
+        except ValueError:
+            raise SystemExit(f"--partition must be START,END,FRAC "
+                             f"(e.g. 50,150,0.5), got {args.partition!r}")
     return AvalancheConfig(
         finalization_score=args.finalization_score,
         max_element_poll=args.max_element_poll,
+        latency_mode=args.latency_mode,
+        latency_rounds=args.latency_rounds,
+        partition_spec=partition,
+        **timing,
         window=args.window,
         quorum=args.quorum,
         k=args.k,
@@ -348,6 +372,41 @@ def main(argv=None) -> Dict:
                         help="what a lying byzantine peer answers")
     parser.add_argument("--drop", type=float, default=0.0)
     parser.add_argument("--churn", type=float, default=0.0)
+    parser.add_argument("--latency-mode",
+                        choices=["none", "fixed", "geometric", "weighted"],
+                        default="none",
+                        help="async query lifecycle (ops/inflight.py): "
+                             "per-(querier, draw) response latency in "
+                             "rounds — 'fixed' = always "
+                             "--latency-rounds, 'geometric' = iid with "
+                             "that mean, 'weighted' = coupled to the "
+                             "latency_weight plane (nearest peer 0, "
+                             "farthest --latency-rounds; snowball has "
+                             "no such plane, so 'weighted' there "
+                             "degenerates to latency 0 — use "
+                             "fixed/geometric).  'none' = the "
+                             "synchronous ideal.  Works with every "
+                             "model; sequential vote mode only")
+    parser.add_argument("--latency-rounds", type=int, default=0,
+                        help="latency parameter (see --latency-mode); "
+                             "draws beyond --timeout-rounds expire "
+                             "unanswered")
+    parser.add_argument("--partition", type=str, default=None,
+                        metavar="START,END,FRAC",
+                        help="network partition: for rounds [START, END) "
+                             "split the nodes at FRAC (cluster-aligned "
+                             "with --clusters); cross-partition queries "
+                             "TIME OUT (expire unanswered) rather than "
+                             "silently vanishing, then the partition "
+                             "heals.  Turns on the async engine even "
+                             "with --latency-mode none")
+    parser.add_argument("--timeout-rounds", type=int, default=8,
+                        help="async modes: rounds before an outstanding "
+                             "query expires unanswered (the in-flight "
+                             "ring depth; maps onto request_timeout_s / "
+                             "time_step_s — host Processor reaping "
+                             "parity).  Expiry flows into "
+                             "--skip-absent-votes exactly like drops")
     parser.add_argument("--skip-absent-votes", action="store_true",
                         help="reference-HOST non-response semantics: a "
                              "dead/dropped peer registers NOTHING instead "

@@ -307,3 +307,93 @@ def test_bounded_restore_bit_identical(tmp_path):
     blocked = restore_checkpoint(p, tmpl(), max_transfer_bytes=256)
     assert_states_equal(whole, blocked)
     assert_states_equal(state, blocked)
+
+
+# ---------------------------------------------------------------------------
+# Async query engine: mid-flight ring buffers round-trip both backends
+# (PR 3).  The saved state has a NON-EMPTY in-flight ring and an ACTIVE
+# partition; restore must resume the identical trajectory — pending
+# deliveries, scheduled expiries and the cut included.
+
+
+def _async_cfg():
+    import dataclasses
+    return dataclasses.replace(
+        AvalancheConfig(finalization_score=16),
+        latency_mode="fixed", latency_rounds=2,
+        partition_spec=(2, 12, 0.5),
+        time_step_s=1.0, request_timeout_s=4.0)
+
+
+def _async_step(cfg):
+    import functools
+    return jax.jit(functools.partial(av.round_step, cfg=cfg))
+
+
+def _mid_flight_state(cfg, rounds=4):
+    # 4 rounds in: rounds 2/3 issued under the active partition, their
+    # cross-cut entries pending expiry; rounds 2+'s intra-side entries
+    # pending delivery — the ring is genuinely non-empty.
+    state = av.init(jax.random.key(7), 24, 8, cfg,
+                    init_pref=av.contested_init_pref(7, 24, 8))
+    step = _async_step(cfg)
+    for _ in range(rounds):
+        state, _ = step(state)
+    assert bool(np.asarray(state.inflight.polled).any()), \
+        "test premise: pending queries in the ring"
+    return state
+
+
+def test_async_mid_flight_roundtrip_npz(tmp_path):
+    cfg = _async_cfg()
+    state = _mid_flight_state(cfg)
+    path = str(tmp_path / "async.npz")
+    save_checkpoint(path, state)
+    restored = restore_checkpoint(path, av.init(jax.random.key(0), 24, 8,
+                                                cfg))
+    assert_states_equal(state, restored)
+
+    # Trajectory bit-parity with the uninterrupted run, THROUGH the
+    # partition heal and the post-heal expiry tail.
+    step = _async_step(cfg)
+    for _ in range(14):
+        state, _ = step(state)
+        restored, _ = step(restored)
+    assert_states_equal(state, restored)
+
+
+@pytest.mark.slow
+def test_async_mid_flight_roundtrip_orbax(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    from go_avalanche_tpu.utils.checkpoint import (
+        restore_checkpoint_orbax,
+        save_checkpoint_orbax,
+    )
+
+    cfg = _async_cfg()
+    state = _mid_flight_state(cfg)
+    path = str(tmp_path / "async_orbax")
+    save_checkpoint_orbax(path, state)
+    restored = restore_checkpoint_orbax(path,
+                                        av.init(jax.random.key(0), 24, 8,
+                                                cfg))
+    assert_states_equal(state, restored)
+    step = _async_step(cfg)
+    for _ in range(14):
+        state, _ = step(state)
+        restored, _ = step(restored)
+    assert_states_equal(state, restored)
+
+
+def test_async_checkpoint_rejects_sync_template(tmp_path):
+    # A ring-carrying checkpoint must refuse a ring-less template (and
+    # vice versa) with the structural leaf-count error, not a silent
+    # partial restore.
+    cfg = _async_cfg()
+    state = _mid_flight_state(cfg)
+    path = str(tmp_path / "async_vs_sync.npz")
+    save_checkpoint(path, state)
+    sync_template = av.init(jax.random.key(0), 24, 8,
+                            AvalancheConfig(finalization_score=16))
+    with pytest.raises(ValueError, match="leaves"):
+        restore_checkpoint(path, sync_template)

@@ -1,0 +1,548 @@
+"""The async query lifecycle (`ops/inflight.py`): latency-0 bit-parity,
+delayed delivery, timeout expiry, partition faults, ring hygiene.
+
+The load-bearing pin is the GOLDEN PARITY MATRIX: with the in-flight
+engine ON but every latency drawn 0, each model's trajectory must be
+bit-identical to the synchronous round on every config axis — the async
+engine is a strict superset of the scale path, never a fork of it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_avalanche_tpu.config import (
+    AdversaryStrategy,
+    AvalancheConfig,
+    VoteMode,
+)
+from go_avalanche_tpu.models import avalanche as av
+from go_avalanche_tpu.models import dag, snowball as sb
+from go_avalanche_tpu.ops import inflight, voterecord as vr
+
+# Timing that makes cfg.timeout_rounds() == 4 (ring depth 5).
+TIMING = dict(time_step_s=1.0, request_timeout_s=3.0)
+
+
+
+def jit_step(step_fn, cfg):
+    """One jitted (state) -> (state, telemetry) step per config: the
+    parity matrix replays many rounds, and eager per-op dispatch of the
+    delivery fori_loop would dominate the suite's wall clock."""
+    import functools
+
+    @functools.partial(jax.jit)
+    def step(s):
+        return step_fn(s, cfg)
+
+    return step
+
+def async0(cfg: AvalancheConfig, **kw) -> AvalancheConfig:
+    """The latency-0 async twin of a synchronous config."""
+    return dataclasses.replace(cfg, latency_mode="fixed", latency_rounds=0,
+                               **TIMING, **kw)
+
+
+def assert_records_equal(a: vr.VoteRecordState, b: vr.VoteRecordState,
+                         ctx=""):
+    for name in ("votes", "consider", "confidence"):
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(getattr(a, name))),
+            np.asarray(jax.device_get(getattr(b, name))),
+            err_msg=f"{ctx}: {name} plane diverged")
+
+
+# ---------------------------------------------------------------------------
+# Config surface
+
+
+def test_timeout_rounds_host_arithmetic():
+    # floor(timeout/dt)+1 — the first age is_expired() reports True
+    # (types.py: timestamp + timeout < now, strict).
+    assert AvalancheConfig(time_step_s=1.0,
+                           request_timeout_s=3.0).timeout_rounds() == 4
+    assert AvalancheConfig(time_step_s=0.5,
+                           request_timeout_s=3.0).timeout_rounds() == 7
+    assert AvalancheConfig(time_step_s=1.0,
+                           request_timeout_s=3.5).timeout_rounds() == 4
+    # Float division noise must not shift the boundary: 60/0.01 = 6000.
+    assert AvalancheConfig().timeout_rounds() == 6001
+
+
+def test_async_requires_sequential_vote_mode():
+    with pytest.raises(ValueError, match="SEQUENTIAL"):
+        AvalancheConfig(latency_mode="fixed", vote_mode=VoteMode.MAJORITY,
+                        **TIMING)
+
+
+def test_async_rejects_oversized_ring():
+    # Default request_timeout_s=60 / time_step_s=0.01 -> 6001 rounds.
+    with pytest.raises(ValueError, match="timeout_rounds"):
+        AvalancheConfig(latency_mode="fixed")
+
+
+def test_partition_spec_validation():
+    with pytest.raises(ValueError, match="start < end"):
+        AvalancheConfig(partition_spec=(10, 10, 0.5), **TIMING)
+    with pytest.raises(ValueError, match="split_frac"):
+        AvalancheConfig(partition_spec=(0, 10, 1.0), **TIMING)
+    # partition alone turns the engine on (latency_mode may stay "none").
+    cfg = AvalancheConfig(partition_spec=(0, 10, 0.5), **TIMING)
+    assert cfg.async_queries()
+
+
+# ---------------------------------------------------------------------------
+# Latency-0 golden parity matrix
+
+AXES = {
+    "default": dict(),
+    "byz_flip": dict(byzantine_fraction=0.25),
+    "byz_equivocate": dict(byzantine_fraction=0.25,
+                           adversary_strategy=AdversaryStrategy.EQUIVOCATE,
+                           flip_probability=0.7),
+    "byz_oppose": dict(byzantine_fraction=0.25,
+                       adversary_strategy=AdversaryStrategy.OPPOSE_MAJORITY),
+    "drops": dict(drop_probability=0.2),
+    "drops_skip": dict(drop_probability=0.2, skip_absent_votes=True),
+    "churn": dict(churn_probability=0.02),
+    "window5_quorum4": dict(window=5, quorum=4, k=5),
+    "legacy_exchange": dict(fused_exchange=False),
+    "swar_ingest": dict(ingest_engine="swar32"),
+    "weighted": dict(weighted_sampling=True),
+    "clustered": dict(n_clusters=2, cluster_locality=0.9),
+}
+
+
+# Per-axis compiles cost ~5 s each on the CPU gate; a representative
+# core runs in tier-1, the rest of the matrix rides the slow lane.
+FAST_AXES_AV = ("default", "byz_equivocate", "drops_skip",
+                "legacy_exchange")
+
+
+@pytest.mark.parametrize(
+    "axis", [a if a in FAST_AXES_AV else
+             pytest.param(a, marks=pytest.mark.slow)
+             for a in sorted(AXES)])
+def test_latency0_parity_avalanche(axis):
+    sync = AvalancheConfig(finalization_score=16, **AXES[axis])
+    asy = async0(sync)
+    pref = av.contested_init_pref(0, 24, 12)
+    s1 = av.init(jax.random.key(0), 24, 12, sync, init_pref=pref)
+    s2 = av.init(jax.random.key(0), 24, 12, asy, init_pref=pref)
+    assert s2.inflight is not None and s1.inflight is None
+    step1, step2 = jit_step(av.round_step, sync), jit_step(av.round_step, asy)
+    for r in range(10):
+        s1, t1 = step1(s1)
+        s2, t2 = step2(s2)
+        assert_records_equal(s1.records, s2.records, f"{axis} round {r}")
+        np.testing.assert_array_equal(np.asarray(s1.finalized_at),
+                                      np.asarray(s2.finalized_at))
+        assert int(t1.votes_applied) == int(t2.votes_applied), (axis, r)
+        assert int(t1.flips) == int(t2.flips), (axis, r)
+        assert int(t1.finalizations) == int(t2.finalizations), (axis, r)
+
+
+@pytest.mark.parametrize(
+    "axis", ["default", "byz_equivocate"]
+    + [pytest.param(a, marks=pytest.mark.slow)
+       for a in ("drops", "drops_skip", "swar_ingest")])
+def test_latency0_parity_dag(axis):
+    sync = AvalancheConfig(finalization_score=16, **AXES[axis])
+    asy = async0(sync)
+    cs = jnp.arange(12, dtype=jnp.int32) // 2
+    d1 = dag.init(jax.random.key(1), 24, cs, sync)
+    d2 = dag.init(jax.random.key(1), 24, cs, asy)
+    step1, step2 = jit_step(dag.round_step, sync), jit_step(dag.round_step, asy)
+    for r in range(10):
+        d1, _ = step1(d1)
+        d2, _ = step2(d2)
+        assert_records_equal(d1.base.records, d2.base.records,
+                             f"{axis} round {r}")
+
+
+@pytest.mark.parametrize(
+    "axis", ["default", "drops_skip"]
+    + [pytest.param(a, marks=pytest.mark.slow)
+       for a in ("byz_flip", "byz_equivocate", "byz_oppose", "drops",
+                 "churn", "window5_quorum4")])
+def test_latency0_parity_snowball(axis):
+    sync = AvalancheConfig(finalization_score=16, **AXES[axis])
+    asy = async0(sync)
+    s1 = sb.init(jax.random.key(2), 48, sync, yes_fraction=0.5)
+    s2 = sb.init(jax.random.key(2), 48, asy, yes_fraction=0.5)
+    step1, step2 = jit_step(sb.round_step, sync), jit_step(sb.round_step, asy)
+    for r in range(12):
+        s1, _ = step1(s1)
+        s2, _ = step2(s2)
+        assert_records_equal(s1.records, s2.records, f"{axis} round {r}")
+
+
+@pytest.mark.slow
+def test_weighted_latency_uniform_weights_is_synchronous():
+    # The "weighted" latency coupling degenerates to 0 rounds on uniform
+    # weights — bit-exact with the synchronous round by construction.
+    sync = AvalancheConfig(finalization_score=16)
+    asy = dataclasses.replace(sync, latency_mode="weighted",
+                              latency_rounds=3, **TIMING)
+    s1 = av.init(jax.random.key(3), 16, 8, sync)
+    s2 = av.init(jax.random.key(3), 16, 8, asy)
+    step1, step2 = jit_step(av.round_step, sync), jit_step(av.round_step, asy)
+    for _ in range(8):
+        s1, _ = step1(s1)
+        s2, _ = step2(s2)
+    assert_records_equal(s1.records, s2.records, "weighted-uniform")
+
+
+# ---------------------------------------------------------------------------
+# Delayed delivery
+
+
+def test_fixed_latency_defers_ingest_by_exactly_L():
+    cfg = dataclasses.replace(AvalancheConfig(finalization_score=16),
+                              latency_mode="fixed", latency_rounds=2,
+                              **TIMING)
+    s = av.init(jax.random.key(0), 16, 8, cfg)
+    step = jit_step(av.round_step, cfg)
+    for r in range(2):   # rounds 0, 1: every response still in flight
+        s, tel = step(s)
+        assert int(tel.votes_applied) == 0, r
+        assert (np.asarray(s.records.votes) == 0).all(), r
+    s, tel = step(s)   # round 2 delivers round 0's polls
+    assert int(tel.votes_applied) > 0
+
+
+@pytest.mark.slow
+def test_latency_shifted_trajectory_matches_synchronous_records():
+    # With fixed latency L (and nothing expiring), delivered votes are
+    # the same exchanges the synchronous run performs, L rounds later:
+    # after R+L async rounds the records equal the synchronous run's
+    # after R rounds (same key; responses read delivery-round state,
+    # which for the all-accepted unanimous prior never differs).
+    sync = AvalancheConfig(finalization_score=0x7FFE)
+    lat = 2
+    asy = dataclasses.replace(sync, latency_mode="fixed",
+                              latency_rounds=lat, **TIMING)
+    s1 = av.init(jax.random.key(5), 16, 8, sync)
+    s2 = av.init(jax.random.key(5), 16, 8, asy)
+    step1, step2 = jit_step(av.round_step, sync), jit_step(av.round_step, asy)
+    rounds = 6
+    for _ in range(rounds):
+        s1, _ = step1(s1)
+    for _ in range(rounds + lat):
+        s2, _ = step2(s2)
+    # Unanimous-prior network: every response is YES regardless of the
+    # round it reads, so the delayed ingest replays the same votes.
+    assert_records_equal(s1.records, s2.records, "shifted")
+
+
+def test_geometric_latency_converges():
+    cfg = dataclasses.replace(
+        AvalancheConfig(finalization_score=16), latency_mode="geometric",
+        latency_rounds=2, time_step_s=1.0, request_timeout_s=7.0)
+    s = av.init(jax.random.key(1), 32, 8, cfg,
+                init_pref=av.contested_init_pref(1, 32, 8))
+    out = av.run(s, cfg, max_rounds=500)
+    fin = vr.has_finalized(out.records.confidence, cfg)
+    assert bool(np.asarray(fin).all())
+    assert int(out.round) < 500
+
+
+# ---------------------------------------------------------------------------
+# Timeout expiry
+
+
+def test_latency_at_timeout_never_delivers_skip_registers_nothing():
+    # Reference-HOST semantics: an expired response never reaches
+    # RegisterVotes — records stay bit-identical to init forever.
+    base = AvalancheConfig(finalization_score=16, skip_absent_votes=True)
+    cfg = dataclasses.replace(base, latency_mode="fixed",
+                              latency_rounds=4, **TIMING)   # timeout == 4
+    s = av.init(jax.random.key(0), 16, 8, cfg)
+    init_records = s.records
+    step = jit_step(av.round_step, cfg)
+    for _ in range(3 * inflight.ring_depth(cfg)):   # ring wraps twice+
+        s, tel = step(s)
+        assert int(tel.votes_applied) == 0
+    assert_records_equal(s.records, init_records, "expired-skip")
+
+
+def test_latency_at_timeout_expires_as_neutral_shift_by_default():
+    # Delivered-neutral semantics: the expiry shifts the window with its
+    # consider bit off at EXACTLY issue+timeout — confidence can never
+    # move (no considered votes), consider stays 0.
+    cfg = dataclasses.replace(AvalancheConfig(finalization_score=16),
+                              latency_mode="fixed", latency_rounds=4,
+                              **TIMING)
+    s = av.init(jax.random.key(0), 16, 8, cfg)
+    conf0 = np.asarray(s.records.confidence).copy()
+    timeout = cfg.timeout_rounds()
+    step = jit_step(av.round_step, cfg)
+    for r in range(timeout):     # ages 0..timeout-1: nothing registers
+        s, _ = step(s)
+        assert (np.asarray(s.records.votes) == 0).all(), r
+    s, _ = step(s)  # round `timeout` expires round 0's polls
+    assert (np.asarray(s.records.consider) == 0).all()
+    np.testing.assert_array_equal(np.asarray(s.records.confidence), conf0)
+    # The window DID shift k times (raw yes bits, consider off).
+    assert (np.asarray(s.records.votes) != 0).any()
+
+
+def test_max_deliverable_latency_is_timeout_minus_one():
+    # lat == timeout-1 delivers (host: a response at age a is accepted
+    # iff a*dt <= timeout_s); lat == timeout expires.  Both sides of the
+    # boundary in one pin.
+    base = AvalancheConfig(finalization_score=16, skip_absent_votes=True)
+    deliver = dataclasses.replace(base, latency_mode="fixed",
+                                  latency_rounds=3, **TIMING)
+    s = av.init(jax.random.key(0), 16, 8, deliver)
+    step = jit_step(av.round_step, deliver)
+    applied = 0
+    for _ in range(6):
+        s, tel = step(s)
+        applied += int(tel.votes_applied)
+    assert applied > 0
+
+
+# ---------------------------------------------------------------------------
+# Partition faults
+
+
+def test_full_partition_isolates_sides():
+    # Two clusters with OPPOSITE unanimous priors, partitioned for the
+    # whole run under skip semantics: each side only ever hears its own
+    # side, so both converge to their own color — no cross-partition
+    # contamination (a drop model cannot make this distinction: it
+    # thins both sides symmetrically forever instead of cleanly until
+    # heal).
+    n, t = 32, 4
+    cfg = AvalancheConfig(finalization_score=16, n_clusters=2,
+                          cluster_locality=0.5, skip_absent_votes=True,
+                          partition_spec=(0, 10_000, 0.5), **TIMING)
+    pref = jnp.concatenate([jnp.ones((n // 2, t), jnp.bool_),
+                            jnp.zeros((n // 2, t), jnp.bool_)])
+    s = av.init(jax.random.key(0), n, t, cfg, init_pref=pref)
+    step = jit_step(av.round_step, cfg)
+    for _ in range(60):
+        s, _ = step(s)
+    acc = np.asarray(vr.is_accepted(s.records.confidence))
+    assert acc[: n // 2].all(), "side A lost its unanimous YES"
+    assert not acc[n // 2:].any(), "side B lost its unanimous NO"
+    fin = np.asarray(vr.has_finalized(s.records.confidence, cfg))
+    assert fin.all(), "isolated sides must still finalize intra-side"
+
+
+def test_partition_stalls_then_recovers():
+    # The examples/partition_outage.py acceptance shape, small: under the
+    # default neutral semantics a 50/50 cut stalls finalization (each
+    # window is half unanswered expiries -> the 7-of-8 quorum almost
+    # never fires), and healing recovers it.
+    from examples.partition_outage import measure
+
+    r = measure(nodes=128, txs=16, partition_start=5, partition_end=45,
+                timeout_rounds=4, latency_rounds=1, finalization_score=48,
+                n_rounds=110, skip_absent=False, seed=0)
+    assert r["finalized_fraction_at_heal"] < 0.1, "no stall"
+    assert r["finalized_fraction_final"] > 0.95, "no recovery"
+    assert r["post_heal_finalizations"] > 0
+
+
+def test_partition_heal_trails_by_timeout():
+    # Queries issued just before the heal still expire: the first
+    # post-heal rounds keep ingesting expiries, so cross-side votes only
+    # resume at heal + latency.  Pin: with latency 1, a query issued at
+    # heal-1 across the cut expires at heal-1+timeout, i.e. votes
+    # DELIVERED from the other side first appear at heal + 1.
+    cfg = AvalancheConfig(finalization_score=0x7FFE,
+                          skip_absent_votes=True, k=8,
+                          partition_spec=(0, 10, 0.5),
+                          latency_mode="fixed", latency_rounds=1, **TIMING)
+    n, t = 16, 4
+    pref = jnp.concatenate([jnp.ones((n // 2, t), jnp.bool_),
+                            jnp.zeros((n // 2, t), jnp.bool_)])
+    s = av.init(jax.random.key(4), n, t, cfg, init_pref=pref)
+    step = jit_step(av.round_step, cfg)
+    saw_no_vote_on_side_a = []
+    for r in range(16):
+        s, _ = step(s)
+        # Side A is unanimous YES; any NO bit in a side-A window came
+        # from side B (cross-cut delivery).
+        votes = np.asarray(s.records.votes[: n // 2])
+        cons = np.asarray(s.records.consider[: n // 2])
+        saw_no_vote_on_side_a.append(bool((cons & ~votes).any()))
+    # Rounds are 0-indexed; heal at round 10, latency 1 -> the first
+    # cross-side delivery lands in round 11 (index 11).
+    assert not any(saw_no_vote_on_side_a[:11])
+    assert any(saw_no_vote_on_side_a[11:])
+
+
+# ---------------------------------------------------------------------------
+# Kernel-level pins
+
+
+def test_present_kernel_matches_two_plane_kernel_when_all_present():
+    rng = np.random.default_rng(0)
+    cfg = AvalancheConfig()
+    shape = (64,)
+    state = vr.VoteRecordState(
+        votes=jnp.asarray(rng.integers(0, 256, shape), jnp.uint8),
+        consider=jnp.asarray(rng.integers(0, 256, shape), jnp.uint8),
+        confidence=jnp.asarray(rng.integers(0, 2 ** 16, shape), jnp.uint16),
+    )
+    yes = jnp.asarray(rng.integers(0, 256, shape), jnp.uint8)
+    cons = jnp.asarray(rng.integers(0, 256, shape), jnp.uint8)
+    ones = jnp.full(shape, 0xFF, jnp.uint8)
+    a, ch_a = vr.register_packed_votes(state, yes, cons, 8, cfg,
+                                       absent_is_skip=False)
+    b, ch_b = vr.register_packed_votes_present(state, yes, cons, ones, 8,
+                                               cfg)
+    assert_records_equal(a, b, "present=ones")
+    np.testing.assert_array_equal(np.asarray(ch_a), np.asarray(ch_b))
+
+
+def test_present_kernel_absent_slots_register_nothing():
+    cfg = AvalancheConfig()
+    state = vr.init_state(jnp.ones((8,), jnp.bool_))
+    yes = jnp.full((8,), 0xFF, jnp.uint8)
+    cons = jnp.full((8,), 0xFF, jnp.uint8)
+    none_present = jnp.zeros((8,), jnp.uint8)
+    out, changed = vr.register_packed_votes_present(state, yes, cons,
+                                                    none_present, 8, cfg)
+    assert_records_equal(out, state, "all-absent")
+    assert not bool(np.asarray(changed).any())
+
+
+def test_clear_columns_drops_pending_updates():
+    cfg = dataclasses.replace(AvalancheConfig(), latency_mode="fixed",
+                              latency_rounds=1, **TIMING)
+    ring = inflight.init_ring(cfg, rows=4, t=6)
+    ring = ring._replace(polled=jnp.ones_like(ring.polled))
+    cols = jnp.asarray([True, False, True, False, False, False])
+    cleared = inflight.clear_columns(ring, cols)
+    polled = np.asarray(cleared.polled)
+    assert not polled[:, :, [0, 2]].any()
+    assert polled[:, :, [1, 3, 4, 5]].all()
+    assert inflight.clear_columns(None, cols) is None
+
+
+def test_finalized_mid_flight_records_ignore_late_votes():
+    # A record that finalizes while a query is in flight must not ingest
+    # the late response (the reference DELETES finalized records;
+    # processor.go:114-116).  Finalize by hand between issue and
+    # delivery and check the record is frozen.
+    cfg = dataclasses.replace(AvalancheConfig(finalization_score=16),
+                              latency_mode="fixed", latency_rounds=2,
+                              **TIMING)
+    s = av.init(jax.random.key(0), 16, 8, cfg)
+    step = jit_step(av.round_step, cfg)
+    s, _ = step(s)   # round 0 issued, delivers at round 2
+    forced = s.records.confidence.at[:, 0].set(
+        jnp.uint16((16 << 1) | 1))  # finalized-accepted
+    s = s._replace(records=s.records._replace(confidence=forced))
+    snap_votes = np.asarray(s.records.votes[:, 0]).copy()
+    for _ in range(4):
+        s, _ = step(s)
+    np.testing.assert_array_equal(np.asarray(s.records.votes[:, 0]),
+                                  snap_votes)
+    np.testing.assert_array_equal(np.asarray(s.records.confidence[:, 0]),
+                                  np.asarray(forced[:, 0]))
+
+
+# ---------------------------------------------------------------------------
+# Streaming schedulers inherit the engine
+
+
+def test_backlog_streams_with_latency():
+    from go_avalanche_tpu.models import backlog as bl
+
+    cfg = dataclasses.replace(AvalancheConfig(finalization_score=8),
+                              latency_mode="fixed", latency_rounds=1,
+                              **TIMING)
+    b = bl.make_backlog(jnp.arange(24, dtype=jnp.int32))
+    st = bl.init(jax.random.key(0), 16, 8, b, cfg)
+    assert st.sim.inflight is not None
+    final = jax.jit(bl.run, static_argnames=("cfg", "max_rounds"))(
+        st, cfg, 3000)
+    assert bool(np.asarray(jax.device_get(final.outputs.settled)).all())
+
+
+@pytest.mark.slow
+def test_streaming_dag_streams_with_latency():
+    from go_avalanche_tpu.models import streaming_dag as sd
+
+    cfg = dataclasses.replace(AvalancheConfig(finalization_score=8),
+                              latency_mode="fixed", latency_rounds=1,
+                              **TIMING)
+    backlog = sd.make_set_backlog(
+        jnp.arange(16, dtype=jnp.int32).reshape(8, 2))
+    st = sd.init(jax.random.key(0), 12, 3, backlog, cfg)
+    final = jax.jit(sd.run, static_argnames=("cfg", "max_rounds"))(
+        st, cfg, 3000)
+    summary = sd.resolution_summary(final)
+    assert summary["sets_settled_fraction"] == 1.0
+    assert summary["sets_one_winner_fraction"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Review-hardening pins (PR 3 code review)
+
+
+def test_zero_timeout_rejected():
+    # timeout_rounds() < 1 would make every query expire before any
+    # response could deliver — a silent livelock for run-until-settled
+    # drivers, so the config refuses it outright.
+    with pytest.raises(ValueError, match="timeout_rounds\\(\\) >= 1"):
+        AvalancheConfig(latency_mode="fixed", time_step_s=1.0,
+                        request_timeout_s=-1.0)
+
+
+def test_dead_querier_freezes_inflight_ingest():
+    # A querier that churns DEAD while its query is in flight must not
+    # ingest the late response — the synchronous round's dead-node
+    # freeze (`polled & alive`) extends to delivery time.
+    cfg = dataclasses.replace(AvalancheConfig(finalization_score=16),
+                              latency_mode="fixed", latency_rounds=2,
+                              **TIMING)
+    s = av.init(jax.random.key(0), 16, 8, cfg)
+    step = jit_step(av.round_step, cfg)
+    s, _ = step(s)                       # round 0 issued, delivers round 2
+    s = s._replace(alive=s.alive.at[0].set(False))   # node 0 dies
+    row0 = jax.tree.map(lambda x: np.asarray(x[0]).copy(), s.records)
+    for _ in range(4):                   # deliveries + expiries pass by
+        s, _ = step(s)
+    assert_records_equal(
+        vr.VoteRecordState(*[jnp.asarray(getattr(row0, f))
+                             for f in row0._fields]),
+        jax.tree.map(lambda x: x[0], s.records), "dead querier")
+    # A live node DID ingest over the same rounds (positive control).
+    assert (np.asarray(s.records.votes[1:]) != 0).any()
+
+
+def test_partition_split_cluster_aligned_and_interior():
+    # The cluster-aligned split snaps to an INTERIOR cluster boundary:
+    # extreme fracs must not collapse to a 1-node cut that straddles a
+    # cluster, and a 0.5 frac at odd cluster counts must not fall to
+    # banker's rounding.
+    timing = dict(time_step_s=1.0, request_timeout_s=3.0)
+    n = 40
+
+    def cut_rows(n_clusters, frac):
+        cfg = AvalancheConfig(n_clusters=n_clusters, partition_spec=(0, 10, frac),
+                              **timing)
+        peers = jnp.zeros((n, 1), jnp.int32)      # everyone queries node 0
+        lat = jnp.zeros((n, 1), jnp.int32)
+        out = inflight.apply_partition(lat, cfg, jnp.int32(0), 0, peers, n)
+        # rows whose latency became the sentinel are on the far side of 0
+        return int((np.asarray(out)[:, 0] == cfg.timeout_rounds()).sum())
+
+    # 4 clusters of 10: frac 0.1 rounds to the FIRST interior boundary
+    # (10 nodes with node 0), never a 1-node cut.
+    assert cut_rows(4, 0.1) == n - 10
+    assert cut_rows(4, 0.99) == n - 30      # last interior boundary
+    # 5 clusters of 8, frac 0.5: floor(2.5+0.5)=3 clusters on side A
+    # (deterministic half-up, not banker's round(2.5)=2).
+    assert cut_rows(5, 0.5) == n - 24

@@ -173,3 +173,98 @@ def test_random_node_selection_draws_from_available():
     seen = {p.get_suitable_node_to_query() for _ in range(100)}
     assert seen <= set(range(8))
     assert len(seen) > 1  # actually random, not always-lowest
+
+
+# ---------------------------------------------------------------------------
+# Cross-twin timeout parity: the host Processor's request_timeout_s reaping
+# and the batched async engine's round-count expiry must register the
+# IDENTICAL outcome for the same query pattern (PR 3 acceptance).
+
+
+def _host_record_bits(p, h):
+    vr = p._vote_records[h]
+    return (vr.votes, vr.consider, vr.confidence)
+
+
+def _batched_record_bits(state, node, tx):
+    import numpy as np
+    return (int(np.asarray(state.records.votes)[node, tx]),
+            int(np.asarray(state.records.consider)[node, tx]),
+            int(np.asarray(state.records.confidence)[node, tx]))
+
+
+def _run_batched_single_query(latency_rounds, n_rounds):
+    """2 nodes, 1 tx, k=1: each node polls the other once per round with
+    a fixed response latency; reference-HOST absence semantics (an
+    expired response registers NOTHING — `skip_absent_votes`)."""
+    import dataclasses
+
+    import jax
+
+    from go_avalanche_tpu.models import avalanche as av
+
+    # timeout_rounds() == 4: request_timeout_s 3.0 at time_step 1.0 —
+    # the host side below uses the same 3-second timeout so both twins
+    # expire the same query ages.
+    cfg = dataclasses.replace(
+        AvalancheConfig(k=1, skip_absent_votes=True),
+        latency_mode="fixed", latency_rounds=latency_rounds,
+        time_step_s=1.0, request_timeout_s=3.0)
+    state = av.init(jax.random.key(0), 2, 1, cfg)
+    for _ in range(n_rounds):
+        state, _ = av.round_step(state, cfg)
+    return state, cfg
+
+
+def _run_host_single_query(answer_delay_s, timeout_s=3.0):
+    """One strict-mode poll answered (or not) after `answer_delay_s`."""
+    import dataclasses
+
+    from go_avalanche_tpu import Tx
+
+    cfg = dataclasses.replace(STRICT, request_timeout_s=timeout_s)
+    connman = Connman()
+    connman.add_node(0)
+    clock = StubClock(0.0)
+    p = Processor(connman, cfg, clock=clock)
+    t = Tx(7, is_accepted=True)
+    assert p.add_target_to_reconcile(t)
+    r = p.get_round()
+    p.event_loop()                      # query recorded at t=0
+    clock.advance(answer_delay_s)
+    accepted = p.register_votes(0, Response(r, 0, [Vote(0, 7)]), [])
+    return p, t, accepted
+
+
+def test_cross_twin_timeout_expiry_outcome_identical():
+    # EXPIRED: the host advances past request_timeout_s and rejects the
+    # response; the batched engine runs the equivalent round count with
+    # an undeliverable latency.  Both must leave the record at its
+    # initial bits (nothing registered).
+    p, t, accepted = _run_host_single_query(answer_delay_s=4.0)
+    assert not accepted                    # is_expired: 0 + 3 < 4
+    host_bits = _host_record_bits(p, t.hash())
+
+    cfg_probe = AvalancheConfig(time_step_s=1.0, request_timeout_s=3.0)
+    timeout = cfg_probe.timeout_rounds()   # 4 rounds == the 4 s above
+    state, cfg = _run_batched_single_query(latency_rounds=timeout,
+                                           n_rounds=timeout + 3)
+    batched_bits = _batched_record_bits(state, 0, 0)
+    assert host_bits == batched_bits == (0, 0, 1)
+
+
+def test_cross_twin_delivered_outcome_identical():
+    # DELIVERED: the same query pattern answered INSIDE the timeout must
+    # ingest the identical single yes vote in both twins (positive
+    # control for the expiry pin; is_expired is strict, so an answer at
+    # exactly timeout_s is still accepted).
+    p, t, accepted = _run_host_single_query(answer_delay_s=3.0)
+    assert accepted
+    host_bits = _host_record_bits(p, t.hash())
+
+    # Deliverable latency: timeout_rounds()-1 == 3 rounds — the batched
+    # twin of "answered at exactly the timeout".  Run exactly enough
+    # rounds for ONE response to arrive (round 0's, at round 3).
+    state, cfg = _run_batched_single_query(latency_rounds=3, n_rounds=4)
+    batched_bits = _batched_record_bits(state, 0, 0)
+    assert host_bits == batched_bits == (1, 1, 1)

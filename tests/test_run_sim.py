@@ -230,3 +230,37 @@ def test_cli_sharded_donate(capsys):
                    "--finalization-score", "16", "--mesh", "4,2",
                    "--donate", "--json"])
     assert result["finalized_fraction"] == 1.0
+
+
+@pytest.mark.slow
+def test_cli_async_latency_flags(capsys):
+    result = main(["--model", "avalanche", "--nodes", "48", "--txs", "12",
+                   "--finalization-score", "16", "--latency-mode", "fixed",
+                   "--latency-rounds", "1", "--timeout-rounds", "6",
+                   "--json"])
+    assert result["finalized_fraction"] == 1.0
+
+
+@pytest.mark.slow
+def test_cli_partition_heals(capsys):
+    result = main(["--model", "snowball", "--nodes", "64",
+                   "--finalization-score", "16", "--partition", "2,20,0.5",
+                   "--timeout-rounds", "4", "--yes-fraction", "1.0",
+                   "--json"])
+    assert result["finalized_fraction"] == 1.0
+
+
+@pytest.mark.slow
+def test_cli_async_mesh_with_donate(capsys):
+    result = main(["--model", "avalanche", "--nodes", "32", "--txs", "16",
+                   "--finalization-score", "16", "--latency-mode",
+                   "geometric", "--latency-rounds", "1",
+                   "--timeout-rounds", "6", "--mesh", "4,2", "--donate",
+                   "--json"])
+    assert result["finalized_fraction"] == 1.0
+
+
+def test_cli_partition_flag_parse_error():
+    with pytest.raises(SystemExit):
+        main(["--model", "snowball", "--partition", "not-a-spec",
+              "--json"])
