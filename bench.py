@@ -86,6 +86,8 @@ def flagship_program(cfg, n_rounds: int):
 def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
           repeats: int = 3, exchange: str = "fused",
           ingest: str = "u8", latency: int = 0,
+          latency_mode: str = "fixed", timeout_rounds: int | None = None,
+          inflight: str = "walk",
           profile: bool = False) -> dict:
     import dataclasses
 
@@ -99,7 +101,10 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
     # max_element_poll >= n_txs so the poll cap never freezes records the
     # vote count below assumes are live.  Shared builder: roofline.py
     # measures phase bandwidth on this exact construction.
-    state, cfg = flagship_state(n_nodes, n_txs, k, latency)
+    state, cfg = flagship_state(n_nodes, n_txs, k, latency,
+                                latency_mode=latency_mode,
+                                timeout_rounds=timeout_rounds,
+                                inflight_engine=inflight)
     if exchange != "fused":
         cfg = dataclasses.replace(cfg, fused_exchange=False)
     if ingest != "u8":
@@ -134,6 +139,15 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
     engine_tag = "" if exchange == "fused" else ", legacy-exchange"
     engine_tag += "" if ingest == "u8" else f", {ingest}-ingest"
     engine_tag += "" if latency == 0 else f", latency{latency}"
+    if latency > 0:
+        # Each async-lane axis tags the metric so no A/B variant ever
+        # enters another variant's same-metric delta chain.
+        engine_tag += ("" if latency_mode == "fixed"
+                       else f", {latency_mode}-latency")
+        engine_tag += ("" if timeout_rounds is None
+                       else f", timeout{timeout_rounds}")
+        engine_tag += ("" if inflight == "walk"
+                       else f", {inflight}-inflight")
     result = {
         "metric": f"sustained vote ingest ({n_nodes} nodes x {n_txs} txs, "
                   f"k={k}, {n_rounds} rounds, "
@@ -174,7 +188,9 @@ def _worker_main(args: argparse.Namespace) -> None:
         jax.config.update("jax_platforms", "cpu")
     result = bench(args.nodes, args.txs, args.rounds, args.k,
                    exchange=args.exchange, ingest=args.ingest,
-                   latency=args.latency, profile=args.profile)
+                   latency=args.latency, latency_mode=args.latency_mode,
+                   timeout_rounds=args.timeout_rounds,
+                   inflight=args.inflight_engine, profile=args.profile)
     if args.nonce:
         # Echoed back so the parent can verify this line belongs to THIS
         # run (the salvage path must never credit a stale line).
@@ -313,6 +329,33 @@ def main() -> None:
                              "sits at 2*latency+2 rounds, so the timed "
                              "window is pure delayed delivery — no "
                              "expiry traffic")
+    parser.add_argument("--latency-mode",
+                        choices=("fixed", "geometric", "weighted"),
+                        default="fixed",
+                        help="with --latency: the per-draw latency "
+                             "distribution (cfg.latency_mode; tags the "
+                             "metric when not fixed).  'geometric' keeps "
+                             "every ring age busy — the walk engine's "
+                             "worst case")
+    parser.add_argument("--timeout-rounds", type=int, default=None,
+                        help="with --latency: override the hard-derived "
+                             "2*latency+2 timeout so ring DEPTH "
+                             "(timeout+1) sweeps independently of "
+                             "latency (the depth-independence A/B of "
+                             "the coalesced engine; tags the metric)")
+    parser.add_argument("--inflight-engine",
+                        choices=("walk", "walk_earlyout", "coalesced"),
+                        default="walk",
+                        help="with --latency: the ring delivery engine "
+                             "(cfg.inflight_engine): 'walk' = the "
+                             "per-age fori_loop (default), "
+                             "'walk_earlyout' = walk + per-age "
+                             "lax.cond skip of inert ages, 'coalesced' "
+                             "= one-pass ring drain (single flattened "
+                             "gather + one fused present-masked "
+                             "ingest; cost tracks deliveries, not "
+                             "depth).  Bit-exact all three ways; "
+                             "non-default engines tag the metric")
     parser.add_argument("--profile", action="store_true",
                         help="attach per-phase wall times (one eager round "
                              "under tracing.collect_phase_times) as a "
@@ -337,7 +380,11 @@ def main() -> None:
         return
 
     flags = [f"--exchange={args.exchange}", f"--ingest={args.ingest}",
-             f"--latency={args.latency}"] \
+             f"--latency={args.latency}",
+             f"--latency-mode={args.latency_mode}",
+             f"--inflight-engine={args.inflight_engine}"] \
+        + ([f"--timeout-rounds={args.timeout_rounds}"]
+           if args.timeout_rounds is not None else []) \
         + (["--profile"] if args.profile else [])
     size = [f"--nodes={args.nodes}", f"--txs={args.txs}",
             f"--rounds={args.rounds}", f"--k={args.k}", *flags]

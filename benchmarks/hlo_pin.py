@@ -22,6 +22,11 @@ entry):
                      response latency, `ops/inflight.py` ring +
                      delivery walk) — the `--latency` A/B lane's
                      program (PR 3);
+  flagship_async_coalesced — the async program on the coalesced
+                     in-flight delivery engine (`bench.py --latency 2
+                     --inflight-engine coalesced`: one-pass ring drain
+                     + bit-packed ring poll masks, PR 4) — the
+                     depth-independence A/B lane's program;
   streaming_step   — one `models/streaming_dag.step` at the roofline's
                      streaming shape (the north-star scheduler's inner
                      program).
@@ -65,7 +70,8 @@ STREAMING = dict(nodes=4096, backlog_sets=20000, set_cap=2,
 def flagship_stablehlo(nodes: int, txs: int, rounds: int, k: int,
                        exchange: str = "fused",
                        ingest: str = "u8",
-                       latency: int = 0) -> str:
+                       latency: int = 0,
+                       inflight: str = "walk") -> str:
     """StableHLO text of the flagship bench program at the given shape.
 
     Abstract lowering: `jax.eval_shape` turns the state builder into
@@ -79,13 +85,14 @@ def flagship_stablehlo(nodes: int, txs: int, rounds: int, k: int,
     import bench
     from benchmarks.workload import flagship_config, flagship_state
 
-    cfg = flagship_config(txs, k, latency)
+    cfg = flagship_config(txs, k, latency, inflight_engine=inflight)
     if exchange != "fused":
         cfg = dataclasses.replace(cfg, fused_exchange=False)
     if ingest != "u8":
         cfg = dataclasses.replace(cfg, ingest_engine=ingest)
     state_abs = jax.eval_shape(
-        lambda: flagship_state(nodes, txs, k, latency)[0])
+        lambda: flagship_state(nodes, txs, k, latency,
+                               inflight_engine=inflight)[0])
     return bench.flagship_program(cfg, rounds).lower(state_abs).as_text()
 
 
@@ -116,6 +123,9 @@ PROGRAMS = {
                         lambda w: flagship_stablehlo(**w)),
     "flagship_async": (dict(FLAGSHIP, latency=2),
                        lambda w: flagship_stablehlo(**w)),
+    "flagship_async_coalesced": (dict(FLAGSHIP, latency=2,
+                                      inflight="coalesced"),
+                                 lambda w: flagship_stablehlo(**w)),
     "streaming_step": (dict(STREAMING),
                        lambda w: streaming_step_stablehlo(**w)),
 }

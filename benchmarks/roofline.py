@@ -170,7 +170,7 @@ def main() -> None:
     import jax.numpy as jnp
     from jax import lax
 
-    from benchmarks.workload import flagship_state
+    from benchmarks.workload import flagship_config, flagship_state
     from go_avalanche_tpu.models import avalanche as av
     from go_avalanche_tpu.ops import voterecord as vr
     from go_avalanche_tpu.ops.bitops import pack_bool_plane
@@ -356,6 +356,57 @@ def main() -> None:
 
     measure("exchange_fused", fused_step, scan_factory(fused_step),
             fused_carry)
+
+    # --- phase: the async delivery pass (ops/inflight.py), per engine —
+    # the inflight_deliver rows sit next to ingest_kernel /
+    # exchange_fused so the async lane's extra cost is visible in the
+    # same units.  The ring is pre-populated with one round of fixed
+    # latency-2 queries per slot; the iteration index drives `round_`,
+    # so each scanned round delivers a different slot (nothing hoists,
+    # exactly one age active per round — the bench lane's shape).
+    from go_avalanche_tpu.ops import adversary as _adv
+    from go_avalanche_tpu.ops import inflight
+
+    for _ieng, _row in (("walk", "inflight_deliver"),
+                        ("coalesced", "inflight_deliver_coalesced")):
+        _acfg = flagship_config(args.txs, args.k, latency=2,
+                                inflight_engine=_ieng)
+        _aring = inflight.init_ring(_acfg, args.nodes, args.txs)
+        _peers0, _ = draw_peers(jax.random.key(13), _acfg,
+                                state.latency_weight, state.alive,
+                                args.nodes)
+        _lat0 = jnp.full((args.nodes, _acfg.k), 2, jnp.int32)
+        _resp0 = jnp.ones((args.nodes, _acfg.k), jnp.bool_)
+        _lie0 = jnp.zeros((args.nodes, _acfg.k), jnp.bool_)
+        _pol0 = jnp.ones((args.nodes, args.txs), jnp.bool_)
+        for _r in range(inflight.ring_depth(_acfg)):
+            _aring = jax.jit(inflight.enqueue)(
+                _aring, jnp.int32(_r), _peers0, _lat0, _resp0, _lie0,
+                _pol0)
+
+        def deliver_step(carry, i=jnp.int32(1), _acfg=_acfg,
+                         _aring=_aring):
+            recs, packed = carry
+            # round_ cycles 2 .. depth+1 over the STATIC pre-filled
+            # ring, so every scanned round delivers exactly one slot
+            # (age == latency == 2) — the steady state of the bench
+            # lane, without re-enqueueing inside the timed phase.
+            round_ = jnp.mod(i, inflight.ring_depth(_acfg)) + 2
+            recs, _, _ = inflight.deliver_multi_engine(
+                _aring, recs, _acfg, packed,
+                _adv.minority_plane(vr.is_accepted(recs.confidence)),
+                jax.random.fold_in(jax.random.key(17), i), round_,
+                args.txs)
+            return recs, packed
+
+        def deliver_probe(carry, _acfg=_acfg, _aring=_aring):
+            # Bytes-probe twin: records-only output (see ingest_probe).
+            return deliver_step(carry)[0]
+
+        measure(_row, deliver_probe, scan_factory(deliver_step),
+                (state.records,
+                 pack_bool_plane(vr.is_accepted(
+                     state.records.confidence))))
 
     # --- phase: peer sampling alone.
     def sample_step(c, i=jnp.int32(1)):

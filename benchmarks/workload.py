@@ -26,42 +26,61 @@ QUICK = dict(nodes=64, backlog_sets=1024, set_cap=2, window_sets=32)
 _SCORE_SEED, _SIM_SEED, _SCORE_MAX = 1, 0, 1 << 20
 
 
-def flagship_config(txs: int, k: int = 8, latency: int = 0):
+def flagship_config(txs: int, k: int = 8, latency: int = 0,
+                    latency_mode: str = "fixed",
+                    timeout_rounds: int | None = None,
+                    inflight_engine: str = "walk"):
     """The flagship bench config alone — buildable without materializing
     state (how `benchmarks/hlo_pin.py` lowers the full-shape program
     abstractly): finalization unreachable within the timed window
     (0x7FFE), gossip off (pre-seeded feed, matching the reference example
     `main.go:49-53`), poll cap covering every tx.
 
-    `latency > 0` selects the ASYNC variant (`bench.py --latency`): fixed
-    per-draw response latency of that many rounds through the in-flight
-    engine (`ops/inflight.py`), with the timeout at ``2*latency + 2``
-    rounds so nothing expires during the timed window (pure
-    delayed-delivery throughput, no expiry traffic)."""
+    `latency > 0` selects the ASYNC variant (`bench.py --latency`):
+    per-draw response latency through the in-flight engine
+    (`ops/inflight.py`).  By default the latency is FIXED at that many
+    rounds with the timeout at ``2*latency + 2`` rounds, so nothing
+    expires during the timed window (pure delayed-delivery throughput,
+    no expiry traffic).  `timeout_rounds` overrides the hard-derived
+    timeout so an A/B can sweep ring DEPTH (``timeout_rounds + 1``)
+    independently of latency; `latency_mode` swaps the fixed draw for
+    geometric/weighted; `inflight_engine` selects the delivery engine
+    (walk / walk_earlyout / coalesced).  All three only apply to the
+    async variant — the latency-0 flagship program is untouched (its
+    `hlo_pin` hash never moves)."""
     from go_avalanche_tpu.config import AvalancheConfig
 
     async_kw = {}
     if latency > 0:
-        async_kw = dict(latency_mode="fixed", latency_rounds=latency,
+        tr = 2 * latency + 2 if timeout_rounds is None else timeout_rounds
+        if latency_mode == "fixed" and tr <= latency:
+            raise ValueError(
+                f"timeout_rounds={tr} <= latency={latency}: every fixed-"
+                f"latency draw would expire unanswered — the bench lane "
+                f"measures delivery, not a timeout storm")
+        async_kw = dict(latency_mode=latency_mode, latency_rounds=latency,
                         time_step_s=1.0,
-                        request_timeout_s=float(2 * latency + 1))
+                        request_timeout_s=float(tr - 1),
+                        inflight_engine=inflight_engine)
     return AvalancheConfig(finalization_score=0x7FFE, k=k, gossip=False,
                            max_element_poll=max(4096, txs), **async_kw)
 
 
-def flagship_state(nodes: int, txs: int, k: int = 8, latency: int = 0):
+def flagship_state(nodes: int, txs: int, k: int = 8, latency: int = 0,
+                   **async_kw):
     """The `bench.py` flagship workload: (state, cfg) for sustained vote
     ingest on `models/avalanche.round_step`.
 
     One construction shared by `bench.py` (the throughput number) and
     `benchmarks/roofline.py` (the per-phase bandwidth anchor) so the two
-    always measure the same program.
+    always measure the same program.  `async_kw` passes through to
+    `flagship_config` (latency_mode / timeout_rounds / inflight_engine).
     """
     import jax
 
     from go_avalanche_tpu.models import avalanche as av
 
-    cfg = flagship_config(txs, k, latency)
+    cfg = flagship_config(txs, k, latency, **async_kw)
     return av.init(jax.random.key(0), nodes, txs, cfg), cfg
 
 

@@ -212,6 +212,36 @@ class AvalancheConfig:
                                       #   engine even with latency_mode
                                       #   "none" semantics (latency 0
                                       #   within each side).
+    inflight_engine: str = "walk"     # async delivery engine
+                                      #   (ops/inflight.py), active only
+                                      #   when async_queries().  "walk":
+                                      #   the reference pass — a
+                                      #   fori_loop visiting every ring
+                                      #   age each round (one gather +
+                                      #   one k-vote ingest per age;
+                                      #   compiled size O(1) in depth,
+                                      #   runtime O(depth)).
+                                      #   "walk_earlyout": the same walk
+                                      #   with a per-age lax.cond that
+                                      #   skips ages whose slot has no
+                                      #   deliverable/expiring entry —
+                                      #   the cheap win when latency <<
+                                      #   timeout.  "coalesced": ONE
+                                      #   ring drain — whole-ring
+                                      #   deliverable mask, a single
+                                      #   flattened gather over every
+                                      #   candidate entry, and one
+                                      #   fused present-masked ingest
+                                      #   over the [rows, D*k] vote
+                                      #   plane, with the ring's
+                                      #   poll-mask planes bit-packed
+                                      #   (per-shard byte padding, so
+                                      #   the plane shards over txs at
+                                      #   any per-shard width).
+                                      #   Bit-exact all three ways —
+                                      #   pinned by tests/test_inflight
+                                      #   the way tests/test_exchange.py
+                                      #   pins cfg.fused_exchange.
     stream_retire_cap: Optional[int] = None
                                       # streaming_dag scheduler: cap the
                                       #   set-slots retired+refilled per
@@ -314,6 +344,11 @@ class AvalancheConfig:
         if self.stream_retire_cap is not None and self.stream_retire_cap < 1:
             raise ValueError("stream_retire_cap must be >= 1 (None "
                              "disables the cap)")
+        if self.inflight_engine not in ("walk", "walk_earlyout",
+                                        "coalesced"):
+            raise ValueError(
+                f"inflight_engine must be 'walk', 'walk_earlyout' or "
+                f"'coalesced', got {self.inflight_engine!r}")
         if self.latency_mode not in ("none", "fixed", "geometric",
                                      "weighted"):
             raise ValueError(

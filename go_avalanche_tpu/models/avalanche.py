@@ -345,7 +345,7 @@ def round_step(
                                            peers, n)
             ring = inflight.enqueue(state.inflight, state.round, peers,
                                     lat, responded, lie, polled)
-            records, changed, votes_applied = inflight.deliver_multi(
+            records, changed, votes_applied = inflight.deliver_multi_engine(
                 ring, state.records, cfg, packed_prefs, minority_t,
                 k_byz, state.round, t, live_rows=state.alive)
         elif cfg.vote_mode is VoteMode.SEQUENTIAL:

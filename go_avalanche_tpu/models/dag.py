@@ -239,7 +239,7 @@ def round_step(
         lat = inflight.apply_partition(lat, cfg, base.round, 0, peers, n)
         ring = inflight.enqueue(base.inflight, base.round, peers, lat,
                                 responded, lie, polled)
-        records, changed, votes_applied = inflight.deliver_multi(
+        records, changed, votes_applied = inflight.deliver_multi_engine(
             ring, base.records, cfg, packed_prefs, minority_t, k_byz,
             base.round, t, live_rows=base.alive)
     else:

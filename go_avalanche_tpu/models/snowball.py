@@ -120,7 +120,7 @@ def round_step(
         lat = inflight.apply_partition(lat, cfg, state.round, 0, peers, n)
         ring = inflight.enqueue(state.inflight, state.round, peers, lat,
                                 responded, lie, update_mask)
-        records, changed = inflight.deliver_1d(ring, state.records, cfg,
+        records, changed = inflight.deliver_1d_engine(ring, state.records, cfg,
                                                prefs, k_byz, state.round,
                                                live_rows=state.alive)
     elif cfg.vote_mode is VoteMode.SEQUENTIAL:

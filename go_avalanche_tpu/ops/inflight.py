@@ -48,10 +48,42 @@ in the same round, reading the same pre-round preference plane with the
 same PRNG keys.  With `cfg.async_queries()` False the engine is
 statically absent (state leaf None, zero trace impact — the flagship
 `hlo_pin` hash is unchanged).
+
+Delivery engines (`cfg.inflight_engine`, PR 4) — all bit-exact twins:
+
+  walk          — the reference pass above: `lax.fori_loop` over every
+                  ring age (compiled size O(1) in depth, runtime and
+                  state round-trips O(depth));
+  walk_earlyout — the same walk with a per-age `lax.cond` that skips
+                  ages whose slot has no deliverable/expiring entry
+                  (the gathers, the adversary transform and the k-vote
+                  ingest all sit inside the cond) — the cheap win when
+                  latency << timeout leaves most ages inert;
+  coalesced     — ONE ring drain (`deliver_multi_coalesced` /
+                  `deliver_1d_coalesced`): the deliverable mask is
+                  computed for the whole ``[D, rows, k]`` ring at once
+                  (no T axis) and reduced to a per-age activity flag;
+                  only ACTIVE ages then pay their gather + present-
+                  masked ingest, in the walk's exact order (oldest age
+                  first, then draw) — under fixed latency the active
+                  age is even known statically, making the drain's
+                  cost proportional to deliveries rather than ring
+                  depth.  Multi-age collisions (two entries in the same
+                  draw slot delivering the same round) land in the same
+                  sequence the walk applies them, and the
+                  finalized-mid-flight freeze re-reads confidence at
+                  every age boundary exactly where the walk's per-age
+                  `update_mask` reads it.  The coalesced ring also
+                  stores its poll-mask plane BIT-PACKED
+                  (`packed_polled_width`): per-shard tx widths pad up
+                  to the next byte multiple, which is what lets the
+                  packed plane shard over the txs axis at widths that
+                  are not multiples of 8 (the PR 3 blocker).
 """
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import NamedTuple, Optional, Tuple
 
@@ -61,7 +93,11 @@ from jax import lax
 
 from go_avalanche_tpu.config import AvalancheConfig
 from go_avalanche_tpu.ops import adversary, exchange, voterecord as vr
-from go_avalanche_tpu.ops.bitops import popcount8
+from go_avalanche_tpu.ops.bitops import (
+    pack_bool_plane,
+    popcount8,
+    unpack_bool_plane,
+)
 
 # fold_in constant deriving the latency stream from the round's sampling
 # key: the latency draw must not perturb any existing stream (latency-0
@@ -76,14 +112,16 @@ class InflightState(NamedTuple):
     entry's age in round ``r'`` is ``r' - r``, and the slot is
     overwritten exactly one round after its entries expire.
 
-    `polled` is the issue-time update mask: bool ``[D, rows, T]`` for
-    the multi-target models (unpacked on purpose — a bit-packed plane
-    cannot shard over the txs axis at byte granularity when the
-    per-shard width is not a multiple of 8; packing it per shard is a
-    ROADMAP item for the hardware window), bool ``[D, rows]`` for
-    single-decree Snowball.  `lat` is clipped to ``[0,
-    timeout_rounds()]``; the top value is the NEVER-delivers sentinel
-    (expires unanswered).
+    `polled` is the issue-time update mask.  Multi-target models: bool
+    ``[D, rows, T]`` for the walk engines (the PR 3 layout, kept
+    verbatim so the `flagship_async` pin never moves), uint8
+    ``[D, rows, packed_polled_width(T, tx_shards)]`` BIT-PACKED for the
+    coalesced engine — each tx shard's width pads up to the next byte
+    multiple, which is what lets the packed plane shard over the txs
+    axis when the per-shard width is not a multiple of 8 (the PR 3
+    blocker).  Single-decree Snowball: bool ``[D, rows]`` always.
+    `lat` is clipped to ``[0, timeout_rounds()]``; the top value is the
+    NEVER-delivers sentinel (expires unanswered).
     """
 
     peers: jax.Array      # int32 [D, rows, k] — global peer ids
@@ -91,8 +129,10 @@ class InflightState(NamedTuple):
                           #   sentinel means "expires unanswered"
     responded: jax.Array  # bool [D, rows, k] — issue-time alive/drop/self
     lie: jax.Array        # bool [D, rows, k] — issue-time adversary mask
-    polled: jax.Array     # bool [D, rows, T], or bool [D, rows]
-                          #   (snowball)
+    polled: jax.Array     # walk engines: bool [D, rows, T]; coalesced:
+                          #   uint8 [D, rows, packed_polled_width(...)]
+                          #   (bit-packed — see class docstring); bool
+                          #   [D, rows] for snowball either way
 
 
 def enabled(cfg: AvalancheConfig) -> bool:
@@ -105,14 +145,67 @@ def ring_depth(cfg: AvalancheConfig) -> int:
     return cfg.timeout_rounds() + 1
 
 
+def packed_polled_width(t: int, tx_shards: int = 1) -> int:
+    """Bytes in the coalesced engine's bit-packed poll-mask plane.
+
+    Each of the `tx_shards` contiguous tx blocks packs its own
+    ``t / tx_shards`` columns into ``ceil(t_local / 8)`` bytes — padding
+    every PER-SHARD width to a byte multiple, so the packed plane's
+    byte axis splits evenly over the txs mesh axis no matter the local
+    width.  With one shard this is plain ``ceil(t / 8)``.
+    """
+    if tx_shards < 1 or t % tx_shards:
+        raise ValueError(f"t={t} must divide into tx_shards={tx_shards}")
+    return tx_shards * (-(-(t // tx_shards) // 8))
+
+
+def repack_polled_for_shards(ring: Optional[InflightState], t: int,
+                             tx_shards: int) -> Optional[InflightState]:
+    """Re-layout a host-built packed ring for a tx-sharded mesh.
+
+    Model `init` packs the poll-mask plane with the single-shard layout
+    (``ceil(t/8)`` bytes); placing that state on a mesh whose per-shard
+    width is not a byte multiple needs the per-shard-padded layout
+    instead.  The input MUST carry the 1-shard layout (every model
+    `init` does) — unpacks it and repacks per shard block, lossless.
+    No-op when the 1-shard layout already IS the per-shard layout
+    (``t/tx_shards`` a byte multiple) or the ring is unpacked (walk
+    engines) / absent.  The layout test is on ALIGNMENT, not byte
+    width: at e.g. t=26 over 2 shards both layouts occupy 4 bytes yet
+    place columns differently, so equal widths prove nothing.
+    """
+    if ring is None or ring.polled.dtype != jnp.uint8:
+        return ring
+    if tx_shards == 1 or (t // tx_shards) % 8 == 0:
+        return ring
+    pw = packed_polled_width(t, tx_shards)
+    lead = ring.polled.shape[:-1]
+    unpacked = unpack_bool_plane(ring.polled, t)
+    blocks = unpacked.reshape(*lead, tx_shards, t // tx_shards)
+    return ring._replace(
+        polled=pack_bool_plane(blocks).reshape(*lead, pw))
+
+
 def init_ring(cfg: AvalancheConfig, rows: int,
-              t: Optional[int] = None) -> InflightState:
+              t: Optional[int] = None,
+              tx_shards: int = 1) -> InflightState:
     """Empty ring: every slot pre-expired (lat = sentinel) with an
-    all-zero update mask, so untouched slots never register anything."""
+    all-zero update mask, so untouched slots never register anything.
+
+    The poll-mask plane's layout follows `cfg.inflight_engine`: bool
+    ``[D, rows, t]`` for the walk engines (PR 3 verbatim), bit-packed
+    uint8 ``[D, rows, packed_polled_width(t, tx_shards)]`` for the
+    coalesced engine (`tx_shards` > 1 pads per-shard widths for a
+    tx-sharded mesh — `repack_polled_for_shards` fixes up host-built
+    states after the fact).
+    """
     d = ring_depth(cfg)
     k = cfg.k
     if t is None:            # single-decree: per-node bool mask
         polled = jnp.zeros((d, rows), jnp.bool_)
+    elif cfg.inflight_engine == "coalesced":
+        polled = jnp.zeros((d, rows, packed_polled_width(t, tx_shards)),
+                           jnp.uint8)
     else:                    # multi-target: per-(node, tx) bool mask
         polled = jnp.zeros((d, rows, t), jnp.bool_)
     return InflightState(
@@ -223,9 +316,18 @@ def enqueue(
     lie: jax.Array,
     polled: jax.Array,
 ) -> InflightState:
-    """Write this round's queries into slot ``round_ % D``."""
+    """Write this round's queries into slot ``round_ % D``.
+
+    `polled` is the round's bool update mask; when the ring stores its
+    poll-mask plane bit-packed (coalesced engine) it is packed here —
+    enqueue always runs where the plane's width is the LOCAL one (the
+    host model, or a shard's block inside `shard_map`), so the plain
+    single-block packing is the right layout in both settings.
+    """
     d = ring.peers.shape[0]
     slot = jnp.mod(round_, d).astype(jnp.int32)
+    if ring.polled.dtype == jnp.uint8 and polled.dtype != jnp.uint8:
+        polled = pack_bool_plane(polled)
 
     def upd(plane, entry):
         return lax.dynamic_update_index_in_dim(plane, entry.astype(
@@ -381,6 +483,480 @@ def deliver_1d(
     return lax.fori_loop(0, depth, body, (records, changed0))
 
 
+# ---------------------------------------------------------------------------
+# walk_earlyout: the walk with a per-age lax.cond skip.
+#
+# Deliberately a TWIN of deliver_multi/deliver_1d rather than a flag on
+# them: the walk's traced op order is pinned by the `flagship_async`
+# hlo_pin hash, and hoisting its mask computation above the ring-plane
+# slices (which the cond structure requires) would move that hash.
+
+
+def deliver_multi_earlyout(
+    ring: InflightState,
+    records: vr.VoteRecordState,
+    cfg: AvalancheConfig,
+    packed_prefs: jax.Array,
+    minority_t: jax.Array,
+    key: jax.Array,
+    round_: jax.Array,
+    t: int,
+    live_rows: Optional[jax.Array] = None,
+) -> Tuple[vr.VoteRecordState, jax.Array, jax.Array]:
+    """`deliver_multi` with a per-age early-out (`cfg.inflight_engine =
+    "walk_earlyout"`).
+
+    Each age first reduces its slot's (no-T) latency planes to one
+    "anything to do?" scalar; the gather, adversary transform and
+    k-vote ingest run under a `lax.cond` only when some entry delivers
+    or expires.  Identical results to the walk — an inert age is a
+    no-op there too (present all-zero registers nothing) — but an inert
+    age now costs a ``[rows, k]`` reduction instead of a full
+    gather+ingest pass: the cheap win when latency << timeout leaves
+    most ring ages empty-handed each round.
+    """
+    timeout = cfg.timeout_rounds()
+    depth = timeout + 1
+
+    def body(i, carry):
+        d = jnp.int32(timeout) - i
+        slot = jnp.mod(round_ - d + depth, depth)
+        lat = lax.dynamic_index_in_dim(ring.lat, slot, 0, False)
+        responded = lax.dynamic_index_in_dim(ring.responded, slot, 0, False)
+
+        deliver = (lat == d[None, None]) & (d != timeout)
+        expire = (lat >= timeout) & (d == timeout)
+        consider = responded & deliver
+        present = deliver | expire
+        if cfg.skip_absent_votes:
+            present = present & consider
+
+        def run(carry):
+            records, changed, votes_applied = carry
+            peers = lax.dynamic_index_in_dim(ring.peers, slot, 0, False)
+            lie = lax.dynamic_index_in_dim(ring.lie, slot, 0, False)
+            polled = lax.dynamic_index_in_dim(ring.polled, slot, 0, False)
+            yes_pack, consider_pack = exchange.gather_vote_packs(
+                packed_prefs, peers, consider, lie,
+                _delivery_key(key, d), cfg, minority_t, t)
+            present_pack = jnp.broadcast_to(
+                _pack_bits(present)[:, None], consider_pack.shape)
+            update_mask = polled & jnp.logical_not(
+                vr.has_finalized(records.confidence, cfg))
+            if live_rows is not None:
+                update_mask = update_mask & live_rows[:, None]
+            records, ch = vr.register_packed_votes_present(
+                records, yes_pack, consider_pack, present_pack, cfg.k,
+                cfg, update_mask=update_mask)
+            votes_applied = votes_applied + (
+                popcount8(consider_pack).astype(jnp.int32)
+                * update_mask).sum()
+            return records, changed | ch, votes_applied
+
+        return lax.cond(present.any(), run, lambda c: c, carry)
+
+    changed0 = jnp.zeros(records.votes.shape, jnp.bool_)
+    return lax.fori_loop(0, depth, body,
+                         (records, changed0, jnp.int32(0)))
+
+
+def deliver_1d_earlyout(
+    ring: InflightState,
+    records: vr.VoteRecordState,
+    cfg: AvalancheConfig,
+    prefs: jax.Array,
+    key: jax.Array,
+    round_: jax.Array,
+    live_rows: Optional[jax.Array] = None,
+) -> Tuple[vr.VoteRecordState, jax.Array]:
+    """`deliver_1d` with the per-age early-out (see
+    `deliver_multi_earlyout`)."""
+    timeout = cfg.timeout_rounds()
+    depth = timeout + 1
+
+    def body(i, carry):
+        d = jnp.int32(timeout) - i
+        slot = jnp.mod(round_ - d + depth, depth)
+        lat = lax.dynamic_index_in_dim(ring.lat, slot, 0, False)
+        responded = lax.dynamic_index_in_dim(ring.responded, slot, 0, False)
+
+        deliver = (lat == d[None, None]) & (d != timeout)
+        expire = (lat >= timeout) & (d == timeout)
+        consider = responded & deliver
+        present = deliver | expire
+        if cfg.skip_absent_votes:
+            present = present & consider
+
+        def run(carry):
+            records, changed = carry
+            peers = lax.dynamic_index_in_dim(ring.peers, slot, 0, False)
+            lie = lax.dynamic_index_in_dim(ring.lie, slot, 0, False)
+            mask = lax.dynamic_index_in_dim(ring.polled, slot, 0, False)
+            votes = adversary.apply_1d(_delivery_key(key, d), prefs[peers],
+                                       lie, cfg, prefs)
+            update_mask = mask & jnp.logical_not(
+                vr.has_finalized(records.confidence, cfg))
+            if live_rows is not None:
+                update_mask = update_mask & live_rows
+            records, ch = vr.register_packed_votes_present(
+                records, _pack_bits(votes), _pack_bits(consider),
+                _pack_bits(present), cfg.k, cfg, update_mask=update_mask)
+            return records, changed | ch
+
+        return lax.cond(present.any(), run, lambda c: c, carry)
+
+    changed0 = jnp.zeros(records.votes.shape, jnp.bool_)
+    return lax.fori_loop(0, depth, body, (records, changed0))
+
+
+# ---------------------------------------------------------------------------
+# coalesced: one-pass ring drain.
+
+
+def _age_loop_bounds(cfg: AvalancheConfig, depth: int):
+    """Static ``(lo, hi)`` bounds for the coalesced per-age drain loop.
+
+    General case: the full ring, ``(0, depth)``.  Fixed latency
+    (`_static_single_age`): a TRIP-2 window containing the one age that
+    can ever register — depth-independent, but deliberately not trip-1:
+    XLA's while-loop simplifier unrolls a single-iteration loop, which
+    hoists the activity `lax.cond` to the scan body's top level where
+    its operand copies (and its serial branch execution) cost ~3x the
+    looped form on CPU (PERF_NOTES PR 4); the one extra inert
+    iteration keeps the while intact for one scalar test + one
+    pass-through copy.
+    """
+    single_age = _static_single_age(cfg)
+    if single_age is None:
+        return 0, depth
+    hi = min(depth, (cfg.timeout_rounds() - single_age) + 2)
+    return hi - 2, hi
+
+
+def _static_single_age(cfg: AvalancheConfig):
+    """The one ring age that can ever register under this config, or
+    None when that is not statically known.
+
+    With ``latency_mode="fixed"`` and no partition, every enqueued
+    entry carries the SAME latency ``min(latency_rounds, timeout)``:
+    if it is below the timeout, only that age ever delivers (and
+    nothing ever expires — the stored latency never reaches the
+    sentinel); if it IS the timeout sentinel, nothing ever delivers and
+    only the expiry age registers.  Either way exactly one age needs
+    processing, so the coalesced drain skips the per-age activity loop
+    entirely — ring depth affects nothing but slot arithmetic, which is
+    what makes the fixed-latency bench lane depth-independent
+    (PERF_NOTES PR 4 depth sweep).
+
+    This is an invariant of rings POPULATED UNDER the same config
+    (`draw_latency` stamps the constant; every model does).  A
+    hand-built ring with mixed latencies must pair with a non-fixed
+    `latency_mode` — which is also the only way production reaches
+    such a state (tests/test_inflight.py collision parity).
+    """
+    if cfg.latency_mode == "fixed" and cfg.partition_spec is None:
+        return min(cfg.latency_rounds, cfg.timeout_rounds())
+    return None
+
+
+def _ring_age_view(ring: InflightState, cfg: AvalancheConfig,
+                   round_: jax.Array):
+    """Whole-ring deliverable/expiry masks, oldest-age-first.
+
+    Returns ``(slots, consider, present)``: `slots` int32 ``[D]`` maps
+    PROCESSING index i (age ``timeout - i``: i=0 is the expiring age,
+    i=depth-1 the round's own enqueue) to its ring slot; the masks are
+    bool ``[D, rows, k]`` — the same per-age masks the walk computes
+    one `fori_loop` iteration at a time, materialized for the whole
+    ring at once from the ring's (no-T) latency planes.
+    """
+    timeout = cfg.timeout_rounds()
+    depth = timeout + 1
+    ages = jnp.arange(timeout, -1, -1, dtype=jnp.int32)        # oldest first
+    slots = jnp.mod(round_ - ages, depth).astype(jnp.int32)
+    lat = jnp.take(ring.lat, slots, axis=0)
+    responded = jnp.take(ring.responded, slots, axis=0)
+    a3 = ages[:, None, None]
+    deliver = (lat == a3) & (a3 != jnp.int32(timeout))
+    expire = (lat >= timeout) & (a3 == jnp.int32(timeout))
+    consider = responded & deliver
+    present = deliver | expire
+    if cfg.skip_absent_votes:
+        present = present & consider
+    return slots, consider, present
+
+
+def _vote_transition(votes, consider, confidence, yes_cnt, cons_cnt,
+                     in_yes_raw, in_cons, pres, cfg: AvalancheConfig):
+    """One present-gated window shift + confidence transition.
+
+    The `_apply_vote_bits` state machine with the per-slot popcounts
+    replaced by the incremental yes/consider counters of the
+    `register_packed_votes` hot loop (the counters ride the same
+    `pres` selects as the windows, so they always count the SELECTED
+    windows' bits).  `in_yes_raw` / `pres` are bool arrays of the state
+    shape (or broadcastable); `in_cons` likewise.  Returns the updated
+    ``(votes, consider, confidence, yes_cnt, cons_cnt, changed)``.
+    """
+    one = jnp.uint8(1)
+    top_bit = cfg.window - 1
+    threshold = jnp.uint8(cfg.quorum - 1)
+    iy_raw = in_yes_raw.astype(jnp.uint8)
+    ic = in_cons.astype(jnp.uint8)
+    in_yes = iy_raw & ic                        # counted iff considered
+
+    evict_yes = ((votes & consider) >> top_bit) & one
+    evict_cons = (consider >> top_bit) & one
+    ny = yes_cnt + in_yes - evict_yes
+    nc = cons_cnt + ic - evict_cons
+
+    nv = (votes << 1) | iy_raw
+    ncs = (consider << 1) | ic
+    if cfg.window != 8:                         # uint8 shifts self-truncate
+        window_mask = jnp.uint8((1 << cfg.window) - 1)
+        nv = nv & window_mask
+        ncs = ncs & window_mask
+
+    yes = ny > threshold
+    no = (nc - ny) > threshold
+    conclusive = yes | no
+    accepted = (confidence & 1) == 1
+    agree = accepted == yes
+    saturated = (confidence >> 1) >= jnp.uint16(0x7FFF)
+    conf_bumped = jnp.where(saturated, confidence,
+                            confidence + jnp.uint16(2))
+    conf_new = jnp.where(conclusive,
+                         jnp.where(agree, conf_bumped,
+                                   yes.astype(jnp.uint16)),
+                         confidence)
+    finalized_now = ((conf_bumped >> 1) == cfg.finalization_score) & agree
+    ch = conclusive & (jnp.logical_not(agree) | finalized_now) & pres
+
+    votes = jnp.where(pres, nv, votes)
+    consider = jnp.where(pres, ncs, consider)
+    confidence = jnp.where(pres, conf_new, confidence)
+    yes_cnt = jnp.where(pres, ny, yes_cnt)
+    cons_cnt = jnp.where(pres, nc, cons_cnt)
+    return votes, consider, confidence, yes_cnt, cons_cnt, ch
+
+
+def deliver_multi_coalesced(
+    ring: InflightState,
+    records: vr.VoteRecordState,
+    cfg: AvalancheConfig,
+    packed_prefs: jax.Array,
+    minority_t: jax.Array,
+    key: jax.Array,
+    round_: jax.Array,
+    t: int,
+    live_rows: Optional[jax.Array] = None,
+) -> Tuple[vr.VoteRecordState, jax.Array, jax.Array]:
+    """One-pass ring drain for the multi-target models
+    (`cfg.inflight_engine = "coalesced"`); same contract and identical
+    bits as `deliver_multi` on every config axis (tests/test_inflight).
+
+    The walk's runtime tracks ring DEPTH: every age pays its gather,
+    its adversary transform and its k-vote ingest whether or not its
+    slot has anything to deliver, so a deeper timeout at the same
+    latency costs proportionally more.  Here the drain's per-round cost
+    is proportional to DELIVERIES:
+
+      * the deliverable/expiry masks come from `_ring_age_view` for the
+        entire ``[D, rows, k]`` ring at once — no T axis involved, so
+        the whole-ring mask pass is noise at any depth;
+      * one static-bound `fori_loop` walks the ages oldest-first, each
+        gated by its PRECOMPUTED "anything present" flag (a `lax.cond`
+        whose body lowers exactly once): an inert age costs one scalar
+        test — no ring-plane reads, no gather, no adversary coins, no
+        window compute.  Under fixed latency exactly one age delivers
+        per round, so the drain does one age's work at any ring depth;
+        under geometric latency every age stays busy and the drain
+        degrades to the walk's cost, never below it.
+
+    Multi-age collisions on a draw slot land in the same sequence the
+    walk applies them (active ages run oldest-first), the
+    finalized-mid-flight / dead-querier / poll-mask gates fold into the
+    per-slot present bits, and confidence is re-read at every age
+    boundary exactly where the walk's per-age `update_mask` samples it.
+    The per-slot transition is the incremental-counter form of the
+    `register_packed_votes` hot loop (`_vote_transition`), not the
+    two-popcount `_apply_vote_bits`, and the ring's poll-mask plane is
+    read bit-packed (8x less traffic than the walk's bool plane).
+    Compiled size is O(k), like the walk.
+    """
+    k = cfg.k
+    slots, consider, present = _ring_age_view(ring, cfg, round_)
+    any_present = present.any(axis=(1, 2))               # [D] flags
+    timeout = jnp.int32(cfg.timeout_rounds())
+
+    def body(ai, carry):
+        records, changed, votes_applied = carry
+        d = timeout - ai                    # oldest age first
+        slot = slots[ai]
+        peers = lax.dynamic_index_in_dim(ring.peers, slot, 0, False)
+        lie = lax.dynamic_index_in_dim(ring.lie, slot, 0, False)
+        polled = lax.dynamic_index_in_dim(ring.polled, slot, 0, False)
+        consider_i = lax.dynamic_index_in_dim(consider, ai, 0, False)
+        present_i = lax.dynamic_index_in_dim(present, ai, 0, False)
+        # Per-age update gate — confidence is re-read HERE, after the
+        # older ages' slots applied, exactly like the walk's per-age
+        # update_mask (finalized-mid-flight records freeze mid-drain).
+        upd = unpack_bool_plane(polled, t) \
+            & jnp.logical_not(vr.has_finalized(records.confidence, cfg))
+        if live_rows is not None:
+            upd = upd & live_rows[:, None]
+        rows = peers.shape[0]
+        cube = packed_prefs[peers.reshape(rows * k)].reshape(
+            rows, k, packed_prefs.shape[-1])
+        votes_adv = adversary.apply_draw_planes(
+            _delivery_key(key, d), unpack_bool_plane(cube, t), lie, cfg,
+            minority_t)                                   # [rows, k, T]
+        votes_applied = votes_applied + jnp.where(
+            upd, popcount8(_pack_bits(consider_i))[:, None]
+            .astype(jnp.int32), 0).sum()
+        votes_w, cons_w, confidence = records
+        yes_cnt = popcount8(votes_w & cons_w)
+        cons_cnt = popcount8(cons_w)
+        for j in range(k):                  # unrolled: k is static
+            pres = present_i[:, j][:, None] & upd
+            (votes_w, cons_w, confidence, yes_cnt, cons_cnt,
+             ch) = _vote_transition(
+                votes_w, cons_w, confidence, yes_cnt, cons_cnt,
+                votes_adv[:, j, :], consider_i[:, j][:, None], pres, cfg)
+            changed = changed | ch
+        return (vr.VoteRecordState(votes_w, cons_w, confidence),
+                changed, votes_applied)
+
+    carry = (records,
+             jnp.zeros(records.votes.shape, jnp.bool_),    # changed
+             jnp.int32(0))                                 # votes applied
+    # STATIC-bound fori, each age gated by ITS OWN precomputed activity
+    # flag: the body — and with it the conditional's record-plane copy
+    # set — lowers exactly once, and an inert age costs one scalar
+    # test plus the skip branch's record-plane pass-through copy.
+    # Under fixed latency the bounds tighten STATICALLY to the single
+    # age that can ever register (`_static_single_age`), which is what
+    # makes the bench lane depth-independent.  The loop+cond structure
+    # itself is load-bearing on three counts (PERF_NOTES PR 4): a
+    # traced-bound `fori_loop(0, n_active, ...)` over argsort-compacted
+    # active ages makes copy-insertion clone the aliased ring/record
+    # buffers every round under the donated flagship scan; `argsort`
+    # inside `shard_map` miscompiles on jax 0.4.37 (returns the
+    # identity permutation on shards whose active set has gaps; pinned
+    # by the sharded geometric parity test); and hoisting the cond out
+    # of the while body — one `lax.cond` per age unrolled at the scan
+    # body's top level, or the single-age cond called bare — re-inserts
+    # the conditional's operand copies once per occurrence per round.
+    lo, hi = _age_loop_bounds(cfg, int(ring.peers.shape[0]))
+    return lax.fori_loop(
+        lo, hi,
+        lambda n, c: lax.cond(any_present[n],
+                              functools.partial(body, n), lambda cc: cc,
+                              c),
+        carry)
+
+
+def deliver_1d_coalesced(
+    ring: InflightState,
+    records: vr.VoteRecordState,
+    cfg: AvalancheConfig,
+    prefs: jax.Array,
+    key: jax.Array,
+    round_: jax.Array,
+    live_rows: Optional[jax.Array] = None,
+) -> Tuple[vr.VoteRecordState, jax.Array]:
+    """`deliver_multi_coalesced` for single-decree Snowball (``[N]``
+    records): whole-ring masks, then one static-bound `fori_loop` whose
+    per-age activity cond drains exactly the ages with something to
+    deliver."""
+    k = cfg.k
+    slots, consider, present = _ring_age_view(ring, cfg, round_)
+    any_present = present.any(axis=(1, 2))               # [D] flags
+    timeout = jnp.int32(cfg.timeout_rounds())
+
+    def body(ai, carry):
+        records, changed = carry
+        votes_w, cons_w, confidence = records
+        d = timeout - ai
+        slot = slots[ai]
+        peers = lax.dynamic_index_in_dim(ring.peers, slot, 0, False)
+        lie = lax.dynamic_index_in_dim(ring.lie, slot, 0, False)
+        mask = lax.dynamic_index_in_dim(ring.polled, slot, 0, False)
+        consider_i = lax.dynamic_index_in_dim(consider, ai, 0, False)
+        present_i = lax.dynamic_index_in_dim(present, ai, 0, False)
+        upd = mask & jnp.logical_not(vr.has_finalized(confidence, cfg))
+        if live_rows is not None:
+            upd = upd & live_rows
+        votes_adv = adversary.apply_1d(_delivery_key(key, d),
+                                       prefs[peers], lie, cfg, prefs)
+        yes_cnt = popcount8(votes_w & cons_w)
+        cons_cnt = popcount8(cons_w)
+        for j in range(k):                  # unrolled: k is static
+            pres = present_i[:, j] & upd
+            (votes_w, cons_w, confidence, yes_cnt, cons_cnt,
+             ch) = _vote_transition(
+                votes_w, cons_w, confidence, yes_cnt, cons_cnt,
+                votes_adv[:, j], consider_i[:, j], pres, cfg)
+            changed = changed | ch
+        return (vr.VoteRecordState(votes_w, cons_w, confidence), changed)
+
+    carry = (records, jnp.zeros(records.votes.shape, jnp.bool_))
+    # Static-bound fori + per-age activity cond, with fixed-latency
+    # single-age bounds: see deliver_multi_coalesced.
+    lo, hi = _age_loop_bounds(cfg, int(ring.peers.shape[0]))
+    return lax.fori_loop(
+        lo, hi,
+        lambda n, c: lax.cond(any_present[n],
+                              functools.partial(body, n), lambda cc: cc,
+                              c),
+        carry)
+
+
+# ---------------------------------------------------------------------------
+# Engine dispatch — the single entry points every round implementation
+# calls (`models/avalanche`, `models/dag`, `models/snowball`,
+# `parallel/sharded`, `parallel/sharded_dag`; the streaming/backlog
+# schedulers inherit through those rounds).
+
+
+def deliver_multi_engine(
+    ring: InflightState,
+    records: vr.VoteRecordState,
+    cfg: AvalancheConfig,
+    packed_prefs: jax.Array,
+    minority_t: jax.Array,
+    key: jax.Array,
+    round_: jax.Array,
+    t: int,
+    live_rows: Optional[jax.Array] = None,
+) -> Tuple[vr.VoteRecordState, jax.Array, jax.Array]:
+    """`cfg.inflight_engine` dispatch for the multi-target delivery pass;
+    identical bits whichever engine runs (tests/test_inflight)."""
+    engine = {"walk": deliver_multi,
+              "walk_earlyout": deliver_multi_earlyout,
+              "coalesced": deliver_multi_coalesced}[cfg.inflight_engine]
+    return engine(ring, records, cfg, packed_prefs, minority_t, key,
+                  round_, t, live_rows=live_rows)
+
+
+def deliver_1d_engine(
+    ring: InflightState,
+    records: vr.VoteRecordState,
+    cfg: AvalancheConfig,
+    prefs: jax.Array,
+    key: jax.Array,
+    round_: jax.Array,
+    live_rows: Optional[jax.Array] = None,
+) -> Tuple[vr.VoteRecordState, jax.Array]:
+    """`cfg.inflight_engine` dispatch for the single-decree delivery
+    pass (Snowball)."""
+    engine = {"walk": deliver_1d,
+              "walk_earlyout": deliver_1d_earlyout,
+              "coalesced": deliver_1d_coalesced}[cfg.inflight_engine]
+    return engine(ring, records, cfg, prefs, key, round_,
+                  live_rows=live_rows)
+
+
 def clear_columns(ring: Optional[InflightState],
                   cols: jax.Array) -> Optional[InflightState]:
     """Drop pending updates for window columns being retired/refilled.
@@ -390,9 +966,15 @@ def clear_columns(ring: Optional[InflightState],
     still in flight for the old occupant must not land on its
     replacement, so every ring slot's stored poll mask drops the refilled
     columns.  `cols` is bool ``[W]`` (True = column re-assigned); None
-    ring (engine off) passes through.
+    ring (engine off) passes through.  A bit-packed poll-mask plane
+    (coalesced engine) clears the same columns as packed bits — pad
+    bits of ``~packed(cols)`` are 1, which keeps the plane's (already
+    zero) pad bits untouched.
     """
     if ring is None:
         return None
+    if ring.polled.dtype == jnp.uint8:
+        keep = jnp.bitwise_not(pack_bool_plane(cols[None, :])[0])
+        return ring._replace(polled=ring.polled & keep[None, None, :])
     return ring._replace(
         polled=ring.polled & jnp.logical_not(cols)[None, None, :])

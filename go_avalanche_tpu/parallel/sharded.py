@@ -105,7 +105,14 @@ def shard_state(state: AvalancheSimState, mesh) -> AvalancheSimState:
     `device_put` may ALIAS leaves whose placement already matches (single
     host, replicated spec) rather than copy — so when the result feeds a
     `donate=True` driver, treat the ORIGINAL `state` as consumed too.
+
+    A coalesced-engine in-flight ring re-packs its poll-mask plane to the
+    mesh's per-shard-padded byte layout first
+    (`inflight.repack_polled_for_shards` — a no-op for walk rings and
+    byte-aligned shard widths).
     """
+    state = state._replace(inflight=inflight.repack_polled_for_shards(
+        state.inflight, state.added.shape[1], mesh.shape[TXS_AXIS]))
     return jax.tree.map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
         state, state_specs(state.finalized_at is not None,
@@ -328,7 +335,7 @@ def _local_round(
                                        peers, n_global)
         ring = inflight.enqueue(state.inflight, state.round, peers, lat,
                                 responded, lie, polled)
-        records, changed, votes_applied = inflight.deliver_multi(
+        records, changed, votes_applied = inflight.deliver_multi_engine(
             ring, state.records, cfg, packed_global, minority_t, k_vote,
             state.round, t_local, live_rows=alive_local)
     elif cfg.vote_mode is VoteMode.SEQUENTIAL:

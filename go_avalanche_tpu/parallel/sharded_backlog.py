@@ -66,6 +66,10 @@ def backlog_state_specs(track_finality: bool = True,
 
 def shard_backlog_state(state: BacklogSimState, mesh) -> BacklogSimState:
     """Place a host-built backlog state onto the mesh."""
+    state = state._replace(sim=state.sim._replace(
+        inflight=inflight.repack_polled_for_shards(
+            state.sim.inflight, state.sim.records.votes.shape[1],
+            mesh.shape[TXS_AXIS])))
     return jax.tree.map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
         state, backlog_state_specs(state.sim.finalized_at is not None,

@@ -21,6 +21,8 @@ targets; the equivocation coin additionally folds the txs-shard index
 
 from __future__ import annotations
 
+import dataclasses
+
 from typing import Optional, Tuple
 
 import jax
@@ -87,6 +89,9 @@ def shard_dag_state(state: DagSimState, mesh) -> DagSimState:
             raise ValueError(
                 f"conflict set {int(blocks[i, -1])} straddles the boundary "
                 f"between tx shards {i} and {i + 1}")
+    state = dataclasses.replace(state, base=state.base._replace(
+        inflight=inflight.repack_polled_for_shards(
+            state.base.inflight, t, n_tx_shards)))
     return jax.tree.map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
         state, dag_state_specs(state.n_sets, state.set_size,
@@ -195,7 +200,7 @@ def _local_round(
                                        peers, n_global)
         ring = inflight.enqueue(base.inflight, base.round, peers, lat,
                                 responded, lie, polled)
-        records, changed, votes_applied = inflight.deliver_multi(
+        records, changed, votes_applied = inflight.deliver_multi_engine(
             ring, base.records, cfg, packed_global, minority_t, k_vote,
             base.round, t_local, live_rows=alive_local)
     else:

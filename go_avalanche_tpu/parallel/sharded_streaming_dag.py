@@ -32,6 +32,8 @@ truncated.
 
 from __future__ import annotations
 
+import dataclasses
+
 from typing import Tuple
 
 import jax
@@ -91,6 +93,10 @@ def shard_streaming_dag_state(state: StreamingDagState,
         raise ValueError(
             f"per-shard window ({w // n_tx_shards}) must be a multiple of "
             f"the set capacity ({c}) so sets do not straddle tx shards")
+    state = state._replace(dag=dataclasses.replace(
+        state.dag, base=state.dag.base._replace(
+            inflight=inflight.repack_polled_for_shards(
+                state.dag.base.inflight, w, n_tx_shards))))
     return jax.tree.map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
         state, streaming_dag_state_specs(

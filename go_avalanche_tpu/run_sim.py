@@ -84,6 +84,7 @@ def build_config(args: argparse.Namespace) -> AvalancheConfig:
         skip_absent_votes=args.skip_absent_votes,
         stream_retire_cap=getattr(args, "stream_retire_cap", None),
         ingest_engine=getattr(args, "ingest_engine", "u8"),
+        inflight_engine=getattr(args, "inflight_engine", "walk"),
     )
 
 
@@ -433,6 +434,19 @@ def main(argv=None) -> Dict:
                              "columns lane-packed per uint32 word with the "
                              "closed-form confidence fold (ops/swar.py). "
                              "Bit-exact either way")
+    parser.add_argument("--inflight-engine",
+                        choices=["walk", "walk_earlyout", "coalesced"],
+                        default="walk",
+                        help="async delivery engine (cfg.inflight_engine; "
+                             "any model, active only with --latency-mode/"
+                             "--partition): 'walk' = the per-age "
+                             "fori_loop (reference), 'walk_earlyout' = "
+                             "walk + per-age lax.cond skip of inert "
+                             "ages, 'coalesced' = one-pass ring drain "
+                             "(whole-ring masks, active ages compacted "
+                             "oldest-first, bit-packed ring poll "
+                             "masks; cost tracks deliveries, not ring "
+                             "depth).  Bit-exact all three ways")
     parser.add_argument("--chunk", type=int, default=0, metavar="ROUNDS",
                         help="streaming_dag: dispatch the run in host-driven "
                              "chunks of this many rounds (0 = one device "

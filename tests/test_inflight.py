@@ -488,6 +488,275 @@ def test_streaming_dag_streams_with_latency():
 
 
 # ---------------------------------------------------------------------------
+# Delivery engines (PR 4): coalesced one-pass drain + walk early-out
+
+
+def engine_cfg(cfg: AvalancheConfig, engine: str) -> AvalancheConfig:
+    return dataclasses.replace(cfg, inflight_engine=engine)
+
+
+# Same budget note as FAST_AXES_AV: a representative core runs in
+# tier-1, the rest of the matrix rides the slow lane.
+# One fast axis per engine: the rest of the matrix (incl. the
+# remaining fast walk axes) rides the slow lane — the 870s gate was
+# ~95% full before PR 4.
+FAST_AXES_COALESCED = ("default",)
+
+
+@pytest.mark.parametrize("engine", ["coalesced", "walk_earlyout"])
+@pytest.mark.parametrize(
+    "axis", [a if a in FAST_AXES_COALESCED else
+             pytest.param(a, marks=pytest.mark.slow)
+             for a in sorted(AXES)])
+def test_latency0_parity_engines_avalanche(axis, engine):
+    # The acceptance pin: latency-0 through the coalesced (and
+    # early-out) engines is bit-exact with the SYNCHRONOUS round on the
+    # full config-axis matrix, exactly like the walk engine's PR 3 pin.
+    sync = AvalancheConfig(finalization_score=16, **AXES[axis])
+    asy = engine_cfg(async0(sync), engine)
+    pref = av.contested_init_pref(0, 24, 12)
+    s1 = av.init(jax.random.key(0), 24, 12, sync, init_pref=pref)
+    s2 = av.init(jax.random.key(0), 24, 12, asy, init_pref=pref)
+    step1, step2 = jit_step(av.round_step, sync), jit_step(av.round_step, asy)
+    for r in range(8):
+        s1, t1 = step1(s1)
+        s2, t2 = step2(s2)
+        assert_records_equal(s1.records, s2.records,
+                             f"{engine} {axis} round {r}")
+        assert int(t1.votes_applied) == int(t2.votes_applied), (axis, r)
+        assert int(t1.flips) == int(t2.flips), (axis, r)
+
+
+def _collision_rings(cfg_walk, cfg_coal, rows, t, seed):
+    """Twin rings (bool-plane walk layout, bit-packed coalesced layout)
+    enqueued with IDENTICAL logical content engineered so that round 3
+    delivers two entries in the same (querier, draw) slot: round 0's
+    polls at latency 3 and round 2's at latency 1 (plus a latency-0
+    entry from round 3 itself, and an expiring sentinel from round 0)."""
+    rng = np.random.default_rng(seed)
+    ring_w = inflight.init_ring(cfg_walk, rows, t)
+    ring_c = inflight.init_ring(cfg_coal, rows, t)
+    timeout = cfg_walk.timeout_rounds()
+    k = cfg_walk.k
+    for r, lat_val in ((0, 3), (1, timeout), (2, 1), (3, 0)):
+        peers = jnp.asarray(rng.integers(0, rows, (rows, k)), jnp.int32)
+        lat = jnp.full((rows, k), lat_val, jnp.int32)
+        # Sprinkle per-draw variety so ages carry mixed latencies too.
+        lat = lat.at[:, 0].set(jnp.asarray(
+            rng.integers(0, timeout + 1, (rows,)), jnp.int32))
+        responded = jnp.asarray(rng.random((rows, k)) < 0.9)
+        lie = jnp.asarray(rng.random((rows, k)) < 0.2)
+        polled = jnp.asarray(rng.random((rows, t)) < 0.8)
+        args = (jnp.int32(r), peers, lat, responded, lie, polled)
+        ring_w = inflight.enqueue(ring_w, *args)
+        ring_c = inflight.enqueue(ring_c, *args)
+    return ring_w, ring_c
+
+
+@pytest.mark.parametrize(
+    "seed", [0, pytest.param(1, marks=pytest.mark.slow)])
+def test_multi_age_collision_parity_walk_vs_coalesced(seed):
+    # Two entries in the same draw slot delivering the SAME round (ages
+    # 3 and 1 at round 3) must ingest in the walk's oldest-age-first
+    # order; the expiry age rides along one round later.  Direct kernel
+    # comparison: records, changed plane and votes_applied all match.
+    rows, t = 16, 12
+    base = AvalancheConfig(finalization_score=16,
+                           byzantine_fraction=0.25,
+                           adversary_strategy=AdversaryStrategy.EQUIVOCATE,
+                           flip_probability=0.5)
+    # geometric mode: the hand-built ring below mixes latencies across
+    # ages, a state only the non-fixed modes can produce (fixed mode
+    # stamps every entry the same latency — the invariant the coalesced
+    # engine's static single-age bounds exploit, `_static_single_age`).
+    cfg_w = dataclasses.replace(base, latency_mode="geometric",
+                                latency_rounds=1, **TIMING)
+    cfg_c = engine_cfg(cfg_w, "coalesced")
+    ring_w, ring_c = _collision_rings(cfg_w, cfg_c, rows, t, seed)
+
+    rng = np.random.default_rng(100 + seed)
+    records = vr.VoteRecordState(
+        votes=jnp.asarray(rng.integers(0, 256, (rows, t)), jnp.uint8),
+        consider=jnp.asarray(rng.integers(0, 256, (rows, t)), jnp.uint8),
+        confidence=jnp.asarray(rng.integers(0, 40, (rows, t)), jnp.uint16),
+    )
+    prefs = jnp.asarray(rng.random((rows, t)) < 0.5)
+    from go_avalanche_tpu.ops import adversary as adv
+    from go_avalanche_tpu.ops.bitops import pack_bool_plane
+    packed = pack_bool_plane(prefs)
+    minority = adv.minority_plane(prefs)
+    key = jax.random.key(7)
+    live = jnp.asarray(rng.random((rows,)) < 0.9)
+
+    def jit_deliver(fn, cfg):
+        return jax.jit(lambda ring, recs, rd: fn(
+            ring, recs, cfg, packed, minority, key, rd, t,
+            live_rows=live))
+
+    run_w = jit_deliver(inflight.deliver_multi, cfg_w)
+    run_c = jit_deliver(inflight.deliver_multi_coalesced, cfg_c)
+    run_e = jit_deliver(inflight.deliver_multi_earlyout, cfg_w)
+    for round_ in (3, 4):   # 3: the collision round; 4: the expiry round
+        out_w = run_w(ring_w, records, jnp.int32(round_))
+        out_c = run_c(ring_c, records, jnp.int32(round_))
+        out_e = run_e(ring_w, records, jnp.int32(round_))
+        for out, nm in ((out_c, "coalesced"), (out_e, "earlyout")):
+            assert_records_equal(out_w[0], out[0],
+                                 f"{nm} round {round_} seed {seed}")
+            np.testing.assert_array_equal(np.asarray(out_w[1]),
+                                          np.asarray(out[1]),
+                                          err_msg=f"{nm} changed")
+            assert int(out_w[2]) == int(out[2]), (nm, round_)
+        records = out_w[0]   # chain into the expiry round
+
+
+def test_geometric_latency_trajectory_parity_all_engines():
+    # Randomized end-to-end pin: geometric latency keeps several ring
+    # ages deliverable at once (multi-age collisions included), and the
+    # three engines must produce identical trajectories.
+    base = AvalancheConfig(finalization_score=16, drop_probability=0.1)
+    walk = dataclasses.replace(base, latency_mode="geometric",
+                               latency_rounds=2, **TIMING)
+    cfgs = [walk, engine_cfg(walk, "coalesced"),
+            engine_cfg(walk, "walk_earlyout")]
+    pref = av.contested_init_pref(3, 24, 12)
+    states = [av.init(jax.random.key(3), 24, 12, c, init_pref=pref)
+              for c in cfgs]
+    steps = [jit_step(av.round_step, c) for c in cfgs]
+    for r in range(9):
+        tels = []
+        for i in range(3):
+            states[i], tel = steps[i](states[i])
+            tels.append(tel)
+        assert_records_equal(states[0].records, states[1].records,
+                             f"coalesced round {r}")
+        assert_records_equal(states[0].records, states[2].records,
+                             f"earlyout round {r}")
+        assert (int(tels[0].votes_applied) == int(tels[1].votes_applied)
+                == int(tels[2].votes_applied)), r
+
+
+@pytest.mark.slow
+def test_geometric_latency_parity_snowball_and_dag_engines():
+    base = AvalancheConfig(finalization_score=16,
+                           byzantine_fraction=0.25,
+                           adversary_strategy=AdversaryStrategy.EQUIVOCATE,
+                           flip_probability=0.5)
+    walk = dataclasses.replace(base, latency_mode="geometric",
+                               latency_rounds=2, **TIMING)
+    coal = engine_cfg(walk, "coalesced")
+    s1 = sb.init(jax.random.key(2), 48, walk, yes_fraction=0.5)
+    s2 = sb.init(jax.random.key(2), 48, coal, yes_fraction=0.5)
+    st1, st2 = jit_step(sb.round_step, walk), jit_step(sb.round_step, coal)
+    for r in range(12):
+        s1, _ = st1(s1)
+        s2, _ = st2(s2)
+        assert_records_equal(s1.records, s2.records, f"snowball {r}")
+    cs = jnp.arange(12, dtype=jnp.int32) // 2
+    d1 = dag.init(jax.random.key(1), 24, cs, walk)
+    d2 = dag.init(jax.random.key(1), 24, cs, coal)
+    dt1, dt2 = jit_step(dag.round_step, walk), jit_step(dag.round_step, coal)
+    for r in range(10):
+        d1, _ = dt1(d1)
+        d2, _ = dt2(d2)
+        assert_records_equal(d1.base.records, d2.base.records, f"dag {r}")
+
+
+def test_packed_ring_width_and_repack_roundtrip():
+    # Per-shard byte padding: 26 txs over 2 shards is 13 per shard —
+    # NOT a multiple of 8 (the PR 3 sharding blocker) — so the packed
+    # width pads each shard's block to 2 bytes.
+    assert inflight.packed_polled_width(26, 1) == 4   # ceil(26/8)
+    assert inflight.packed_polled_width(26, 2) == 4   # 2 * ceil(13/8)
+    assert inflight.packed_polled_width(20, 2) == 4   # 2 * ceil(10/8)
+    assert inflight.packed_polled_width(16, 2) == 2   # byte-aligned
+    with pytest.raises(ValueError, match="divide"):
+        inflight.packed_polled_width(10, 4)
+
+    cfg = engine_cfg(dataclasses.replace(
+        AvalancheConfig(), latency_mode="fixed", latency_rounds=1,
+        **TIMING), "coalesced")
+    t, rows = 20, 6
+    ring = inflight.init_ring(cfg, rows, t)
+    assert ring.polled.dtype == jnp.uint8
+    rng = np.random.default_rng(0)
+    polled = jnp.asarray(rng.random((rows, t)) < 0.5)
+    ring = inflight.enqueue(
+        ring, jnp.int32(0), jnp.zeros((rows, cfg.k), jnp.int32),
+        jnp.zeros((rows, cfg.k), jnp.int32),
+        jnp.ones((rows, cfg.k), jnp.bool_),
+        jnp.zeros((rows, cfg.k), jnp.bool_), polled)
+    from go_avalanche_tpu.ops.bitops import unpack_bool_plane
+    np.testing.assert_array_equal(
+        np.asarray(unpack_bool_plane(ring.polled[0], t)),
+        np.asarray(polled), err_msg="packed enqueue roundtrip")
+
+    # Host 1-shard layout -> per-shard-padded 2-shard layout, lossless.
+    repacked = inflight.repack_polled_for_shards(ring, t, 2)
+    assert repacked.polled.shape[-1] == 4
+    half = np.asarray(unpack_bool_plane(repacked.polled[0, :, :2], 10))
+    np.testing.assert_array_equal(half, np.asarray(polled[:, :10]))
+    half2 = np.asarray(unpack_bool_plane(repacked.polled[0, :, 2:], 10))
+    np.testing.assert_array_equal(half2, np.asarray(polled[:, 10:]))
+    # Walk rings and byte-aligned per-shard widths pass through untouched.
+    assert inflight.repack_polled_for_shards(None, t, 2) is None
+    ring16 = inflight.init_ring(cfg, rows, 16)
+    assert inflight.repack_polled_for_shards(ring16, 16, 2) is ring16
+
+    # EQUAL byte widths do not mean equal layouts: t=26 over 2 shards
+    # packs to 4 bytes under BOTH layouts (ceil(26/8) == 2*ceil(13/8)),
+    # but the host layout runs columns contiguously while the per-shard
+    # layout restarts at column 13 — the repack must still happen
+    # (review regression: a width-equality no-op silently corrupted
+    # shard 1's poll masks at such shapes).
+    t26 = 26
+    ring26 = inflight.init_ring(cfg, rows, t26)
+    polled26 = jnp.asarray(rng.random((rows, t26)) < 0.5)
+    ring26 = inflight.enqueue(
+        ring26, jnp.int32(0), jnp.zeros((rows, cfg.k), jnp.int32),
+        jnp.zeros((rows, cfg.k), jnp.int32),
+        jnp.ones((rows, cfg.k), jnp.bool_),
+        jnp.zeros((rows, cfg.k), jnp.bool_), polled26)
+    rp26 = inflight.repack_polled_for_shards(ring26, t26, 2)
+    assert rp26 is not ring26
+    lo = np.asarray(unpack_bool_plane(rp26.polled[0, :, :2], 13))
+    hi = np.asarray(unpack_bool_plane(rp26.polled[0, :, 2:], 13))
+    np.testing.assert_array_equal(lo, np.asarray(polled26[:, :13]))
+    np.testing.assert_array_equal(hi, np.asarray(polled26[:, 13:]))
+
+
+def test_clear_columns_packed_ring():
+    cfg = engine_cfg(dataclasses.replace(
+        AvalancheConfig(), latency_mode="fixed", latency_rounds=1,
+        **TIMING), "coalesced")
+    ring = inflight.init_ring(cfg, rows=4, t=6)
+    ring = ring._replace(polled=jnp.full_like(ring.polled, 0x3F))
+    cols = jnp.asarray([True, False, True, False, False, False])
+    cleared = inflight.clear_columns(ring, cols)
+    from go_avalanche_tpu.ops.bitops import unpack_bool_plane
+    polled = np.asarray(unpack_bool_plane(cleared.polled, 6))
+    assert not polled[:, :, [0, 2]].any()
+    assert polled[:, :, [1, 3, 4, 5]].all()
+
+
+@pytest.mark.slow
+def test_backlog_streams_with_coalesced_engine():
+    # clear_columns on the bit-packed ring: refilled window columns drop
+    # their pending bits and the stream still drains.
+    from go_avalanche_tpu.models import backlog as bl
+
+    cfg = engine_cfg(dataclasses.replace(
+        AvalancheConfig(finalization_score=8), latency_mode="fixed",
+        latency_rounds=1, **TIMING), "coalesced")
+    b = bl.make_backlog(jnp.arange(24, dtype=jnp.int32))
+    st = bl.init(jax.random.key(0), 16, 8, b, cfg)
+    assert st.sim.inflight.polled.dtype == jnp.uint8
+    final = jax.jit(bl.run, static_argnames=("cfg", "max_rounds"))(
+        st, cfg, 3000)
+    assert bool(np.asarray(jax.device_get(final.outputs.settled)).all())
+
+
+# ---------------------------------------------------------------------------
 # Review-hardening pins (PR 3 code review)
 
 

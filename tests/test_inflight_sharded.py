@@ -112,6 +112,39 @@ def test_sharded_partition_cut_uses_global_node_ids(mesh):
     assert not acc[n // 2:].any()
 
 
+def test_sharded_coalesced_parity_unaligned_shard_width(mesh):
+    # The PR 4 acceptance pin: the coalesced engine's bit-packed ring
+    # poll masks shard over txs at a PER-SHARD width that is NOT a
+    # multiple of 8 (t=20 over 2 tx shards -> 10 columns/shard, padded
+    # to 2 bytes each), under geometric latency (multi-age collisions),
+    # with donation — trajectory-identical to the sharded walk engine.
+    walk = dataclasses.replace(
+        AvalancheConfig(finalization_score=16),
+        latency_mode="geometric", latency_rounds=2, **TIMING)
+    coal = dataclasses.replace(walk, inflight_engine="coalesced")
+    pref = av.contested_init_pref(5, 16, 20)
+    s1 = sharded.shard_state(av.init(jax.random.key(5), 16, 20, walk,
+                                     init_pref=pref), mesh)
+    s2 = sharded.shard_state(av.init(jax.random.key(5), 16, 20, coal,
+                                     init_pref=pref), mesh)
+    # repack happened: 2 shards * ceil(10/8) bytes, not ceil(20/8) == 3.
+    assert s2.inflight.polled.shape[-1] == 4
+    step1 = sharded.make_sharded_round_step(mesh, walk)
+    step2 = sharded.make_sharded_round_step(mesh, coal, donate=True)
+    for r in range(7):
+        s1, t1 = step1(s1)
+        s2, t2 = step2(s2)
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(s1.records.confidence)),
+            np.asarray(jax.device_get(s2.records.confidence)),
+            err_msg=f"round {r}")
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(s1.records.votes)),
+            np.asarray(jax.device_get(s2.records.votes)),
+            err_msg=f"round {r} votes")
+        assert int(t1.votes_applied) == int(t2.votes_applied), r
+
+
 @pytest.mark.slow
 def test_sharded_backlog_and_streaming_async(mesh):
     from go_avalanche_tpu.models import backlog as bl

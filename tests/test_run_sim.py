@@ -242,6 +242,23 @@ def test_cli_async_latency_flags(capsys):
 
 
 @pytest.mark.slow
+def test_cli_inflight_engine_flag(capsys):
+    # --inflight-engine rides every model; coalesced through the sharded
+    # driver exercises the bit-packed ring's mesh repack end to end.
+    result = main(["--model", "avalanche", "--nodes", "32", "--txs", "16",
+                   "--finalization-score", "16", "--latency-mode",
+                   "geometric", "--latency-rounds", "1",
+                   "--timeout-rounds", "6", "--inflight-engine",
+                   "coalesced", "--mesh", "4,2", "--json"])
+    assert result["finalized_fraction"] == 1.0
+    result = main(["--model", "snowball", "--nodes", "48",
+                   "--finalization-score", "16", "--latency-mode", "fixed",
+                   "--latency-rounds", "1", "--timeout-rounds", "4",
+                   "--inflight-engine", "walk_earlyout", "--json"])
+    assert result["finalized_fraction"] == 1.0
+
+
+@pytest.mark.slow
 def test_cli_partition_heals(capsys):
     result = main(["--model", "snowball", "--nodes", "64",
                    "--finalization-score", "16", "--partition", "2,20,0.5",
