@@ -88,12 +88,15 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
           ingest: str = "u8", latency: int = 0,
           latency_mode: str = "fixed", timeout_rounds: int | None = None,
           inflight: str = "walk",
+          metrics: str | None = None, metrics_every: int = 0,
           profile: bool = False) -> dict:
+    import contextlib
     import dataclasses
 
     import jax
 
     from benchmarks.workload import flagship_state
+    from go_avalanche_tpu import obs
     from go_avalanche_tpu.models import avalanche as av
 
     # finalization_score 0x7FFE: unreachable within the timed window, so
@@ -101,14 +104,31 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
     # max_element_poll >= n_txs so the poll cap never freezes records the
     # vote count below assumes are live.  Shared builder: roofline.py
     # measures phase bandwidth on this exact construction.
+    # No sink => no tap: timing the tapped (slower) program while every
+    # record is dropped would tag a perturbed number for nothing.  The
+    # converse pairing (sink, stride 0) would open and truncate the
+    # JSONL, write a manifest, and record NOTHING — a run that looks
+    # observed but wasn't.  Normalize both directions, same as the CLI.
+    if not metrics:
+        metrics_every = 0
+    elif metrics_every == 0:
+        metrics_every = 1
     state, cfg = flagship_state(n_nodes, n_txs, k, latency,
                                 latency_mode=latency_mode,
                                 timeout_rounds=timeout_rounds,
-                                inflight_engine=inflight)
+                                inflight_engine=inflight,
+                                metrics_every=metrics_every)
     if exchange != "fused":
         cfg = dataclasses.replace(cfg, fused_exchange=False)
     if ingest != "u8":
         cfg = dataclasses.replace(cfg, ingest_engine=ingest)
+    # The one tag spelling shared with roofline and the metrics sink
+    # (obs/tags.py; format pinned by tests/test_obs.py).  A metrics-on
+    # run times a DIFFERENT program (the in-graph io_callback tap), so
+    # the tag keeps it out of the untapped delta chain.
+    engine_tag = obs.tag_from_config(cfg)
+    sink_ctx = (obs.metrics_sink(metrics, tag=engine_tag)
+                if metrics else contextlib.nullcontext())
 
     # The round loop runs ON DEVICE (lax.scan inside one jit): dispatching
     # rounds one by one from Python pays a fixed per-call latency (~6ms
@@ -118,36 +138,32 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
     # throughput per round is identical from any round's state).
     run = flagship_program(cfg, n_rounds)
 
-    # Warm-up: compile + one executed sweep.
-    state = run(state)
-    _sync(state)
-
-    best_dt = None
-    for _ in range(repeats):
-        t0 = time.perf_counter()
+    with sink_ctx:
+        # Warm-up: compile + one executed sweep.
         state = run(state)
         _sync(state)
-        dt = time.perf_counter() - t0
-        best_dt = dt if best_dt is None else min(best_dt, dt)
+
+        best_dt = None
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            state = run(state)
+            _sync(state)
+            dt = time.perf_counter() - t0
+            best_dt = dt if best_dt is None else min(best_dt, dt)
+
+    if metrics:
+        # Provenance next to the trace: config, topology, pin hashes,
+        # git sha (obs/manifest.py).
+        obs.write_manifest(metrics, cfg, extra={
+            "workload": {"nodes": n_nodes, "txs": n_txs,
+                         "rounds": n_rounds, "k": k,
+                         "repeats": repeats,
+                         "sweeps": repeats + 1},
+            "tag": engine_tag,
+        })
 
     votes = n_nodes * n_txs * k * n_rounds
     votes_per_sec = votes / best_dt
-    # The metric string is part of the round-over-round delta contract
-    # (`_attach_prev_delta` compares same-metric rounds only): unchanged
-    # for the default engines, tagged for the A/B variants so an A/B
-    # never masquerades as a regression/win against default rounds.
-    engine_tag = "" if exchange == "fused" else ", legacy-exchange"
-    engine_tag += "" if ingest == "u8" else f", {ingest}-ingest"
-    engine_tag += "" if latency == 0 else f", latency{latency}"
-    if latency > 0:
-        # Each async-lane axis tags the metric so no A/B variant ever
-        # enters another variant's same-metric delta chain.
-        engine_tag += ("" if latency_mode == "fixed"
-                       else f", {latency_mode}-latency")
-        engine_tag += ("" if timeout_rounds is None
-                       else f", timeout{timeout_rounds}")
-        engine_tag += ("" if inflight == "walk"
-                       else f", {inflight}-inflight")
     result = {
         "metric": f"sustained vote ingest ({n_nodes} nodes x {n_txs} txs, "
                   f"k={k}, {n_rounds} rounds, "
@@ -190,7 +206,9 @@ def _worker_main(args: argparse.Namespace) -> None:
                    exchange=args.exchange, ingest=args.ingest,
                    latency=args.latency, latency_mode=args.latency_mode,
                    timeout_rounds=args.timeout_rounds,
-                   inflight=args.inflight_engine, profile=args.profile)
+                   inflight=args.inflight_engine,
+                   metrics=args.metrics, metrics_every=args.metrics_every,
+                   profile=args.profile)
     if args.nonce:
         # Echoed back so the parent can verify this line belongs to THIS
         # run (the salvage path must never credit a stale line).
@@ -356,6 +374,20 @@ def main() -> None:
                              "ingest; cost tracks deliveries, not "
                              "depth).  Bit-exact all three ways; "
                              "non-default engines tag the metric")
+    parser.add_argument("--metrics", type=str, default=None, metavar="PATH",
+                        help="stream per-round telemetry to this JSONL "
+                             "file through the in-graph metrics tap "
+                             "(go_avalanche_tpu/obs: one unordered "
+                             "io_callback per emitted round inside the "
+                             "timed scan) and write a run manifest next "
+                             "to it (PATH.manifest.json).  The tap "
+                             "changes the timed program, so the metric "
+                             "gains a ', metricsN' tag — pinned as the "
+                             "flagship_metrics hlo program")
+    parser.add_argument("--metrics-every", type=int, default=0,
+                        help="emit every N-th round (cfg.metrics_every); "
+                             "defaults to 1 when --metrics is given, "
+                             "0 (tap statically absent) otherwise")
     parser.add_argument("--profile", action="store_true",
                         help="attach per-phase wall times (one eager round "
                              "under tracing.collect_phase_times) as a "
@@ -375,6 +407,16 @@ def main() -> None:
                         help="accelerator attempts before the CPU fallback")
     args = parser.parse_args()
 
+    if args.metrics_every < 0:
+        # Reject here: the worker subprocess's ValueError would read as
+        # an accelerator failure and spin the retry/fallback loop.
+        parser.error("--metrics-every must be >= 0")
+    if args.metrics and args.metrics_every == 0:
+        args.metrics_every = 1
+    elif args.metrics_every and not args.metrics:
+        parser.error("--metrics-every requires --metrics (without a "
+                     "sink the tap's records are dropped)")
+
     if args.worker:
         _worker_main(args)
         return
@@ -385,6 +427,9 @@ def main() -> None:
              f"--inflight-engine={args.inflight_engine}"] \
         + ([f"--timeout-rounds={args.timeout_rounds}"]
            if args.timeout_rounds is not None else []) \
+        + ([f"--metrics={args.metrics}",
+            f"--metrics-every={args.metrics_every}"]
+           if args.metrics else []) \
         + (["--profile"] if args.profile else [])
     size = [f"--nodes={args.nodes}", f"--txs={args.txs}",
             f"--rounds={args.rounds}", f"--k={args.k}", *flags]

@@ -27,6 +27,14 @@ entry):
                      --inflight-engine coalesced`: one-pass ring drain
                      + bit-packed ring poll masks, PR 4) — the
                      depth-independence A/B lane's program;
+  flagship_metrics — the flagship with the in-graph metrics tap on
+                     (`bench.py --metrics ... --metrics-every 2`,
+                     cfg.metrics_every=2: one unordered io_callback
+                     under a round-mod lax.cond, PR 5) — the
+                     observability on-path program.  The callback
+                     custom call's process-local pointer is normalized
+                     before hashing (`strip_locations`); the OFF path
+                     is covered by `--verify-off-path`;
   streaming_step   — one `models/streaming_dag.step` at the roofline's
                      streaming shape (the north-star scheduler's inner
                      program).
@@ -44,6 +52,7 @@ changed on purpose.
     python benchmarks/hlo_pin.py --list             # show pinned programs
     python benchmarks/hlo_pin.py --update           # re-pin all programs
     python benchmarks/hlo_pin.py --update flagship  # re-pin one program
+    python benchmarks/hlo_pin.py --verify-off-path  # metrics-off == pins
 """
 
 from __future__ import annotations
@@ -71,21 +80,26 @@ def flagship_stablehlo(nodes: int, txs: int, rounds: int, k: int,
                        exchange: str = "fused",
                        ingest: str = "u8",
                        latency: int = 0,
-                       inflight: str = "walk") -> str:
+                       inflight: str = "walk",
+                       metrics_every: int = 0) -> str:
     """StableHLO text of the flagship bench program at the given shape.
 
     Abstract lowering: `jax.eval_shape` turns the state builder into
     ShapeDtypeStructs, so nothing allocates and full bench shape lowers on
     any host.  The program object comes from `bench.flagship_program` —
     the one `bench()` executes — so the hash pins the timed program
-    itself.
+    itself.  `metrics_every > 0` is the in-graph metrics tap
+    (`bench.py --metrics`): its io_callback custom call embeds a
+    process-local callback pointer, which `strip_locations` normalizes
+    so the pin is stable across processes.
     """
     import jax
 
     import bench
     from benchmarks.workload import flagship_config, flagship_state
 
-    cfg = flagship_config(txs, k, latency, inflight_engine=inflight)
+    cfg = flagship_config(txs, k, latency, inflight_engine=inflight,
+                          metrics_every=metrics_every)
     if exchange != "fused":
         cfg = dataclasses.replace(cfg, fused_exchange=False)
     if ingest != "u8":
@@ -126,16 +140,37 @@ PROGRAMS = {
     "flagship_async_coalesced": (dict(FLAGSHIP, latency=2,
                                       inflight="coalesced"),
                                  lambda w: flagship_stablehlo(**w)),
+    "flagship_metrics": (dict(FLAGSHIP, metrics_every=2),
+                         lambda w: flagship_stablehlo(**w)),
     "streaming_step": (dict(STREAMING),
                        lambda w: streaming_step_stablehlo(**w)),
 }
 
+# The metrics-OFF flagship programs: with cfg.metrics_every == 0 (the
+# default) the obs tap must be STATICALLY absent, i.e. these programs'
+# hashes must not move however the observability layer evolves.
+# `--verify-off-path` re-lowers each with metrics_every=0 forced
+# explicitly and checks the archived pin.
+OFF_PATH_PROGRAMS = ("flagship", "flagship_swar32", "flagship_async",
+                     "flagship_async_coalesced")
+
+# A python io_callback custom call embeds the process-local callback
+# pointer twice: as the digit-string `backend_config` attribute and as
+# an i64 constant operand.  Both change every process; hashing must see
+# neither, or the flagship_metrics pin would never reproduce.
+_CALLBACK_CFG = re.compile(
+    r'@xla(?:_ffi)?_python_[a-z_]*callback\b[^\n]*?'
+    r'backend_config\s*=\s*"(\d+)"')
+
 
 def strip_locations(hlo_text: str) -> str:
-    """Drop source-location metadata: inline ``loc(...)`` attributes and
-    trailing ``#loc`` definition lines.  Locations shift with ANY edit to
-    files on the call path (even comments); the pin must only move when
-    the PROGRAM moves."""
+    """Drop source-location metadata — inline ``loc(...)`` attributes and
+    trailing ``#loc`` definition lines — and normalize process-local
+    python-callback pointers (see `_CALLBACK_CFG`).  Locations shift
+    with ANY edit to files on the call path (even comments); the pin
+    must only move when the PROGRAM moves."""
+    for ptr in set(_CALLBACK_CFG.findall(hlo_text)):
+        hlo_text = hlo_text.replace(ptr, "PYCB_PTR")
     stripped = re.sub(r"loc\([^)]*\)", "", hlo_text)
     return "\n".join(line for line in stripped.splitlines()
                      if not line.lstrip().startswith("#loc"))
@@ -146,10 +181,70 @@ def hlo_hash(hlo_text: str) -> str:
     return hashlib.sha256(strip_locations(hlo_text).encode()).hexdigest()
 
 
+_HASH_CACHE: dict = {}
+
+
 def program_hash(name: str, workload: dict | None = None) -> str:
-    """Current hash of a pinned program (archive workload or default)."""
+    """Current hash of a pinned program (archive workload or default).
+
+    Memoized per (name, workload) within the process.  An explicit
+    ``metrics_every=0`` is a DISTINCT cache key from an absent one on
+    purpose: the off-path check must actually lower the explicit-0
+    program (proving off == absent), not read back the drift test's
+    memoized hash."""
     default_workload, builder = PROGRAMS[name]
-    return hlo_hash(builder(workload or default_workload))
+    workload = dict(workload or default_workload)
+    key = (name, json.dumps(workload, sort_keys=True))
+    if key not in _HASH_CACHE:
+        _HASH_CACHE[key] = hlo_hash(builder(workload))
+    return _HASH_CACHE[key]
+
+
+def verify_off_path(platform: str, archive: dict | None = None) -> list:
+    """Check the metrics-OFF flagship programs are byte-identical to
+    their archived pins with `metrics_every=0` forced explicitly.
+
+    Proves the observability tap's OFF path is statically absent — the
+    compiled benchmark programs are the pre-obs ones — rather than
+    merely defaulted: each program here is RE-LOWERED with an explicit
+    zero (a distinct `program_hash` cache key from the drift test's
+    absent-key lowering, so this check can fail independently).  Also
+    checks the converse anchor: `flagship_metrics` with its tap forced
+    off must hash to the `flagship` pin — the tap is the ONLY delta
+    between the tapped and untapped programs.  Returns a list of
+    failure strings (empty = ok); programs without a pin for `platform`
+    are skipped.
+    """
+    archive = archive or _load_archive()
+    failures = []
+    for name in OFF_PATH_PROGRAMS:
+        entry = archive.get("programs", {}).get(name)
+        if not entry:
+            continue
+        pinned = entry.get("hashes", {}).get(platform)
+        if pinned is None:
+            continue
+        workload = dict(entry.get("workload") or PROGRAMS[name][0])
+        workload["metrics_every"] = 0
+        current = program_hash(name, workload)
+        if current != pinned:
+            failures.append(
+                f"{name}: metrics-off program {current} != pinned "
+                f"{pinned} — the obs tap leaks into the off path")
+    met = archive.get("programs", {}).get("flagship_metrics")
+    flag = archive.get("programs", {}).get("flagship")
+    if met and flag and flag.get("hashes", {}).get(platform):
+        workload = dict(met.get("workload") or PROGRAMS["flagship_metrics"][0])
+        workload["metrics_every"] = 0
+        current = program_hash("flagship_metrics", workload)
+        pinned = flag["hashes"][platform]
+        if current != pinned:
+            failures.append(
+                f"flagship_metrics with the tap forced off hashes to "
+                f"{current} != the flagship pin {pinned} — the tapped "
+                f"program differs from the untapped one by more than "
+                f"the tap")
+    return failures
 
 
 def _load_archive() -> dict:
@@ -175,6 +270,12 @@ def main() -> None:
                              "--update re-pins every known program")
     parser.add_argument("--list", action="store_true",
                         help="list pinned programs and their hashes")
+    parser.add_argument("--verify-off-path", action="store_true",
+                        help="check the metrics-OFF flagship programs "
+                             "(cfg.metrics_every=0 forced explicitly) "
+                             "are byte-identical to the archived pins — "
+                             "the observability tap must be statically "
+                             "absent on the default path")
     args = parser.parse_args()
 
     archive = _load_archive()
@@ -194,6 +295,16 @@ def main() -> None:
     import jax
 
     platform = jax.default_backend()
+
+    if args.verify_off_path:
+        failures = verify_off_path(platform, archive)
+        if failures:
+            print("OFF-PATH DRIFT:\n  " + "\n  ".join(failures),
+                  file=sys.stderr)
+            sys.exit(1)
+        print(f"ok: metrics-off flagship programs match their "
+              f"[{platform}] pins")
+        return
 
     if args.update is not None:
         names = args.update or sorted(PROGRAMS)

@@ -50,7 +50,8 @@ def _sync(x) -> None:
 
 def _measure(name: str, step_fn, make_scanned, init_carry, length: int,
              repeats: int = 3, floor_s: float = 0.0,
-             deepen: bool = True, budget_left_s: float | None = None) -> dict:
+             deepen: bool = True, budget_left_s: float | None = None,
+             tag: str = "") -> dict:
     """Per-round roofline row: bytes from the SINGLE-step program's cost
     analysis, wall-clock from a length-`length` scanned program built by
     ``make_scanned(length)``.
@@ -108,6 +109,7 @@ def _measure(name: str, step_fn, make_scanned, init_carry, length: int,
     peak = HBM_PEAK_GBPS.get(platform)
     row = {
         "phase": name,
+        **({"tag": tag} if tag else {}),
         "backend": platform,
         "wall_ms_per_round": round(wall_per_round * 1e3, 3),
         "bytes_mb_per_round": round(bytes_per_round / 1e6, 1),
@@ -172,6 +174,7 @@ def main() -> None:
 
     from benchmarks.workload import flagship_config, flagship_state
     from go_avalanche_tpu.models import avalanche as av
+    from go_avalanche_tpu.obs import tag_from_config
     from go_avalanche_tpu.ops import voterecord as vr
     from go_avalanche_tpu.ops.bitops import pack_bool_plane
     from go_avalanche_tpu.ops.sampling import draw_peers
@@ -202,11 +205,15 @@ def main() -> None:
             return scanned
         return make
 
-    def measure(name, step_fn, make_scanned, init_carry, deepen=True):
+    def measure(name, step_fn, make_scanned, init_carry, deepen=True,
+                tag=""):
         """Deadline-guarded `_measure` with incremental `--out`: a phase
         only starts if budget remains, and every completed row hits the
         file immediately — an external kill loses at most the in-flight
-        phase, never the measured ones."""
+        phase, never the measured ones.  `tag` is the phase config's
+        `obs.tag_from_config` spelling — the join key against bench
+        lines of the same engine variant (dropped when empty: the
+        default config's rows are format-unchanged)."""
         if (args.deadline is not None
                 and time.time() - t_start > args.deadline):
             # Plain text, NOT a JSON line: tpu_evidence merges stderr
@@ -219,7 +226,8 @@ def main() -> None:
         left = (None if args.deadline is None
                 else args.deadline - (time.time() - t_start))
         row = _measure(name, step_fn, make_scanned, init_carry, R,
-                       floor_s=floor[0], deepen=deepen, budget_left_s=left)
+                       floor_s=floor[0], deepen=deepen, budget_left_s=left,
+                       tag=tag)
         rows.append(row)
         if args.out:
             Path(args.out).write_text(
@@ -303,7 +311,7 @@ def main() -> None:
                                                swar_cfg)[0]
 
     measure("ingest_swar", ingest_swar_probe, scan_factory(ingest_swar_step),
-            (state.records, yes0, con0))
+            (state.records, yes0, con0), tag=tag_from_config(swar_cfg))
 
     # --- phase: preference pack + k row-gathers (the vote-exchange
     # collective's single-chip form).
@@ -323,8 +331,11 @@ def main() -> None:
         # pack + k gathers cannot be hoisted or dead-coded.
         return (conf ^ i.astype(jnp.uint16), acc)
 
+    # The k-pass form is the LEGACY exchange engine's shape, so its row
+    # carries that config's tag (joins bench --exchange legacy lines).
     measure("pref_gathers", gather_step, scan_factory(gather_step),
-            gather_carry)
+            gather_carry,
+            tag=tag_from_config(_dc.replace(cfg, fused_exchange=False)))
 
     # --- phase: the FUSED exchange engine (ops/exchange.py, the default
     # production path since the single-gather rework): pack + ONE flattened
@@ -406,7 +417,8 @@ def main() -> None:
         measure(_row, deliver_probe, scan_factory(deliver_step),
                 (state.records,
                  pack_bool_plane(vr.is_accepted(
-                     state.records.confidence))))
+                     state.records.confidence))),
+                tag=tag_from_config(_acfg))
 
     # --- phase: peer sampling alone.
     def sample_step(c, i=jnp.int32(1)):

@@ -29,7 +29,8 @@ _SCORE_SEED, _SIM_SEED, _SCORE_MAX = 1, 0, 1 << 20
 def flagship_config(txs: int, k: int = 8, latency: int = 0,
                     latency_mode: str = "fixed",
                     timeout_rounds: int | None = None,
-                    inflight_engine: str = "walk"):
+                    inflight_engine: str = "walk",
+                    metrics_every: int = 0):
     """The flagship bench config alone — buildable without materializing
     state (how `benchmarks/hlo_pin.py` lowers the full-shape program
     abstractly): finalization unreachable within the timed window
@@ -47,12 +48,17 @@ def flagship_config(txs: int, k: int = 8, latency: int = 0,
     geometric/weighted; `inflight_engine` selects the delivery engine
     (walk / walk_earlyout / coalesced).  All three only apply to the
     async variant — the latency-0 flagship program is untouched (its
-    `hlo_pin` hash never moves)."""
+    `hlo_pin` hash never moves).  `metrics_every > 0` turns on the
+    in-graph metrics tap (`bench.py --metrics`; the tapped program is
+    pinned as `flagship_metrics`)."""
     from go_avalanche_tpu.config import AvalancheConfig
 
     async_kw = {}
     if latency > 0:
-        tr = 2 * latency + 2 if timeout_rounds is None else timeout_rounds
+        from go_avalanche_tpu.obs.tags import default_timeout_rounds
+
+        tr = (default_timeout_rounds(latency) if timeout_rounds is None
+              else timeout_rounds)
         if latency_mode == "fixed" and tr <= latency:
             raise ValueError(
                 f"timeout_rounds={tr} <= latency={latency}: every fixed-"
@@ -63,7 +69,8 @@ def flagship_config(txs: int, k: int = 8, latency: int = 0,
                         request_timeout_s=float(tr - 1),
                         inflight_engine=inflight_engine)
     return AvalancheConfig(finalization_score=0x7FFE, k=k, gossip=False,
-                           max_element_poll=max(4096, txs), **async_kw)
+                           max_element_poll=max(4096, txs),
+                           metrics_every=metrics_every, **async_kw)
 
 
 def flagship_state(nodes: int, txs: int, k: int = 8, latency: int = 0,

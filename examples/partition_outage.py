@@ -12,6 +12,22 @@ vanishing.  After `end` the partition heals, but queries issued just
 before the heal still expire: recovery trails the heal by the timeout,
 the tail a memoryless drop model cannot produce.
 
+Since PR 5 the whole story is visible in the round telemetry itself —
+this script is the worked example for the observability layer
+(`go_avalanche_tpu/obs`, docs/observability.md), host-side streaming
+mode: the run's stacked `SimTelemetry` is streamed to a JSONL trace
+with `MetricsSink.write_stacked` and a run manifest is written next to
+it.  The trace shows, per round:
+
+* ``partition_blocked`` jumping to ~N*k/2 at the cut and back to 0 at
+  the heal (the cut severs half of each node's draws);
+* ``expiries`` echoing that curve `timeout_rounds` later (every blocked
+  query is reaped exactly once — nothing vanishes silently);
+* ``ring_occupancy`` swelling while blocked queries sit out their
+  timeout, then draining;
+* ``finalizations`` stalling through the window and recovering after
+  heal + timeout (neutral semantics) or merely slowing (skip).
+
 What the measurement shows (RESULTS-style summary printed per mode):
 
 * **default (delivered-neutral) semantics** — an expired query shifts the
@@ -31,8 +47,13 @@ of Avalanche's Snowball", arXiv:2409.02217); this script is the minimal
 reproduction of that divergence on the batched simulator.
 
     python examples/partition_outage.py
+    python examples/partition_outage.py --metrics /tmp/outage.jsonl
     python examples/partition_outage.py --nodes 2048 --txs 256 \
         --partition-start 10 --partition-end 60 --timeout-rounds 6
+
+The JSONL trace is sorted-by-construction (host-side streaming); the
+in-graph tap variant of the same trace is `run_sim.py --metrics` /
+`bench.py --metrics` (unordered io_callback, sort by `round`).
 """
 
 from __future__ import annotations
@@ -56,17 +77,21 @@ def measure(
     n_rounds: int = 130,
     skip_absent: bool = False,
     seed: int = 0,
+    metrics_path: str | None = None,
 ) -> dict:
-    """One partition-outage run; returns per-round finalizations + summary.
+    """One partition-outage run; returns per-round telemetry + summary.
 
     Contested priors (per-node 50/50) so the network must genuinely
     converge per tx; fixed `latency_rounds` response latency inside each
     side; the partition splits the nodes 50/50 for
-    ``[partition_start, partition_end)``.
+    ``[partition_start, partition_end)``.  With `metrics_path`, the
+    stacked telemetry streams to that JSONL file (one line per round,
+    tagged with the engine config) and a manifest lands next to it.
     """
     import jax
     import numpy as np
 
+    from go_avalanche_tpu import obs
     from go_avalanche_tpu.config import AvalancheConfig
     from go_avalanche_tpu.models import avalanche as av
     from go_avalanche_tpu.ops import voterecord as vr
@@ -84,8 +109,25 @@ def measure(
                     init_pref=av.contested_init_pref(seed, nodes, txs))
     final, tel = av.run_scan(state, cfg, n_rounds=n_rounds)
     fins = np.asarray(jax.device_get(tel.finalizations))       # [rounds]
+    blocked = np.asarray(jax.device_get(tel.partition_blocked))
+    expiries = np.asarray(jax.device_get(tel.expiries))
+    occupancy = np.asarray(jax.device_get(tel.ring_occupancy))
     fin_frac = float(np.asarray(jax.device_get(vr.has_finalized(
         final.records.confidence, cfg))).mean())
+
+    if metrics_path:
+        # Host-side streaming: ONE device_get for the whole stacked
+        # pytree, one JSON line per round, manifest next to the file.
+        mode_tag = obs.tag_from_config(cfg) + (
+            ", skip-absent" if skip_absent else "")
+        with obs.metrics_sink(metrics_path, tag=mode_tag) as sink:
+            sink.write_stacked(tel)
+        obs.write_manifest(metrics_path, cfg, extra={
+            "study": "partition_outage",
+            "mode": "skip" if skip_absent else "neutral",
+            "workload": {"nodes": nodes, "txs": txs, "rounds": n_rounds,
+                         "seed": seed},
+        })
 
     # The stall window: expiry semantics take one timeout to kick in
     # after the cut, and recovery trails the heal by the timeout too.
@@ -95,12 +137,19 @@ def measure(
     return {
         "mode": "skip" if skip_absent else "neutral",
         "per_round_finalizations": fins.tolist(),
+        "per_round_blocked": blocked.tolist(),
+        "per_round_expiries": expiries.tolist(),
+        "per_round_ring_occupancy": occupancy.tolist(),
         "finalized_fraction_final": fin_frac,
         "finalized_fraction_at_cut": float(cum[partition_start - 1]),
         "finalized_fraction_at_heal": float(cum[stall_hi - 1]),
         "stall_window_finalizations": int(fins[stall_lo:stall_hi].sum()),
         "post_heal_finalizations": int(fins[stall_hi:].sum()),
+        "blocked_total": int(blocked.sum()),
+        "expiries_total": int(expiries.sum()),
+        "peak_ring_occupancy": int(occupancy.max()),
         "timeout_rounds": cfg.timeout_rounds(),
+        "metrics_file": metrics_path,
         "config": {
             "nodes": nodes, "txs": txs,
             "partition": [partition_start, partition_end, 0.5],
@@ -109,6 +158,12 @@ def measure(
             "rounds": n_rounds,
         },
     }
+
+
+def _strip(series) -> str:
+    peak = max(max(series), 1)
+    return "".join(
+        " .:-=+*#@"[min(8, (9 * f) // (peak + 1))] for f in series)
 
 
 def main() -> None:
@@ -122,12 +177,23 @@ def main() -> None:
     parser.add_argument("--finalization-score", type=int, default=48)
     parser.add_argument("--rounds", type=int, default=130)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--metrics", type=str, default=None, metavar="PATH",
+                        help="stream each mode's per-round telemetry to "
+                             "PATH.<mode>.jsonl (JSONL, one line per "
+                             "round) with a manifest next to each — the "
+                             "host-side streaming mode of the metrics "
+                             "sink (docs/observability.md)")
     parser.add_argument("--json", action="store_true",
                         help="emit the raw per-mode dicts as JSON")
     args = parser.parse_args()
 
     results = []
     for skip in (False, True):
+        metrics_path = None
+        if args.metrics:
+            p = Path(args.metrics)
+            mode = "skip" if skip else "neutral"
+            metrics_path = str(p.with_name(f"{p.stem}.{mode}{p.suffix}"))
         r = measure(nodes=args.nodes, txs=args.txs,
                     partition_start=args.partition_start,
                     partition_end=args.partition_end,
@@ -135,7 +201,7 @@ def main() -> None:
                     latency_rounds=args.latency_rounds,
                     finalization_score=args.finalization_score,
                     n_rounds=args.rounds, skip_absent=skip,
-                    seed=args.seed)
+                    seed=args.seed, metrics_path=metrics_path)
         results.append(r)
 
     if args.json:
@@ -144,6 +210,7 @@ def main() -> None:
 
     for r in results:
         fins = r["per_round_finalizations"]
+        ps, pe = r["config"]["partition"][0], r["config"]["partition"][1]
         print(f"\n== {r['mode']} absence semantics "
               f"(timeout {r['timeout_rounds']} rounds) ==")
         print(f"finalized fraction: at cut {r['finalized_fraction_at_cut']:.3f}"
@@ -152,13 +219,19 @@ def main() -> None:
         print(f"finalizations inside stall window: "
               f"{r['stall_window_finalizations']}; after heal: "
               f"{r['post_heal_finalizations']}")
-        # Coarse per-round strip chart: one char per round.
-        peak = max(max(fins), 1)
-        strip = "".join(
-            " .:-=+*#@"[min(8, (9 * f) // (peak + 1))] for f in fins)
-        ps, pe = r["config"]["partition"][0], r["config"]["partition"][1]
+        print(f"blocked queries: {r['blocked_total']} "
+              f"(all reaped: {r['expiries_total']} expiries); "
+              f"peak ring occupancy {r['peak_ring_occupancy']}")
+        # Coarse per-round strip charts: one char per round.  The
+        # blocked strip is a square pulse over [start, end); expiries
+        # echo it one timeout later; finalization dips between them.
         print(f"rounds 0..{len(fins) - 1} (partition [{ps}, {pe})):")
-        print(f"|{strip}|")
+        print(f"finalizations |{_strip(fins)}|")
+        print(f"blocked       |{_strip(r['per_round_blocked'])}|")
+        print(f"expiries      |{_strip(r['per_round_expiries'])}|")
+        if r["metrics_file"]:
+            print(f"trace: {r['metrics_file']} "
+                  f"(+ .manifest.json)")
 
 
 if __name__ == "__main__":

@@ -242,6 +242,26 @@ class AvalancheConfig:
                                       #   pinned by tests/test_inflight
                                       #   the way tests/test_exchange.py
                                       #   pins cfg.fused_exchange.
+    metrics_every: int = 0            # in-graph metrics stride
+                                      #   (go_avalanche_tpu/obs): every
+                                      #   this-many rounds the dense
+                                      #   round_step emits its
+                                      #   SimTelemetry scalars to the
+                                      #   active JSONL sink through ONE
+                                      #   unordered `io_callback` under a
+                                      #   round-mod `lax.cond` — no extra
+                                      #   dispatches, no device->host
+                                      #   sync in the fused loop.  0
+                                      #   (default) = statically absent:
+                                      #   the traced program is
+                                      #   byte-identical to the pre-obs
+                                      #   one (every existing hlo_pin
+                                      #   hash unchanged; the on path is
+                                      #   pinned as `flagship_metrics`).
+                                      #   Sharded drivers ignore it —
+                                      #   they stream stacked telemetry
+                                      #   host-side instead
+                                      #   (obs.MetricsSink.write_stacked)
     stream_retire_cap: Optional[int] = None
                                       # streaming_dag scheduler: cap the
                                       #   set-slots retired+refilled per
@@ -341,6 +361,9 @@ class AvalancheConfig:
             raise ValueError(
                 f"ingest_engine must be 'u8' or 'swar32', "
                 f"got {self.ingest_engine!r}")
+        if self.metrics_every < 0:
+            raise ValueError("metrics_every must be >= 0 (0 disables the "
+                             "in-graph metrics tap)")
         if self.stream_retire_cap is not None and self.stream_retire_cap < 1:
             raise ValueError("stream_retire_cap must be >= 1 (None "
                              "disables the cap)")

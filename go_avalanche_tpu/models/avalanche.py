@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG, VoteMode
+from go_avalanche_tpu.obs import sink as obs_sink
 from go_avalanche_tpu.ops import adversary, exchange, inflight
 from go_avalanche_tpu.ops import voterecord as vr
 from go_avalanche_tpu.ops.bitops import pack_bool_plane, popcount8
@@ -93,13 +94,32 @@ class AvalancheSimState(NamedTuple):
 
 
 class SimTelemetry(NamedTuple):
-    """Per-round scalars accumulated on device; fetched infrequently."""
+    """Per-round scalars accumulated on device; fetched infrequently.
+
+    Two granularities (docs/observability.md glossary): the vote
+    counters (`polls`, `votes_applied`, `flips`, `finalizations`,
+    `admissions`, `gossip_writes`) count (node, target[, draw]) events;
+    the async-era ring counters (`deliveries`, `expiries`,
+    `ring_occupancy`, `partition_blocked` — PR 5) count (querier, draw)
+    in-flight ENTRIES and are statically zero when the in-flight engine
+    is off.  Every field is computed from planes the round already
+    materializes (popcount/compare reductions, zero extra gathers), so
+    a driver that discards telemetry — every pinned hlo program does —
+    pays nothing: jax DCEs the dead reductions before lowering.
+    """
 
     polls: jax.Array           # int32 — (node, target) pairs polled
     votes_applied: jax.Array   # int32 — non-neutral votes ingested
     flips: jax.Array           # int32 — preference flips
     finalizations: jax.Array   # int32 — records finalized this round
     admissions: jax.Array      # int32 — gossip admissions this round
+    deliveries: jax.Array      # int32 — ring entries delivered this round
+    expiries: jax.Array        # int32 — ring entries expired unanswered
+    ring_occupancy: jax.Array  # int32 — entries in flight after the round
+    partition_blocked: jax.Array  # int32 — this round's draws cut by the
+                               # active partition (they will expire)
+    gossip_writes: jax.Array   # int32 — (node, target) pairs the gossip
+                               # scatter marked heard this round
 
 
 def contested_init_pref(seed: int, n_nodes: int, n_txs: int) -> jax.Array:
@@ -302,6 +322,7 @@ def round_step(
     # bits either way (`ops/exchange.gossip_heard`).
     added = state.added
     admissions = jnp.int32(0)
+    gossip_writes = jnp.int32(0)
     if cfg.gossip:
         with annotate("gossip_admission"):
             heard = exchange.gossip_heard(peers, polled.astype(jnp.uint8),
@@ -309,6 +330,7 @@ def round_step(
             new_adds = ((heard > 0) & jnp.logical_not(added)
                         & state.alive[:, None] & state.valid[None, :])
             admissions = new_adds.sum().astype(jnp.int32)
+            gossip_writes = (heard > 0).sum().astype(jnp.int32)
             added = added | new_adds
 
     # --- gather peer preferences and pack the k votes into bit planes.
@@ -375,13 +397,26 @@ def round_step(
         toggle = jax.random.bernoulli(k_churn, cfg.churn_probability, (n,))
         alive = jnp.logical_xor(alive, toggle)
 
+    # Async-era counters (PR 5): ring-entry accounting from the no-T
+    # latency planes plus the issue-time partition cut — all statically
+    # zero when the in-flight engine / partition is off.
+    rt = inflight.ring_telemetry(ring, cfg, state.round)
+    cut = (inflight.partition_cut(cfg, state.round, 0, peers, n)
+           if inflight.enabled(cfg) else None)
     telemetry = SimTelemetry(
         polls=polled.sum().astype(jnp.int32),
         votes_applied=votes_applied.astype(jnp.int32),
         flips=(changed & jnp.logical_not(newly_final)).sum().astype(jnp.int32),
         finalizations=newly_final.sum().astype(jnp.int32),
         admissions=admissions,
+        deliveries=rt.deliveries,
+        expiries=rt.expiries,
+        ring_occupancy=rt.occupancy,
+        partition_blocked=(jnp.int32(0) if cut is None
+                           else cut.sum().astype(jnp.int32)),
+        gossip_writes=gossip_writes,
     )
+    obs_sink.emit_round(cfg, state.round, telemetry)
     new_state = AvalancheSimState(
         records=records,
         added=added,

@@ -35,6 +35,7 @@ from jax import lax
 
 from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
 from go_avalanche_tpu.models import avalanche as av
+from go_avalanche_tpu.obs import sink as obs_sink
 from go_avalanche_tpu.ops import adversary, exchange, inflight
 from go_avalanche_tpu.ops import voterecord as vr
 from go_avalanche_tpu.ops.bitops import pack_bool_plane
@@ -261,13 +262,26 @@ def round_step(
         toggle = jax.random.bernoulli(k_churn, cfg.churn_probability, (n,))
         alive = jnp.logical_xor(alive, toggle)
 
+    # Async-era ring counters: same accounting as the flat simulator
+    # (statically zero when the in-flight engine is off); the DAG round
+    # has no gossip, so the gossip counters stay zero.
+    rt = inflight.ring_telemetry(ring, cfg, base.round)
+    cut = (inflight.partition_cut(cfg, base.round, 0, peers, n)
+           if inflight.enabled(cfg) else None)
     telemetry = av.SimTelemetry(
         polls=polled.sum().astype(jnp.int32),
         votes_applied=votes_applied.astype(jnp.int32),
         flips=(changed & jnp.logical_not(newly_final)).sum().astype(jnp.int32),
         finalizations=newly_final.sum().astype(jnp.int32),
         admissions=jnp.int32(0),
+        deliveries=rt.deliveries,
+        expiries=rt.expiries,
+        ring_occupancy=rt.occupancy,
+        partition_blocked=(jnp.int32(0) if cut is None
+                           else cut.sum().astype(jnp.int32)),
+        gossip_writes=jnp.int32(0),
     )
+    obs_sink.emit_round(cfg, base.round, telemetry)
     new_base = av.AvalancheSimState(
         records=records,
         added=base.added,

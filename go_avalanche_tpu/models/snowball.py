@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG, VoteMode
+from go_avalanche_tpu.obs import sink as obs_sink
 from go_avalanche_tpu.ops import adversary, inflight, voterecord as vr
 from go_avalanche_tpu.ops.sampling import sample_peers_uniform
 
@@ -47,11 +48,21 @@ class SnowballState(NamedTuple):
 
 
 class RoundTelemetry(NamedTuple):
-    """Per-round scalars, accumulated on device (SURVEY.md section 5)."""
+    """Per-round scalars, accumulated on device (SURVEY.md section 5).
+
+    The async-era ring counters (PR 5) mirror `SimTelemetry`'s at
+    (querier, draw) entry granularity; statically zero when the
+    in-flight engine is off.
+    """
 
     flips: jax.Array          # int32 — preference flips this round
     finalizations: jax.Array  # int32 — records that finalized this round
     yes_preferences: jax.Array  # int32 — nodes currently preferring yes
+    deliveries: jax.Array     # int32 — ring entries delivered this round
+    expiries: jax.Array       # int32 — ring entries expired unanswered
+    ring_occupancy: jax.Array  # int32 — entries in flight after the round
+    partition_blocked: jax.Array  # int32 — this round's draws cut by the
+                              # active partition
 
 
 def init(
@@ -162,12 +173,21 @@ def round_step(
         toggle = jax.random.bernoulli(k_churn, cfg.churn_probability, (n,))
         alive = jnp.logical_xor(alive, toggle)
 
+    rt = inflight.ring_telemetry(ring, cfg, state.round)
+    cut = (inflight.partition_cut(cfg, state.round, 0, peers, n)
+           if inflight.enabled(cfg) else None)
     telemetry = RoundTelemetry(
         flips=(changed & jnp.logical_not(newly_final)).sum().astype(jnp.int32),
         finalizations=newly_final.sum().astype(jnp.int32),
         yes_preferences=vr.is_accepted(
             records.confidence).sum().astype(jnp.int32),
+        deliveries=rt.deliveries,
+        expiries=rt.expiries,
+        ring_occupancy=rt.occupancy,
+        partition_blocked=(jnp.int32(0) if cut is None
+                           else cut.sum().astype(jnp.int32)),
     )
+    obs_sink.emit_round(cfg, state.round, telemetry)
     new_state = SnowballState(
         records=records,
         byzantine=state.byzantine,

@@ -1,0 +1,34 @@
+"""On-device flight recorder: streaming metrics, manifests, watchdog.
+
+The observability layer (docs/observability.md):
+
+  * `sink`     — JSONL metrics sink: host-side streaming of stacked
+                 telemetry, plus the zero-dispatch in-graph tap
+                 (`emit_round`) the dense rounds call under
+                 `cfg.metrics_every`;
+  * `manifest` — run-manifest writer (config, jax/device topology,
+                 hlo-pin hashes, git sha) emitted next to every metrics
+                 file by `bench.py` and `run_sim.py`;
+  * `tags`     — `tag_from_config`: the one metric-tag spelling shared
+                 by bench, roofline and the sink;
+  * `watchdog` — opt-in invariant checks (`run_sim --check-invariants`)
+                 that turn silent state corruption into loud failures.
+"""
+
+from go_avalanche_tpu.obs.manifest import (  # noqa: F401
+    manifest_dict,
+    manifest_path_for,
+    write_manifest,
+)
+from go_avalanche_tpu.obs.sink import (  # noqa: F401
+    MetricsSink,
+    emit_round,
+    metrics_sink,
+)
+from go_avalanche_tpu.obs.tags import tag_from_config  # noqa: F401
+from go_avalanche_tpu.obs.watchdog import (  # noqa: F401
+    InvariantViolation,
+    Watchdog,
+    check_records,
+    check_ring,
+)

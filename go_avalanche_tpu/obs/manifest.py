@@ -1,0 +1,119 @@
+"""Run-manifest writer: the provenance record next to every metrics file.
+
+A metrics trace without its construction context is unreplayable — the
+round-3 postmortem pattern (BENCH artifacts whose shape/backend had to
+be reverse-engineered from the metric string).  The manifest captures,
+at run time:
+
+  * the full `AvalancheConfig` as a dict (enums by value);
+  * jax / jaxlib versions and the device topology (platform, kind,
+    count) the run actually saw;
+  * the current `benchmarks/hlo_pin.json` program hashes, so a trace is
+    joinable against the exact compiled-program generation it came from;
+  * the git commit (best-effort: absent outside a checkout);
+  * any caller extras (workload shape, CLI argv, metric tag).
+
+`bench.py` and `run_sim.py` write one next to every metrics file
+(`manifest_path_for`: ``<metrics>.manifest.json``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+_HLO_PIN = _REPO_ROOT / "benchmarks" / "hlo_pin.json"
+
+
+def _config_dict(cfg) -> dict:
+    out = {}
+    for f in dataclasses.fields(cfg):
+        v = getattr(cfg, f.name)
+        if isinstance(v, enum.Enum):
+            v = v.value
+        elif isinstance(v, tuple):
+            v = list(v)
+        out[f.name] = v
+    return out
+
+
+def _git_sha() -> Optional[str]:
+    try:
+        proc = subprocess.run(
+            ["git", "-C", str(_REPO_ROOT), "rev-parse", "HEAD"],
+            capture_output=True, text=True, timeout=5)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    sha = proc.stdout.strip()
+    return sha if proc.returncode == 0 and sha else None
+
+
+def _pin_hashes() -> Optional[dict]:
+    try:
+        archive = json.loads(_HLO_PIN.read_text())
+    except (OSError, ValueError):
+        return None
+    return {name: entry.get("hashes", {})
+            for name, entry in archive.get("programs", {}).items()}
+
+
+def manifest_dict(cfg=None, extra: Optional[dict] = None) -> dict:
+    """Assemble the manifest (see module docstring); pure, no I/O writes.
+
+    Every field is best-effort — a manifest from a stripped environment
+    (no git, no pin archive, no devices) still records what it can.
+    """
+    import jax
+
+    try:
+        devices = jax.devices()
+        topology = {
+            "platform": devices[0].platform,
+            "device_kind": getattr(devices[0], "device_kind", None),
+            "device_count": len(devices),
+        }
+    except Exception:  # noqa: BLE001 — backend init can fail outright
+        topology = None
+
+    manifest = {
+        "jax": jax.__version__,
+        "jaxlib": getattr(jax, "jaxlib_version", None) or _jaxlib_version(),
+        "devices": topology,
+        "git_sha": _git_sha(),
+        "hlo_pins": _pin_hashes(),
+    }
+    if cfg is not None:
+        manifest["config"] = _config_dict(cfg)
+    if extra:
+        manifest.update(extra)
+    return manifest
+
+
+def _jaxlib_version() -> Optional[str]:
+    try:
+        import jaxlib
+        return jaxlib.__version__
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def manifest_path_for(metrics_path) -> Path:
+    """``<metrics file>.manifest.json`` — always NEXT TO the metrics
+    file, whatever its own suffix."""
+    p = Path(metrics_path)
+    return p.with_name(p.name + ".manifest.json")
+
+
+def write_manifest(metrics_path, cfg=None,
+                   extra: Optional[dict] = None) -> Path:
+    """Write the manifest next to `metrics_path`; returns its path."""
+    path = manifest_path_for(metrics_path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(manifest_dict(cfg, extra), indent=2,
+                               sort_keys=True) + "\n")
+    return path

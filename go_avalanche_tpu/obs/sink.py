@@ -1,0 +1,170 @@
+"""Streaming JSONL metrics sink + the zero-dispatch in-graph tap.
+
+Two feeding modes, one file format (docs/observability.md):
+
+  * **host-side** — `MetricsSink.write_stacked(telemetry)` streams the
+    stacked telemetry a `run_scan` returns: ONE `jax.device_get` for the
+    whole pytree, then one JSON line per (strided) round.  Works for
+    every model and every sharded driver (sharded telemetry is already
+    psum-replicated scalars).
+  * **in-graph** — `emit_round(cfg, round_, telemetry)` is called by the
+    dense `round_step`s.  With `cfg.metrics_every == 0` (default) it
+    returns before touching the trace: the compiled program is
+    byte-identical to the pre-obs one (hlo_pin).  With a stride set, the
+    round's telemetry scalars leave the device through ONE unordered
+    `jax.experimental.io_callback` under a round-mod `lax.cond` — no
+    extra dispatches and no host sync in the fused scan/while loop,
+    which is what lets a compiled-loop run be observed without
+    perturbing it (the "flight recorder").  Unordered means lines can
+    land out of round order under an async dispatch stream; every record
+    carries its `round`, so consumers sort (or `jq -s 'sort_by(.round)'`).
+
+The callback writes to the innermost ACTIVE sink (`metrics_sink`
+context manager) at call time — the traced program never captures a
+file path, so one compiled executable serves any sink (and the
+`flagship_metrics` hlo pin stays path-independent).  With no active
+sink the record is dropped.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+from pathlib import Path
+from typing import Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import io_callback
+
+_ACTIVE: list["MetricsSink"] = []   # stack; innermost (last) receives
+
+
+def _flatten_telemetry(tel, out: dict) -> dict:
+    """Flatten (possibly nested) telemetry NamedTuples into one flat
+    dict by leaf field name — `BacklogTelemetry.round` (a SimTelemetry)
+    contributes its own field names, not a 'round' key."""
+    for name in tel._fields:
+        v = getattr(tel, name)
+        if hasattr(v, "_fields"):
+            _flatten_telemetry(v, out)
+        else:
+            out[name] = v
+    return out
+
+
+class MetricsSink:
+    """Append-only JSONL writer; one JSON object per line.
+
+    `tag` (see `obs.tags.tag_from_config`) is stamped into every record
+    when non-empty, so merged traces from different engine configs stay
+    separable.  Thread-safe: the in-graph tap's callback may fire from a
+    runtime thread.
+
+    Opening TRUNCATES: one file is one run's trace.  A retried worker
+    (bench.py's CPU fallback) or a re-run of the same command starts the
+    trace over instead of silently interleaving two runs' records with
+    duplicate round numbers under one last-wins manifest.
+    """
+
+    def __init__(self, path, tag: str = ""):
+        self.path = Path(path)
+        self.tag = tag
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._fh = open(self.path, "w", encoding="utf-8")
+        self._lock = threading.Lock()
+        self.records_written = 0
+
+    def write(self, record: dict) -> None:
+        if self.tag:
+            record = {**record, "tag": self.tag}
+        line = json.dumps(record, sort_keys=True)
+        with self._lock:
+            self._fh.write(line + "\n")
+            self.records_written += 1
+
+    def write_stacked(self, telemetry, every: int = 1,
+                      start_round: int = 0) -> int:
+        """Stream a `run_scan`'s stacked telemetry pytree: one transfer
+        (`jax.device_get` on the whole tree — see
+        `utils.metrics.telemetry_summary`), then one line per `every`-th
+        round.  Returns the number of records written."""
+        if every < 1:
+            raise ValueError("every must be >= 1")
+        host = jax.device_get(telemetry)
+        flat = _flatten_telemetry(host, {})
+        n = int(next(iter(flat.values())).shape[0])
+        wrote = 0
+        for r in range(0, n, every):
+            self.write({"round": start_round + r,
+                        **{k: int(np.asarray(v[r])) for k, v in
+                           flat.items()}})
+            wrote += 1
+        return wrote
+
+    def flush(self) -> None:
+        with self._lock:
+            self._fh.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            self._fh.close()
+
+
+@contextlib.contextmanager
+def metrics_sink(path, tag: str = "") -> Iterator[MetricsSink]:
+    """Open a sink and make it the ACTIVE receiver of the in-graph tap
+    for the duration of the block."""
+    sink = MetricsSink(path, tag=tag)
+    _ACTIVE.append(sink)
+    try:
+        yield sink
+    finally:
+        # Unordered callbacks can trail the jit call that issued them;
+        # drain them before detaching the sink or trailing records from
+        # the run's last rounds would be dropped.
+        try:
+            jax.effects_barrier()
+        except Exception:  # noqa: BLE001 — barrier is best-effort
+            pass
+        _ACTIVE.remove(sink)
+        sink.close()
+
+
+def active_sink() -> Optional[MetricsSink]:
+    return _ACTIVE[-1] if _ACTIVE else None
+
+
+def _host_write(payload: dict) -> None:
+    """io_callback target: route one record to the active sink (drop
+    when none — the compiled program outlives any one sink)."""
+    if not _ACTIVE:
+        return
+    _ACTIVE[-1].write({k: int(np.asarray(v)) for k, v in payload.items()})
+
+
+def emit_round(cfg, round_, telemetry) -> None:
+    """The in-graph telemetry tap (call from a round_step, AFTER the
+    round's telemetry is assembled).
+
+    `cfg.metrics_every == 0`: returns before any tracing — statically
+    absent, the caller's program is untouched.  Otherwise inserts one
+    unordered `io_callback` behind a ``round % metrics_every == 0``
+    `lax.cond`; scan/while/jit-compatible (ordered callbacks are not
+    legal inside `lax.cond`, hence unordered + the `round` field for
+    re-ordering).  Never emits from inside `shard_map` — the sharded
+    drivers stream host-side instead (`MetricsSink.write_stacked`).
+    """
+    if getattr(cfg, "metrics_every", 0) <= 0:
+        return
+    payload = _flatten_telemetry(telemetry, {"round": round_})
+
+    def _emit(x):
+        io_callback(_host_write, None, payload, ordered=False)
+        return x
+
+    lax.cond(jnp.mod(round_, cfg.metrics_every) == 0,
+             _emit, lambda x: x, jnp.int32(0))

@@ -1,0 +1,78 @@
+"""`tag_from_config` — the single spelling of the metric engine tag.
+
+`bench.py` grew its tag by ad-hoc concatenation per A/B axis
+(--exchange / --ingest / --latency* / --inflight-engine); roofline rows
+and the metrics sink need the same label or their artifacts stop being
+joinable against bench lines.  The tag is part of the round-over-round
+delta contract (`bench._attach_prev_delta` compares same-metric rounds
+only), so its format is PINNED by `tests/test_obs.py` — change it only
+with the test, knowing every archived `BENCH_r*.json` chain breaks at
+the rename.
+
+Format: empty for the all-default config; otherwise a concatenation of
+``", <axis-tag>"`` fragments, one per NON-default engine axis, in this
+fixed order:
+
+    ", legacy-exchange"        cfg.fused_exchange False
+    ", {engine}-ingest"        cfg.ingest_engine != "u8"
+    ", latency{N}"             async on with a latency distribution
+    ", {mode}-latency"         cfg.latency_mode not fixed
+    ", timeout{T}"             timeout differs from the bench-derived
+                               default (`default_timeout_rounds`:
+                               2 * latency + 2 rounds).  ONE deliberate
+                               divergence from bench's historic
+                               concatenation: bench tagged whenever
+                               --timeout-rounds was passed EXPLICITLY,
+                               even at the default value; a config
+                               cannot carry explicitness, so an
+                               explicit-at-default timeout is now
+                               untagged (no archived chain used one)
+    ", {engine}-inflight"      cfg.inflight_engine != "walk"
+    ", partition"              cfg.partition_spec scheduled
+    ", metrics{N}"             cfg.metrics_every > 0 (the in-graph tap
+                               changes the timed program)
+"""
+
+from __future__ import annotations
+
+from go_avalanche_tpu.config import AvalancheConfig
+
+
+def default_timeout_rounds(latency_rounds: int) -> int:
+    """The bench lane's derived timeout default: 2 * latency + 2 rounds
+    (room for a full round trip plus jitter before a draw is reaped).
+    THE single spelling — `benchmarks/workload.flagship_config` derives
+    its `request_timeout_s` from this, and `tag_from_config` suppresses
+    the ", timeoutN" fragment exactly when a config matches it; a
+    drifted copy would silently relabel configs and break the archived
+    same-metric delta chains."""
+    return 2 * latency_rounds + 2
+
+
+def tag_from_config(cfg: AvalancheConfig) -> str:
+    """Metric tag fragment for this config's non-default engine axes.
+
+    Matches what `bench.py` historically concatenated from its flags
+    (sole divergence: the explicit-at-default timeout case — see the
+    module docstring), so existing same-metric delta chains keep
+    resolving; leading ", " so it appends directly inside a metric
+    string's parenthetical.
+    """
+    tag = "" if cfg.fused_exchange else ", legacy-exchange"
+    if cfg.ingest_engine != "u8":
+        tag += f", {cfg.ingest_engine}-ingest"
+    if cfg.async_queries():
+        if cfg.latency_mode != "none":
+            tag += f", latency{cfg.latency_rounds}"
+            if cfg.latency_mode != "fixed":
+                tag += f", {cfg.latency_mode}-latency"
+            if cfg.timeout_rounds() != default_timeout_rounds(
+                    cfg.latency_rounds):
+                tag += f", timeout{cfg.timeout_rounds()}"
+        if cfg.inflight_engine != "walk":
+            tag += f", {cfg.inflight_engine}-inflight"
+        if cfg.partition_spec is not None:
+            tag += ", partition"
+    if cfg.metrics_every > 0:
+        tag += f", metrics{cfg.metrics_every}"
+    return tag

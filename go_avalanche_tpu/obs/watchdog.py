@@ -1,0 +1,175 @@
+"""Invariant watchdog: turn silent state corruption into loud failures.
+
+The PR 4 review caught a SILENT corruption class: the layout-aliased
+poll-mask repack (`inflight.repack_polled_for_shards`) produced
+equal-width but differently-laid-out packed planes, and nothing
+downstream could tell — votes just landed on the wrong columns.  This
+module is the opt-in debug mode (`run_sim --check-invariants`) that
+asserts, on the HOST between steps, the structural invariants every
+engine maintains by construction:
+
+  * confidence counter ``(conf >> 1) <= 0x7FFF`` (the saturation cap —
+    the counter lives in 15 bits) AND ``<= cfg.finalization_score
+    + cfg.k - 1`` (a record freezes once a round ENDS with it
+    finalized — poll masks and every delivery's `update_mask` exclude
+    finalized records — but the k sequential votes of the ingest call
+    it crosses in keep landing, so the crossing call can overshoot the
+    score by at most k - 1);
+  * window planes carry no bits above ``cfg.window`` (the packed uint8
+    windows are masked on every shift when window < 8);
+  * every in-flight ring latency sits in ``[0, timeout_rounds()]`` and
+    the ring's depth is ``timeout_rounds() + 1`` (ages < depth);
+  * a bit-packed ring poll-mask plane has ZERO padding bits in every
+    per-shard byte block (the exact aliased-repack corruption);
+  * the finalized count never DECREASES across steps (finalized records
+    freeze; streaming schedulers legitimately reset refilled columns —
+    construct `Watchdog(monotonic=False)` there).
+
+Host-side by design: a `jax.device_get` per check keeps the checks out
+of the compiled program entirely (the traced step is byte-identical
+with the watchdog on or off), and a violation raises
+`InvariantViolation` with the offending indices — not a device-side
+trap.  Debug-mode cost: one transfer + numpy reductions per step.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+from go_avalanche_tpu.config import AvalancheConfig
+from go_avalanche_tpu.ops import voterecord as vr
+
+
+class InvariantViolation(AssertionError):
+    """A structural invariant of the sim state failed."""
+
+
+def _offenders(mask: np.ndarray, limit: int = 5) -> str:
+    idx = np.argwhere(mask)
+    shown = ", ".join(str(tuple(int(x) for x in i)) for i in idx[:limit])
+    more = "" if idx.shape[0] <= limit else f" (+{idx.shape[0] - limit} more)"
+    return f"{idx.shape[0]} offender(s) at {shown}{more}"
+
+
+def check_records(records, cfg: AvalancheConfig) -> int:
+    """Assert the vote-record invariants; returns the finalized count
+    (fuel for the monotonicity check).  `records` is any
+    `VoteRecordState` (``[N]`` or ``[N, T]``)."""
+    votes, consider, confidence = (
+        np.asarray(x) for x in jax.device_get(
+            (records.votes, records.consider, records.confidence)))
+    counter = confidence >> 1
+    bad = counter > 0x7FFF
+    if bad.any():
+        raise InvariantViolation(
+            f"confidence counter exceeds the 15-bit saturation cap "
+            f"0x7FFF: {_offenders(bad)}")
+    # A record freezes once a round ends with it finalized (poll masks
+    # and per-delivery update_masks exclude finalized records), but the
+    # ingest call it CROSSES in applies its remaining sequential votes
+    # under a mask computed at call start — overshoot caps at k - 1.
+    cap = min(0x7FFF, cfg.finalization_score + cfg.k - 1)
+    bad = counter > cap
+    if bad.any():
+        raise InvariantViolation(
+            f"confidence counter exceeds finalization_score + k - 1 = "
+            f"{cap} (a record finalized at a round boundary must "
+            f"freeze): {_offenders(bad)}")
+    if cfg.window < 8:
+        window_mask = np.uint8((1 << cfg.window) - 1)
+        for name, plane in (("votes", votes), ("consider", consider)):
+            bad = (plane & ~window_mask) != 0
+            if bad.any():
+                raise InvariantViolation(
+                    f"{name} window plane carries bits above "
+                    f"window={cfg.window}: {_offenders(bad)}")
+    fin = np.asarray(jax.device_get(
+        vr.has_finalized(records.confidence, cfg)))
+    return int(fin.sum())
+
+
+def check_ring(ring, cfg: AvalancheConfig, t: Optional[int] = None,
+               tx_shards: int = 1) -> None:
+    """Assert the in-flight ring invariants (None ring passes).
+
+    `t` (the multi-target tx width) enables the packed-plane padding
+    check for a coalesced ring; `tx_shards` selects which per-shard
+    byte layout the plane must carry (`inflight.packed_polled_width`)."""
+    if ring is None:
+        return
+    timeout = cfg.timeout_rounds()
+    depth = int(ring.peers.shape[0])
+    if depth != timeout + 1:
+        raise InvariantViolation(
+            f"ring depth {depth} != timeout_rounds() + 1 = {timeout + 1}: "
+            f"entry ages can escape the ring")
+    lat = np.asarray(jax.device_get(ring.lat))
+    bad = (lat < 0) | (lat > timeout)
+    if bad.any():
+        raise InvariantViolation(
+            f"ring latency outside [0, timeout={timeout}]: "
+            f"{_offenders(bad)}")
+    polled = np.asarray(jax.device_get(ring.polled))
+    if polled.dtype == np.uint8 and t is not None:
+        t_local = t // tx_shards
+        pad_bits = -t_local % 8
+        if pad_bits:
+            blocks = polled.reshape(*polled.shape[:-1], tx_shards, -1)
+            # Bits t_local .. of each shard block's last byte are pad.
+            pad_mask = np.uint8(((1 << pad_bits) - 1) << (t_local % 8))
+            bad = (blocks[..., -1] & pad_mask) != 0
+            if bad.any():
+                raise InvariantViolation(
+                    f"bit-packed ring poll mask has NON-ZERO padding "
+                    f"bits (layout-aliased repack? see "
+                    f"inflight.repack_polled_for_shards): "
+                    f"{_offenders(bad)}")
+
+
+def _resolve(state):
+    """(records, ring, t) from any model's state pytree."""
+    if hasattr(state, "dag"):                  # StreamingDagState
+        state = state.dag
+    if hasattr(state, "sim"):                  # BacklogSimState
+        state = state.sim
+    if hasattr(state, "base"):                 # DagSimState
+        state = state.base
+    records = state.records
+    t = records.votes.shape[1] if records.votes.ndim == 2 else None
+    return records, getattr(state, "inflight", None), t
+
+
+class Watchdog:
+    """Stateful checker: call `check(state)` after every step.
+
+    Tracks the finalized count across calls for the monotonicity
+    invariant; `monotonic=False` for the streaming schedulers, whose
+    column refills legitimately reset finality.  `tx_shards` forwards
+    to the packed-plane padding check for mesh-placed states.
+    """
+
+    def __init__(self, cfg: AvalancheConfig, monotonic: bool = True,
+                 tx_shards: int = 1):
+        self.cfg = cfg
+        self.monotonic = monotonic
+        self.tx_shards = tx_shards
+        self.checks = 0
+        self._prev_finalized: Optional[int] = None
+
+    def check(self, state) -> int:
+        """Run every invariant against `state`; returns the finalized
+        count.  Raises `InvariantViolation` on the first failure."""
+        records, ring, t = _resolve(state)
+        finalized = check_records(records, self.cfg)
+        check_ring(ring, self.cfg, t=t, tx_shards=self.tx_shards)
+        if (self.monotonic and self._prev_finalized is not None
+                and finalized < self._prev_finalized):
+            raise InvariantViolation(
+                f"finalized count decreased: {self._prev_finalized} -> "
+                f"{finalized} (finalized records must freeze)")
+        self._prev_finalized = finalized
+        self.checks += 1
+        return finalized

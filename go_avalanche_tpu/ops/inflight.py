@@ -264,26 +264,23 @@ def draw_latency(
     return jnp.clip(lat, 0, timeout)
 
 
-def apply_partition(
-    lat: jax.Array,
+def partition_cut(
     cfg: AvalancheConfig,
     round_: jax.Array,
     row_offset,
     peers: jax.Array,
     n_global: int,
-) -> jax.Array:
-    """Mark cross-partition draws undeliverable while the cut is active.
+) -> Optional[jax.Array]:
+    """Bool ``[rows, k]`` — draws severed by the active partition cut
+    this round; None (statically) when no partition is scheduled.
 
-    During rounds ``[start, end)`` of `cfg.partition_spec`, a query whose
-    querier and sampled peer sit on opposite sides of the split never
-    delivers — its latency becomes the timeout sentinel, so it EXPIRES
-    unanswered at age `timeout_rounds()` (the host Processor's reap),
-    including entries issued just before the heal.  The split point is
-    ``floor(split_frac * N)``, snapped to a cluster boundary when
-    `cfg.n_clusters > 1` (contiguous-block clusters, `ops/sampling.py`).
+    The mask `apply_partition` stamps with the timeout sentinel, exposed
+    on its own so the round's telemetry can count partition-blocked
+    queries from the same plane (XLA CSEs the shared computation; with
+    `partition_spec` None both callers are statically absent).
     """
     if cfg.partition_spec is None:
-        return lat
+        return None
     start, end, frac = cfg.partition_spec
     if cfg.n_clusters > 1:
         # Snap to the nearest INTERIOR cluster boundary: at least one
@@ -303,7 +300,30 @@ def apply_partition(
     qside = (jnp.arange(rows, dtype=jnp.int32)
              + jnp.asarray(row_offset, jnp.int32)) < split
     pside = peers < split
-    cut = active & (qside[:, None] != pside)
+    return active & (qside[:, None] != pside)
+
+
+def apply_partition(
+    lat: jax.Array,
+    cfg: AvalancheConfig,
+    round_: jax.Array,
+    row_offset,
+    peers: jax.Array,
+    n_global: int,
+) -> jax.Array:
+    """Mark cross-partition draws undeliverable while the cut is active.
+
+    During rounds ``[start, end)`` of `cfg.partition_spec`, a query whose
+    querier and sampled peer sit on opposite sides of the split never
+    delivers — its latency becomes the timeout sentinel, so it EXPIRES
+    unanswered at age `timeout_rounds()` (the host Processor's reap),
+    including entries issued just before the heal.  The split point is
+    ``floor(split_frac * N)``, snapped to a cluster boundary when
+    `cfg.n_clusters > 1` (contiguous-block clusters, `ops/sampling.py`).
+    """
+    cut = partition_cut(cfg, round_, row_offset, peers, n_global)
+    if cut is None:
+        return lat
     return jnp.where(cut, jnp.int32(cfg.timeout_rounds()), lat)
 
 
@@ -659,16 +679,33 @@ def _static_single_age(cfg: AvalancheConfig):
     return None
 
 
+class _RingAgeView(NamedTuple):
+    """Whole-ring per-age planes, oldest-age-first (see
+    `_ring_age_view`).  The ONE spelling of the mod-depth age
+    arithmetic — the delivery engines consume `slots`/`consider`/
+    `present`; `ring_telemetry` reads the raw `ages`/`lat`/`deliver`/
+    `expire` planes so its counters can never desync from what the
+    engines deliver."""
+
+    slots: jax.Array     # int32 [D] — processing index -> ring slot
+    consider: jax.Array  # bool [D, rows, k] — delivering AND responded
+    present: jax.Array   # bool [D, rows, k] — window-shifting this round
+    ages: jax.Array      # int32 [D] — age per processing index
+    lat: jax.Array       # int32 [D, rows, k] — latencies, slot-gathered
+    deliver: jax.Array   # bool [D, rows, k] — latency matches age (raw)
+    expire: jax.Array    # bool [D, rows, k] — timeout reap (raw)
+
+
 def _ring_age_view(ring: InflightState, cfg: AvalancheConfig,
-                   round_: jax.Array):
+                   round_: jax.Array) -> _RingAgeView:
     """Whole-ring deliverable/expiry masks, oldest-age-first.
 
-    Returns ``(slots, consider, present)``: `slots` int32 ``[D]`` maps
-    PROCESSING index i (age ``timeout - i``: i=0 is the expiring age,
-    i=depth-1 the round's own enqueue) to its ring slot; the masks are
-    bool ``[D, rows, k]`` — the same per-age masks the walk computes
-    one `fori_loop` iteration at a time, materialized for the whole
-    ring at once from the ring's (no-T) latency planes.
+    `slots` int32 ``[D]`` maps PROCESSING index i (age ``timeout - i``:
+    i=0 is the expiring age, i=depth-1 the round's own enqueue) to its
+    ring slot; the masks are bool ``[D, rows, k]`` — the same per-age
+    masks the walk computes one `fori_loop` iteration at a time,
+    materialized for the whole ring at once from the ring's (no-T)
+    latency planes.
     """
     timeout = cfg.timeout_rounds()
     depth = timeout + 1
@@ -683,7 +720,8 @@ def _ring_age_view(ring: InflightState, cfg: AvalancheConfig,
     present = deliver | expire
     if cfg.skip_absent_votes:
         present = present & consider
-    return slots, consider, present
+    return _RingAgeView(slots=slots, consider=consider, present=present,
+                        ages=ages, lat=lat, deliver=deliver, expire=expire)
 
 
 def _vote_transition(votes, consider, confidence, yes_cnt, cons_cnt,
@@ -785,7 +823,8 @@ def deliver_multi_coalesced(
     Compiled size is O(k), like the walk.
     """
     k = cfg.k
-    slots, consider, present = _ring_age_view(ring, cfg, round_)
+    view = _ring_age_view(ring, cfg, round_)
+    slots, consider, present = view.slots, view.consider, view.present
     any_present = present.any(axis=(1, 2))               # [D] flags
     timeout = jnp.int32(cfg.timeout_rounds())
 
@@ -870,7 +909,8 @@ def deliver_1d_coalesced(
     per-age activity cond drains exactly the ages with something to
     deliver."""
     k = cfg.k
-    slots, consider, present = _ring_age_view(ring, cfg, round_)
+    view = _ring_age_view(ring, cfg, round_)
+    slots, consider, present = view.slots, view.consider, view.present
     any_present = present.any(axis=(1, 2))               # [D] flags
     timeout = jnp.int32(cfg.timeout_rounds())
 
@@ -955,6 +995,66 @@ def deliver_1d_engine(
               "coalesced": deliver_1d_coalesced}[cfg.inflight_engine]
     return engine(ring, records, cfg, prefs, key, round_,
                   live_rows=live_rows)
+
+
+class RingTelemetry(NamedTuple):
+    """Per-round ring counters (int32 scalars) — (querier, draw) ENTRY
+    granularity, unlike the vote counters' (querier, draw, tx) votes."""
+
+    deliveries: jax.Array  # responses delivered (responded & on-time)
+    expiries: jax.Array    # entries expired unanswered at the timeout age
+    occupancy: jax.Array   # entries still in flight after this round
+
+
+def ring_telemetry(
+    ring: Optional[InflightState],
+    cfg: AvalancheConfig,
+    round_: jax.Array,
+) -> RingTelemetry:
+    """Ring activity counters for the round that just drained slot ages.
+
+    Everything comes from the ring's no-T latency planes — the same
+    ``[D, rows, k]`` masks every delivery engine derives per age
+    (`_ring_age_view`), reduced to three scalars; no gathers, no record
+    reads, engine- and layout-independent (the bit-packed coalesced ring
+    carries identical `lat`/`responded` planes).  Ages the ring has not
+    been through yet (``age > round_``: the init-time pre-expired slots
+    of the first ``D - 1`` rounds) are masked out, so an empty ring
+    reads 0 everywhere.
+
+      deliveries — entries whose latency matched their age this round
+                   AND whose issue-time `responded` bit is set (a
+                   non-responding draw leaves the ring silently at its
+                   delivery age: it delivers absence, not a vote);
+      expiries   — entries reaching the timeout age with the
+                   never-delivers sentinel (partition cuts, latency
+                   tails) — the host Processor's reap count;
+      occupancy  — entries below the timeout age whose latency is still
+                   ahead of them: the ring's fill AFTER this round's
+                   deliveries left it.
+
+    On a sharded driver the ring holds this shard's node rows: psum the
+    counters over the NODES axis only (the planes are replicated across
+    tx shards), which reproduces the dense counters bit-for-bit.
+    None ring (engine off) returns static zeros.
+    """
+    zero = jnp.int32(0)
+    if ring is None:
+        return RingTelemetry(zero, zero, zero)
+    # The engines' own age view (`_ring_age_view` — the one spelling of
+    # the mod-depth arithmetic); telemetry adds only the `issued` gate
+    # (slots the ring has not been through yet read as empty) and the
+    # still-pending mask.
+    v = _ring_age_view(ring, cfg, round_)
+    timeout = jnp.int32(cfg.timeout_rounds())
+    a3 = v.ages[:, None, None]
+    issued = (v.ages <= round_)[:, None, None]        # slot written yet?
+    pending = (v.lat > a3) & (a3 != timeout) & issued
+    return RingTelemetry(
+        deliveries=(v.consider & issued).sum().astype(jnp.int32),
+        expiries=(v.expire & issued).sum().astype(jnp.int32),
+        occupancy=pending.sum().astype(jnp.int32),
+    )
 
 
 def clear_columns(ring: Optional[InflightState],

@@ -297,6 +297,7 @@ def _local_round(
     # global-height plane, all_to_all + OR back to owner shards.
     added = state.added
     admissions = jnp.int32(0)
+    gossip_writes = jnp.int32(0)
     if cfg.gossip:
         heard_packed = _gossip_heard_packed(peers, polled, n_global,
                                             fused=cfg.fused_sharded_gossip)
@@ -304,6 +305,7 @@ def _local_round(
         new_adds = (heard & jnp.logical_not(added)
                     & alive_local[:, None] & state.valid[None, :])
         admissions = new_adds.sum().astype(jnp.int32)
+        gossip_writes = heard.sum().astype(jnp.int32)
         added = added | new_adds
 
     # --- preference exchange: pack local plane, all-gather, gather rows.
@@ -378,15 +380,38 @@ def _local_round(
                                tiled=True)
 
     # --- global telemetry: psum over both axes => replicated scalars.
+    # The ring counters come from planes sharded over NODE rows but
+    # REPLICATED across tx shards (`inflight.ring_telemetry` reads the
+    # no-T latency planes; the partition cut reads peers) — psum over
+    # the nodes axis ONLY, or every tx shard would be double-counted.
+    # Either way the result is replicated on both axes, and equals the
+    # dense round's counter bit-for-bit for the same trajectory.
     def _global_sum(x):
         return lax.psum(x.astype(jnp.int32), (NODES_AXIS, TXS_AXIS))
 
+    def _nodes_sum(x):
+        return lax.psum(x.astype(jnp.int32), NODES_AXIS)
+
+    zero = jnp.int32(0)
+    ring_tel = (zero, zero, zero)
+    if inflight.enabled(cfg):
+        rt = inflight.ring_telemetry(ring, cfg, state.round)
+        ring_tel = (_nodes_sum(rt.deliveries), _nodes_sum(rt.expiries),
+                    _nodes_sum(rt.occupancy))
+    cut = (inflight.partition_cut(cfg, state.round, offset, peers,
+                                  n_global)
+           if inflight.enabled(cfg) else None)
     telemetry = SimTelemetry(
         polls=_global_sum(polled.sum()),
         votes_applied=_global_sum(votes_applied),
         flips=_global_sum((changed & jnp.logical_not(newly_final)).sum()),
         finalizations=_global_sum(newly_final.sum()),
         admissions=_global_sum(admissions),
+        deliveries=ring_tel[0],
+        expiries=ring_tel[1],
+        ring_occupancy=ring_tel[2],
+        partition_blocked=(zero if cut is None else _nodes_sum(cut.sum())),
+        gossip_writes=(_global_sum(gossip_writes) if cfg.gossip else zero),
     )
     new_state = AvalancheSimState(
         records=records,

@@ -232,12 +232,32 @@ def _local_round(
     def _global_sum(x):
         return lax.psum(x.astype(jnp.int32), (NODES_AXIS, TXS_AXIS))
 
+    # Ring counters: node-row-sharded, TX-REPLICATED planes — psum over
+    # the nodes axis only (see parallel/sharded.py); no gossip in the
+    # DAG round, so those counters stay statically zero.
+    def _nodes_sum(x):
+        return lax.psum(x.astype(jnp.int32), NODES_AXIS)
+
+    zero = jnp.int32(0)
+    ring_tel = (zero, zero, zero)
+    if inflight.enabled(cfg):
+        rt = inflight.ring_telemetry(ring, cfg, base.round)
+        ring_tel = (_nodes_sum(rt.deliveries), _nodes_sum(rt.expiries),
+                    _nodes_sum(rt.occupancy))
+    cut = (inflight.partition_cut(cfg, base.round, offset, peers,
+                                  n_global)
+           if inflight.enabled(cfg) else None)
     telemetry = av.SimTelemetry(
         polls=_global_sum(polled.sum()),
         votes_applied=_global_sum(votes_applied),
         flips=_global_sum((changed & jnp.logical_not(newly_final)).sum()),
         finalizations=_global_sum(newly_final.sum()),
         admissions=jnp.int32(0),
+        deliveries=ring_tel[0],
+        expiries=ring_tel[1],
+        ring_occupancy=ring_tel[2],
+        partition_blocked=(zero if cut is None else _nodes_sum(cut.sum())),
+        gossip_writes=jnp.int32(0),
     )
     new_base = av.AvalancheSimState(
         records=records, added=base.added, valid=base.valid,

@@ -85,7 +85,34 @@ def build_config(args: argparse.Namespace) -> AvalancheConfig:
         stream_retire_cap=getattr(args, "stream_retire_cap", None),
         ingest_engine=getattr(args, "ingest_engine", "u8"),
         inflight_engine=getattr(args, "inflight_engine", "walk"),
+        metrics_every=(getattr(args, "metrics_every", 0)
+                       if getattr(args, "metrics", None) else 0),
     )
+
+
+def _watchdog_run(state, cfg: AvalancheConfig, max_rounds: int,
+                  round_step, settled) -> tuple:
+    """`--check-invariants` driver: jitted single-round stepping with the
+    host-side invariant watchdog (`obs/watchdog.py`) between rounds.
+
+    Trades the fused while-loop for one dispatch + one device_get per
+    round — the debug mode whose whole point is observing every
+    intermediate state.  Returns ``(final_state, checks_run)``; raises
+    `obs.InvariantViolation` (with offender indices) on the first
+    violated invariant.
+    """
+    from go_avalanche_tpu import obs
+
+    step = jax.jit(lambda s: round_step(s, cfg)[0])
+    settled_fn = jax.jit(lambda s: settled(s, cfg))
+    wd = obs.Watchdog(cfg)
+    wd.check(state)
+    for _ in range(max_rounds):
+        if bool(jax.device_get(settled_fn(state))):
+            break
+        state = step(state)
+        wd.check(state)
+    return state, wd.checks
 
 
 def run_snowball(args, cfg: AvalancheConfig) -> Dict:
@@ -94,8 +121,17 @@ def run_snowball(args, cfg: AvalancheConfig) -> Dict:
 
     state = sb.init(jax.random.key(args.seed), args.nodes, cfg,
                     yes_fraction=args.yes_fraction)
-    state = jax.jit(sb.run, static_argnames=("cfg", "max_rounds"))(
-        state, cfg, args.max_rounds)
+    out = {}
+    if args.check_invariants:
+        def settled(s, cfg):
+            return jnp.logical_not((jnp.logical_not(vr.has_finalized(
+                s.records.confidence, cfg)) & s.alive).any())
+
+        state, out["invariant_checks"] = _watchdog_run(
+            state, cfg, args.max_rounds, sb.round_step, settled)
+    else:
+        state = jax.jit(sb.run, static_argnames=("cfg", "max_rounds"))(
+            state, cfg, args.max_rounds)
     fin = np.asarray(jax.device_get(
         vr.has_finalized(state.records.confidence, cfg)))
     pref = np.asarray(jax.device_get(
@@ -104,6 +140,7 @@ def run_snowball(args, cfg: AvalancheConfig) -> Dict:
         "rounds": int(jax.device_get(state.round)),
         "finalized_fraction": float(fin.mean()),
         "yes_fraction": float(pref[fin].mean()) if fin.any() else None,
+        **out,
     }
 
 
@@ -133,6 +170,7 @@ def run_avalanche(args, cfg: AvalancheConfig) -> Dict:
                  if args.contested else None)
     state = av.init(jax.random.key(args.seed), args.nodes, args.txs, cfg,
                     init_pref=init_pref)
+    extra = {}
     if args.mesh:
         from go_avalanche_tpu.parallel import sharded
 
@@ -141,6 +179,9 @@ def run_avalanche(args, cfg: AvalancheConfig) -> Dict:
         state = sharded.run_sharded(mesh, state, cfg,
                                     max_rounds=args.max_rounds,
                                     donate=args.donate)
+    elif args.check_invariants:
+        state, extra["invariant_checks"] = _watchdog_run(
+            state, cfg, args.max_rounds, av.round_step, av.all_settled)
     else:
         # av.run jits itself (static cfg/max_rounds); donate frees the
         # double-buffered [N, T] planes — the init state is not reused.
@@ -151,6 +192,7 @@ def run_avalanche(args, cfg: AvalancheConfig) -> Dict:
         "rounds": int(jax.device_get(state.round)),
         "finalized_fraction": float(fin.mean()),
         "nodes_fully_finalized": int(fin.all(axis=1).sum()),
+        **extra,
     }
     out.update({f"finality_{k}": v for k, v in
                 metrics.rounds_to_finality(state.finalized_at).items()})
@@ -162,6 +204,7 @@ def run_dag(args, cfg: AvalancheConfig) -> Dict:
 
     conflict_set = jnp.arange(args.txs, dtype=jnp.int32) // args.conflict_size
     state = dag.init(jax.random.key(args.seed), args.nodes, conflict_set, cfg)
+    extra = {}
     if args.mesh:
         from go_avalanche_tpu.parallel import sharded_dag
 
@@ -170,6 +213,9 @@ def run_dag(args, cfg: AvalancheConfig) -> Dict:
         state = sharded_dag.run_sharded_dag(mesh, state, cfg,
                                             max_rounds=args.max_rounds,
                                             donate=args.donate)
+    elif args.check_invariants:
+        state, extra["invariant_checks"] = _watchdog_run(
+            state, cfg, args.max_rounds, dag.round_step, dag.settled)
     else:
         state = jax.jit(dag.run, static_argnames=("cfg", "max_rounds"))(
             state, cfg, args.max_rounds)
@@ -188,6 +234,7 @@ def run_dag(args, cfg: AvalancheConfig) -> Dict:
         "rounds": int(jax.device_get(state.base.round)),
         "sets_resolved_fraction": float((winners_per_set == 1).mean()),
         "conflict_sets": n_sets,
+        **extra,
     }
 
 
@@ -470,6 +517,39 @@ def main(argv=None) -> Dict:
                         help="emit one JSON line instead of key=value text")
     parser.add_argument("--trace", type=str, default=None,
                         help="write a JAX profiler trace to this directory")
+    parser.add_argument("--metrics", type=str, default=None, metavar="PATH",
+                        help="stream per-round telemetry to this JSONL file "
+                             "through the in-graph metrics tap "
+                             "(go_avalanche_tpu/obs: one unordered "
+                             "io_callback per emitted round inside the "
+                             "compiled loop — the flight recorder) and "
+                             "write a run manifest next to it "
+                             "(PATH.manifest.json).  Models whose round "
+                             "body carries the tap: snowball, avalanche, "
+                             "dag, backlog, streaming_dag (the streaming "
+                             "schedulers inherit it from the inner "
+                             "round).  Sharded runs stream host-side "
+                             "instead (obs.MetricsSink.write_stacked — "
+                             "see examples/partition_outage.py), so "
+                             "--metrics excludes --mesh")
+    parser.add_argument("--metrics-every", type=int, default=0,
+                        metavar="N",
+                        help="emit every N-th round (cfg.metrics_every); "
+                             "defaults to 1 when --metrics is given, 0 "
+                             "(tap statically absent — every hlo_pin "
+                             "hash unchanged) otherwise")
+    parser.add_argument("--check-invariants", action="store_true",
+                        help="debug mode (obs/watchdog.py): step the sim "
+                             "one jitted round at a time and assert the "
+                             "structural invariants on the host between "
+                             "rounds — confidence counter caps, window "
+                             "bit hygiene, ring ages/depth, packed-plane "
+                             "padding, finalized-count monotonicity.  "
+                             "Raises InvariantViolation with offender "
+                             "indices on the first failure.  Models: "
+                             "snowball, avalanche, dag (dense; the "
+                             "streaming schedulers legitimately reset "
+                             "refilled columns)")
     args = parser.parse_args(argv)
 
     if args.mesh and args.model not in ("avalanche", "dag", "backlog",
@@ -489,6 +569,30 @@ def main(argv=None) -> Dict:
                      "sharded backend has its own dispatch loop)")
     if args.checkpoint and not args.chunk:
         parser.error("--checkpoint requires --chunk")
+    if args.check_invariants:
+        if args.model not in ("snowball", "avalanche", "dag"):
+            parser.error(f"--check-invariants supports models snowball/"
+                         f"avalanche/dag, not {args.model}")
+        if args.mesh:
+            parser.error("--check-invariants is a dense debug mode (the "
+                         "sharded while-loop drivers never surface "
+                         "intermediate states to the host)")
+    if args.metrics:
+        if args.model in ("slush", "snowflake"):
+            parser.error(f"--metrics needs a round body carrying the "
+                         f"in-graph tap; the family models "
+                         f"(slush/snowflake) predate it — got "
+                         f"{args.model}")
+        if args.mesh:
+            parser.error("--metrics is the dense in-graph tap; sharded "
+                         "drivers stream stacked telemetry host-side "
+                         "(obs.MetricsSink.write_stacked — see "
+                         "examples/partition_outage.py)")
+        if args.metrics_every == 0:
+            args.metrics_every = 1
+    elif args.metrics_every:
+        parser.error("--metrics-every requires --metrics (without a sink "
+                     "the tap's records are dropped)")
     cfg = build_config(args)
     runner = {"slush": run_slush, "snowflake": run_snowflake,
               "snowball": run_snowball, "avalanche": run_avalanche,
@@ -496,9 +600,29 @@ def main(argv=None) -> Dict:
               "streaming_dag": run_streaming_dag}[args.model]
 
     ctx = tracing.trace(args.trace) if args.trace else contextlib.nullcontext()
+    if args.metrics:
+        from go_avalanche_tpu import obs
+
+        sink_ctx = obs.metrics_sink(args.metrics,
+                                    tag=obs.tag_from_config(cfg))
+    else:
+        sink_ctx = contextlib.nullcontext()
     t0 = time.perf_counter()
-    with ctx:
+    with ctx, sink_ctx as sink:
         result = runner(args, cfg)
+    extra = {}
+    if sink is not None:
+        # The sink context drained in-flight callbacks and closed on
+        # exit; records_written is final here.
+        obs.write_manifest(args.metrics, cfg, extra={
+            "model": args.model,
+            "workload": {"nodes": args.nodes, "txs": args.txs,
+                         "max_rounds": args.max_rounds,
+                         "seed": args.seed},
+            "tag": obs.tag_from_config(cfg),
+        })
+        extra = {"metrics_records": sink.records_written,
+                 "metrics_file": str(sink.path)}
     result = {
         "model": args.model,
         "nodes": args.nodes,
@@ -506,6 +630,7 @@ def main(argv=None) -> Dict:
         if args.model not in ("snowball", "slush", "snowflake") else 1,
         "backend": jax.devices()[0].platform,
         **result,
+        **extra,
         "elapsed_s": round(time.perf_counter() - t0, 3),
     }
     if args.json:

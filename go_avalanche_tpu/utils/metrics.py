@@ -100,9 +100,14 @@ def votes_per_second(total_votes: int, seconds: float) -> float:
 
 
 def telemetry_summary(telemetry) -> Dict[str, int]:
-    """Sum stacked per-round telemetry into run totals."""
+    """Sum stacked per-round telemetry into run totals.
+
+    ONE `jax.device_get` on the whole telemetry pytree — a single
+    device->host transfer however many fields the tuple grows — then
+    host-side sums per field.
+    """
+    host = jax.device_get(telemetry)
     return {
-        field: int(np.asarray(jax.device_get(getattr(telemetry, field)))
-                   .sum())
-        for field in telemetry._fields
+        field: int(np.asarray(getattr(host, field)).sum())
+        for field in host._fields
     }

@@ -132,6 +132,23 @@ def test_hlo_pin_hashes_match_archive():
         pytest.skip(f"no {platform} pins archived yet")
 
 
+def test_hlo_pin_metrics_off_path_is_statically_absent():
+    """`verify_off_path`: every metrics-OFF flagship program, re-lowered
+    with `metrics_every=0` forced explicitly, is byte-identical to its
+    archived pin — the observability tap must be statically absent from
+    the default path, not merely defaulted off (the PR 5 acceptance
+    criterion).  Each program re-lowers under a DISTINCT explicit-0
+    cache key (so this check can fail independently of the drift test),
+    plus the converse anchor: flagship_metrics with the tap forced off
+    must hash to the flagship pin."""
+    import jax
+
+    from benchmarks import hlo_pin
+
+    failures = hlo_pin.verify_off_path(jax.default_backend())
+    assert not failures, "\n".join(failures)
+
+
 def test_hlo_pin_list_and_check_cli(tmp_path):
     """`--list` names every archived program without touching jax, and
     the check mode exits 0 against the committed archive (the CLI twin

@@ -1,0 +1,253 @@
+"""Observability layer (go_avalanche_tpu/obs): metric-tag format pin,
+JSONL sink (host-side streaming + the in-graph io_callback tap), run
+manifests, and the invariant watchdog."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_avalanche_tpu import obs
+from go_avalanche_tpu.config import AvalancheConfig
+from go_avalanche_tpu.models import avalanche as av
+from go_avalanche_tpu.ops import inflight
+
+TIMING = dict(time_step_s=1.0, request_timeout_s=3.0)
+
+
+def _async_cfg(**kw):
+    base = dict(finalization_score=16, latency_mode="geometric",
+                latency_rounds=2, **TIMING)
+    base.update(kw)
+    return AvalancheConfig(**base)
+
+
+# --- tag_from_config: the format is the join key of every archived
+# BENCH_r*.json delta chain — these pins are the contract.
+
+def test_tag_default_config_is_empty():
+    assert obs.tag_from_config(AvalancheConfig()) == ""
+
+
+@pytest.mark.parametrize("cfg,expected", [
+    (AvalancheConfig(fused_exchange=False), ", legacy-exchange"),
+    (AvalancheConfig(ingest_engine="swar32"), ", swar32-ingest"),
+    (AvalancheConfig(metrics_every=2), ", metrics2"),
+    (_async_cfg(), ", latency2, geometric-latency, timeout4"),
+    (_async_cfg(latency_mode="fixed", request_timeout_s=5.0,
+                inflight_engine="coalesced"),
+     ", latency2, coalesced-inflight"),
+    (_async_cfg(latency_mode="fixed", request_timeout_s=5.0,
+                partition_spec=(2, 6, 0.5)),
+     ", latency2, partition"),
+    (AvalancheConfig(fused_exchange=False, ingest_engine="swar32",
+                     metrics_every=1),
+     ", legacy-exchange, swar32-ingest, metrics1"),
+])
+def test_tag_format_pinned(cfg, expected):
+    assert obs.tag_from_config(cfg) == expected
+
+
+def test_tag_matches_bench_historic_spelling():
+    """The exact concatenation bench.py used to build inline, for the
+    PR 4 A/B lane flags (--latency 2 --inflight-engine coalesced):
+    renaming any fragment breaks every archived delta chain."""
+    cfg = _async_cfg(latency_mode="fixed", inflight_engine="coalesced",
+                     request_timeout_s=5.0)  # timeout 6 = 2*2+2 default
+    assert obs.tag_from_config(cfg) == ", latency2, coalesced-inflight"
+
+
+# --- MetricsSink: file format + host-side stacked streaming.
+
+def test_sink_writes_jsonl_with_tag(tmp_path):
+    path = tmp_path / "m.jsonl"
+    with obs.metrics_sink(path, tag=", swar32-ingest") as sink:
+        sink.write({"round": 0, "polls": 7})
+        sink.write({"round": 1, "polls": 9})
+    rows = [json.loads(l) for l in path.read_text().splitlines()]
+    assert rows == [{"polls": 7, "round": 0, "tag": ", swar32-ingest"},
+                    {"polls": 9, "round": 1, "tag": ", swar32-ingest"}]
+    assert sink.records_written == 2
+
+
+def test_sink_write_stacked_strides_and_flattens(tmp_path):
+    cfg = AvalancheConfig(finalization_score=8)
+    state = av.init(jax.random.key(0), 16, 8, cfg)
+    _, tel = av.run_scan(state, cfg, 6)
+    path = tmp_path / "s.jsonl"
+    with obs.metrics_sink(path) as sink:
+        wrote = sink.write_stacked(tel, every=2)
+    rows = [json.loads(l) for l in path.read_text().splitlines()]
+    assert wrote == 3 and [r["round"] for r in rows] == [0, 2, 4]
+    host = jax.device_get(tel)
+    for r in rows:
+        for f in tel._fields:
+            assert r[f] == int(np.asarray(getattr(host, f))[r["round"]])
+
+
+# --- the in-graph tap: off path statically absent, on path equals the
+# stacked telemetry row-for-row.
+
+def test_emit_round_off_path_lowers_no_callback():
+    cfg = AvalancheConfig(finalization_score=8)
+    state = av.init(jax.random.key(0), 16, 8, cfg)
+    off = jax.jit(lambda s: av.round_step(s, cfg)[0]).lower(state)
+    assert "callback" not in off.as_text()
+    on_cfg = dataclasses.replace(cfg, metrics_every=2)
+    on = jax.jit(lambda s: av.round_step(s, on_cfg)[0]).lower(state)
+    assert "callback" in on.as_text()
+
+
+def test_in_graph_tap_matches_stacked_telemetry(tmp_path):
+    """Flight-recorder correctness: records streamed by the io_callback
+    tap from inside the compiled scan equal the stacked telemetry the
+    same scan returns, on the strided rounds."""
+    every = 2
+    cfg = _async_cfg(metrics_every=every, partition_spec=(2, 5, 0.5))
+    state = av.init(jax.random.key(1), 16, 8, cfg,
+                    init_pref=av.contested_init_pref(1, 16, 8))
+    path = tmp_path / "tap.jsonl"
+    with obs.metrics_sink(path, tag=obs.tag_from_config(cfg)):
+        _, tel = av.run_scan(state, cfg, 9)
+    rows = sorted((json.loads(l) for l in path.read_text().splitlines()),
+                  key=lambda r: r["round"])
+    assert [r["round"] for r in rows] == list(range(0, 9, every))
+    host = jax.device_get(tel)
+    for r in rows:
+        for f in tel._fields:
+            assert r[f] == int(np.asarray(getattr(host, f))[r["round"]]), f
+    # The async counters must actually count (partition active rounds
+    # 2..5 block queries; geometric latency keeps the ring occupied).
+    assert sum(r["partition_blocked"] for r in rows) > 0
+    assert sum(r["ring_occupancy"] for r in rows) > 0
+
+
+def test_tap_without_active_sink_drops_records():
+    cfg = AvalancheConfig(finalization_score=8, metrics_every=1)
+    state = av.init(jax.random.key(0), 8, 8, cfg)
+    final, _ = av.run_scan(state, cfg, 3)  # no sink: must not raise
+    assert int(jax.device_get(final.round)) == 3
+
+
+# --- run manifest.
+
+def test_manifest_written_next_to_metrics(tmp_path):
+    cfg = AvalancheConfig(ingest_engine="swar32")
+    metrics_file = tmp_path / "trace.jsonl"
+    p = obs.write_manifest(metrics_file, cfg, extra={"tag": ", x"})
+    assert p == tmp_path / "trace.jsonl.manifest.json"
+    m = json.loads(p.read_text())
+    assert m["config"]["ingest_engine"] == "swar32"
+    assert m["jax"] == jax.__version__
+    assert m["devices"]["platform"] == "cpu"
+    assert m["tag"] == ", x"
+    # hlo_pins joins the trace to its compiled-program generation.
+    assert "flagship" in m["hlo_pins"]
+
+
+# --- invariant watchdog.
+
+def _records_state(n=8, t=8, cfg=None):
+    cfg = cfg or AvalancheConfig()
+    return av.init(jax.random.key(0), n, t, cfg)
+
+
+def test_watchdog_passes_clean_run():
+    cfg = AvalancheConfig(finalization_score=8)
+    state = _records_state(cfg=cfg)
+    wd = obs.Watchdog(cfg)
+    step = jax.jit(lambda s: av.round_step(s, cfg)[0])
+    for _ in range(6):
+        state = step(state)
+        wd.check(state)
+    assert wd.checks == 6
+
+
+def test_watchdog_counter_cap():
+    cfg = AvalancheConfig(finalization_score=8)
+    state = _records_state(cfg=cfg)
+    # Overshoot within the crossing call's k votes is legal ...
+    legal = (cfg.finalization_score + cfg.k - 1) << 1
+    recs = state.records._replace(confidence=jnp.full_like(
+        state.records.confidence, jnp.uint16(legal)))
+    obs.check_records(recs, cfg)
+    # ... one more bump is corruption.
+    recs = state.records._replace(confidence=jnp.full_like(
+        state.records.confidence, jnp.uint16(legal + 2)))
+    with pytest.raises(obs.InvariantViolation, match="finalization_score"):
+        obs.check_records(recs, cfg)
+
+
+def test_watchdog_saturation_cap():
+    cfg = AvalancheConfig(finalization_score=0x7FFF)
+    state = _records_state(cfg=cfg)
+    recs = state.records._replace(confidence=jnp.full_like(
+        state.records.confidence, jnp.uint16(0xFFFF)))  # counter 0x7FFF ok
+    obs.check_records(recs, cfg)
+
+
+def test_watchdog_window_bits():
+    cfg = AvalancheConfig(window=4, quorum=3)
+    state = _records_state(cfg=cfg)
+    recs = state.records._replace(votes=jnp.full_like(
+        state.records.votes, jnp.uint8(0x10)))  # bit above window 4
+    with pytest.raises(obs.InvariantViolation, match="window"):
+        obs.check_records(recs, cfg)
+
+
+def test_watchdog_ring_latency_and_padding():
+    cfg = _async_cfg(inflight_engine="coalesced")
+    n, t = 8, 12  # t=12: packed plane has 4 padding bits per row byte-pair
+    ring = inflight.init_ring(cfg, n, t)
+    obs.check_ring(ring, cfg, t=t)
+    bad = ring._replace(lat=ring.lat.at[0, 0, 0].set(
+        jnp.int32(cfg.timeout_rounds() + 1)))
+    with pytest.raises(obs.InvariantViolation, match="latency"):
+        obs.check_ring(bad, cfg, t=t)
+    assert ring.polled.dtype == jnp.uint8  # the coalesced packed plane
+    bad = ring._replace(polled=ring.polled.at[..., -1].set(jnp.uint8(0x80)))
+    with pytest.raises(obs.InvariantViolation, match="padding"):
+        obs.check_ring(bad, cfg, t=t)
+
+
+def test_watchdog_finalized_monotonicity():
+    cfg = AvalancheConfig(finalization_score=8)
+    state = _records_state(cfg=cfg)
+    fin_conf = jnp.full_like(state.records.confidence,
+                             jnp.uint16(8 << 1))
+    wd = obs.Watchdog(cfg)
+    wd.check(state._replace(records=state.records._replace(
+        confidence=fin_conf)))
+    with pytest.raises(obs.InvariantViolation, match="decreased"):
+        wd.check(state)  # back to the unfinalized init records
+    # monotonic=False (streaming refills) accepts the same sequence.
+    wd2 = obs.Watchdog(cfg, monotonic=False)
+    wd2.check(state._replace(records=state.records._replace(
+        confidence=fin_conf)))
+    wd2.check(state)
+
+
+# --- run_sim integration: the CLI debug/observability modes.
+
+def test_run_sim_metrics_and_watchdog(tmp_path):
+    from go_avalanche_tpu import run_sim
+
+    path = tmp_path / "rs.jsonl"
+    result = run_sim.main([
+        "--model", "avalanche", "--nodes", "16", "--txs", "8",
+        "--max-rounds", "12", "--finalization-score", "8",
+        "--metrics", str(path), "--metrics-every", "3",
+        "--check-invariants", "--json"])
+    assert result["invariant_checks"] == result["rounds"] + 1
+    assert result["metrics_records"] > 0
+    rows = [json.loads(l) for l in path.read_text().splitlines()]
+    assert all(r["round"] % 3 == 0 for r in rows)
+    manifest = json.loads(
+        (tmp_path / "rs.jsonl.manifest.json").read_text())
+    assert manifest["model"] == "avalanche"
+    assert manifest["config"]["metrics_every"] == 3

@@ -1,0 +1,215 @@
+"""Telemetry parity: the async-era counters (PR 5) are bit-identical
+across delivery engines and execution backends.
+
+Two distinct claims, matching how the counters are computed:
+
+  * **engine parity** — walk / walk_earlyout / coalesced are bit-exact
+    on records, so two runs of the same trajectory must produce
+    IDENTICAL telemetry stacks, dense and sharded.
+  * **reduction parity** — the sharded drivers psum the ring counters
+    over the NODES axis only (the latency planes are tx-replicated —
+    parallel/sharded.py); for the SAME ring state, the psum'd counters
+    must equal the dense `inflight.ring_telemetry` formula applied to
+    the gathered global planes, bit-for-bit.  (Dense and sharded RUNS
+    draw different per-shard RNG streams, so cross-backend parity is
+    per-state, not per-trajectory — the same split every trajectory
+    test in tests/test_sharding.py makes.)
+
+Fast-lane sizes only — heavier grids ride the slow lane (tier-1 wall
+budget, ROADMAP)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_avalanche_tpu.config import AvalancheConfig
+from go_avalanche_tpu.models import avalanche as av
+from go_avalanche_tpu.models import dag, snowball
+from go_avalanche_tpu.ops import inflight
+from go_avalanche_tpu.parallel import sharded, sharded_dag
+from go_avalanche_tpu.parallel.mesh import make_mesh
+
+TIMING = dict(time_step_s=1.0, request_timeout_s=3.0)
+
+
+def _async_cfg(**kw):
+    base = dict(finalization_score=16, latency_mode="geometric",
+                latency_rounds=2, **TIMING)
+    base.update(kw)
+    return AvalancheConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    # 2 tx shards over t=12 => per-shard width 6 (NOT a multiple of 8):
+    # the coalesced ring's per-shard byte padding is live in the
+    # sharded tests below.
+    return make_mesh(n_node_shards=4, n_tx_shards=2)
+
+
+def _tel_dicts(tel):
+    return {f: np.asarray(jax.device_get(getattr(tel, f)))
+            for f in tel._fields}
+
+
+def _assert_stacks_equal(ta, tb, label):
+    da, db = _tel_dicts(ta), _tel_dicts(tb)
+    assert set(da) == set(db)
+    for f in da:
+        np.testing.assert_array_equal(da[f], db[f],
+                                      err_msg=f"{label}: field {f}")
+
+
+def _gathered_ring(state_inflight):
+    """Global (dense-layout) jnp view of a sharded ring's planes."""
+    host = jax.device_get(state_inflight)
+    return state_inflight._replace(
+        **{f: jnp.asarray(np.asarray(getattr(host, f)))
+           for f in state_inflight._fields})
+
+
+def _check_sharded_ring_counters(step, state, cfg, rounds, label):
+    """Reduction parity + partition accounting for one sharded driver.
+
+    Returns the stacked telemetry dicts (list per round)."""
+    dense_rt = jax.jit(inflight.ring_telemetry,
+                       static_argnames=("cfg",))
+    rows = []
+    for r in range(rounds):
+        state, tel = step(state)
+        rt = dense_rt(_gathered_ring(state.inflight), cfg, jnp.int32(r))
+        want = {"deliveries": rt.deliveries, "expiries": rt.expiries,
+                "ring_occupancy": rt.occupancy}
+        for field, w in want.items():
+            assert (int(jax.device_get(getattr(tel, field)))
+                    == int(jax.device_get(w))), (
+                f"{label} round {r}: sharded psum'd {field} != dense "
+                f"formula on the gathered ring")
+        rows.append({f: int(jax.device_get(getattr(tel, f)))
+                     for f in tel._fields})
+    return rows
+
+
+def test_sharded_ring_counters_equal_dense_formula(mesh):
+    # Fixed latency + a partition cut: every enqueued entry either
+    # delivers (lat 1 < timeout) or expires (cut entries stamped with
+    # the timeout sentinel) — exact conservation, checked below.
+    cfg = _async_cfg(latency_mode="fixed", latency_rounds=1,
+                     partition_spec=(2, 6, 0.5),
+                     inflight_engine="coalesced")
+    pref = av.contested_init_pref(3, 16, 12)
+    state = sharded.shard_state(
+        av.init(jax.random.key(3), 16, 12, cfg, init_pref=pref), mesh)
+    step = sharded.make_sharded_round_step(mesh, cfg)
+    rounds = 12
+    rows = _check_sharded_ring_counters(step, state, cfg, rounds,
+                                        "avalanche")
+    # Partition accounting: blocked only while the cut is active ...
+    blocked = [r["partition_blocked"] for r in rows]
+    assert sum(blocked[2:6]) > 0
+    assert sum(blocked[:2]) == 0 and sum(blocked[6:]) == 0
+    # ... every blocked entry is reaped exactly once, nothing else
+    # expires (fixed latency 1 always beats timeout 4), and the ring
+    # conserves entries: N*k enqueued per round.
+    assert sum(r["expiries"] for r in rows) == sum(blocked)
+    n, k = 16, cfg.k
+    assert (sum(r["deliveries"] for r in rows)
+            + sum(r["expiries"] for r in rows)
+            + rows[-1]["ring_occupancy"]) == n * k * rounds
+
+
+def test_sharded_dag_ring_counters_equal_dense_formula(mesh):
+    cfg = _async_cfg(latency_mode="fixed", latency_rounds=1)
+    cs = jnp.arange(12, dtype=jnp.int32) // 2
+    placed = sharded_dag.shard_dag_state(
+        dag.init(jax.random.key(5), 16, cs, cfg), mesh)
+    step = sharded_dag.make_sharded_dag_round_step(mesh, cfg)
+    rows = []
+    dense_rt = jax.jit(inflight.ring_telemetry, static_argnames=("cfg",))
+    state = placed
+    for r in range(6):
+        state, tel = step(state)
+        rt = dense_rt(_gathered_ring(state.base.inflight), cfg,
+                      jnp.int32(r))
+        assert int(jax.device_get(tel.deliveries)) == int(
+            jax.device_get(rt.deliveries)), r
+        assert int(jax.device_get(tel.expiries)) == int(
+            jax.device_get(rt.expiries)), r
+        assert int(jax.device_get(tel.ring_occupancy)) == int(
+            jax.device_get(rt.occupancy)), r
+        rows.append(int(jax.device_get(tel.deliveries)))
+    assert sum(rows) > 0
+
+
+def test_sharded_engine_pair_full_stack_parity(mesh):
+    """Same sharded trajectory, walk vs coalesced: the WHOLE telemetry
+    tuple (vote counters + ring counters) must match per round —
+    extends PR 4's records/votes_applied pin to every PR 5 field."""
+    walk = _async_cfg(partition_spec=(2, 6, 0.5))
+    coal = dataclasses.replace(walk, inflight_engine="coalesced")
+    pref = av.contested_init_pref(5, 16, 12)
+    s1 = sharded.shard_state(av.init(jax.random.key(5), 16, 12, walk,
+                                     init_pref=pref), mesh)
+    s2 = sharded.shard_state(av.init(jax.random.key(5), 16, 12, coal,
+                                     init_pref=pref), mesh)
+    step1 = sharded.make_sharded_round_step(mesh, walk)
+    step2 = sharded.make_sharded_round_step(mesh, coal, donate=True)
+    saw_blocked = 0
+    for r in range(8):
+        s1, t1 = step1(s1)
+        s2, t2 = step2(s2)
+        _assert_stacks_equal(t1, t2, f"sharded walk vs coalesced r{r}")
+        saw_blocked += int(jax.device_get(t1.partition_blocked))
+    assert saw_blocked > 0
+
+
+def test_walk_vs_coalesced_dense_telemetry():
+    base = _async_cfg()
+    pref = av.contested_init_pref(7, 16, 12)
+    stacks = {}
+    for engine in ("walk", "coalesced"):
+        cfg = dataclasses.replace(base, inflight_engine=engine)
+        state = av.init(jax.random.key(7), 16, 12, cfg, init_pref=pref)
+        _, stacks[engine] = av.run_scan(state, cfg, 10)
+    _assert_stacks_equal(stacks["walk"], stacks["coalesced"],
+                         "walk vs coalesced")
+    d = _tel_dicts(stacks["walk"])
+    assert d["deliveries"].sum() > 0 and d["ring_occupancy"].sum() > 0
+
+
+def test_snowball_ring_telemetry_counts():
+    cfg = _async_cfg(latency_mode="fixed", latency_rounds=1)
+    state = snowball.init(jax.random.key(0), 32, cfg, yes_fraction=0.5)
+    _, tel = snowball.run_scan(state, cfg, 8)
+    d = _tel_dicts(tel)
+    assert d["deliveries"].sum() > 0
+    assert d["ring_occupancy"].sum() > 0
+    # Fixed latency 1, no partition: nothing expires.
+    assert d["expiries"].sum() == 0
+
+
+@pytest.mark.slow
+def test_three_engine_dense_grid_through_cut_and_heal():
+    """All three engines, longer horizon, geometric latency tails (lat
+    can hit the timeout and expire), partition cut-and-heal — identical
+    stacks, conservation across the whole run."""
+    base = _async_cfg(partition_spec=(3, 9, 0.5))
+    pref = av.contested_init_pref(11, 16, 12)
+    stacks = {}
+    for engine in ("walk", "walk_earlyout", "coalesced"):
+        cfg = dataclasses.replace(base, inflight_engine=engine)
+        state = av.init(jax.random.key(11), 16, 12, cfg, init_pref=pref)
+        _, stacks[engine] = av.run_scan(state, cfg, 20)
+    _assert_stacks_equal(stacks["walk"], stacks["walk_earlyout"],
+                         "walk vs earlyout")
+    _assert_stacks_equal(stacks["walk"], stacks["coalesced"],
+                         "walk vs coalesced")
+    d = _tel_dicts(stacks["walk"])
+    n, k, rounds = 16, base.k, 20
+    assert (d["deliveries"].sum() + d["expiries"].sum()
+            + d["ring_occupancy"][-1]) == n * k * rounds
