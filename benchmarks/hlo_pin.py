@@ -35,6 +35,12 @@ entry):
                      custom call's process-local pointer is normalized
                      before hashing (`strip_locations`); the OFF path
                      is covered by `--verify-off-path`;
+  flagship_faults  — the async flagship under a scheduled fault script
+                     (one partition + one latency spike,
+                     `cfg.fault_script`, PR 6) — the fault-script
+                     engine's on-path program.  The OFF path (empty
+                     script == every archived pin byte-identical) is
+                     covered by `--verify-off-path`;
   streaming_step   — one `models/streaming_dag.step` at the roofline's
                      streaming shape (the north-star scheduler's inner
                      program).
@@ -81,7 +87,8 @@ def flagship_stablehlo(nodes: int, txs: int, rounds: int, k: int,
                        ingest: str = "u8",
                        latency: int = 0,
                        inflight: str = "walk",
-                       metrics_every: int = 0) -> str:
+                       metrics_every: int = 0,
+                       faults=None) -> str:
     """StableHLO text of the flagship bench program at the given shape.
 
     Abstract lowering: `jax.eval_shape` turns the state builder into
@@ -91,7 +98,10 @@ def flagship_stablehlo(nodes: int, txs: int, rounds: int, k: int,
     itself.  `metrics_every > 0` is the in-graph metrics tap
     (`bench.py --metrics`): its io_callback custom call embeds a
     process-local callback pointer, which `strip_locations` normalizes
-    so the pin is stable across processes.
+    so the pin is stable across processes.  `faults` is a JSON-spelled
+    fault script (`config.fault_script_from_json`) — ``[]`` forces an
+    EXPLICIT empty script (how `--verify-off-path` proves empty ==
+    absent), None leaves the field absent.
     """
     import jax
 
@@ -104,6 +114,11 @@ def flagship_stablehlo(nodes: int, txs: int, rounds: int, k: int,
         cfg = dataclasses.replace(cfg, fused_exchange=False)
     if ingest != "u8":
         cfg = dataclasses.replace(cfg, ingest_engine=ingest)
+    if faults is not None:
+        from go_avalanche_tpu.config import fault_script_from_json
+
+        cfg = dataclasses.replace(cfg,
+                                  fault_script=fault_script_from_json(faults))
     state_abs = jax.eval_shape(
         lambda: flagship_state(nodes, txs, k, latency,
                                inflight_engine=inflight)[0])
@@ -142,15 +157,21 @@ PROGRAMS = {
                                  lambda w: flagship_stablehlo(**w)),
     "flagship_metrics": (dict(FLAGSHIP, metrics_every=2),
                          lambda w: flagship_stablehlo(**w)),
+    "flagship_faults": (dict(FLAGSHIP, latency=2,
+                             faults=[["partition", 5, 10, 0.5],
+                                     ["latency_spike", 12, 15, 2]]),
+                        lambda w: flagship_stablehlo(**w)),
     "streaming_step": (dict(STREAMING),
                        lambda w: streaming_step_stablehlo(**w)),
 }
 
-# The metrics-OFF flagship programs: with cfg.metrics_every == 0 (the
-# default) the obs tap must be STATICALLY absent, i.e. these programs'
-# hashes must not move however the observability layer evolves.
-# `--verify-off-path` re-lowers each with metrics_every=0 forced
-# explicitly and checks the archived pin.
+# The off-path flagship programs: with cfg.metrics_every == 0 and an
+# empty fault script (the defaults) the obs tap AND the fault-script
+# engine must both be STATICALLY absent, i.e. these programs' hashes
+# must not move however the observability or fault layers evolve.
+# `--verify-off-path` re-lowers each with metrics_every=0 and
+# faults=[] (an EXPLICIT empty script) forced and checks the archived
+# pin.
 OFF_PATH_PROGRAMS = ("flagship", "flagship_swar32", "flagship_async",
                      "flagship_async_coalesced")
 
@@ -201,19 +222,23 @@ def program_hash(name: str, workload: dict | None = None) -> str:
 
 
 def verify_off_path(platform: str, archive: dict | None = None) -> list:
-    """Check the metrics-OFF flagship programs are byte-identical to
-    their archived pins with `metrics_every=0` forced explicitly.
+    """Check the off-path flagship programs are byte-identical to their
+    archived pins with `metrics_every=0` AND an EMPTY fault script
+    (`faults=[]`) forced explicitly.
 
-    Proves the observability tap's OFF path is statically absent — the
-    compiled benchmark programs are the pre-obs ones — rather than
-    merely defaulted: each program here is RE-LOWERED with an explicit
-    zero (a distinct `program_hash` cache key from the drift test's
-    absent-key lowering, so this check can fail independently).  Also
-    checks the converse anchor: `flagship_metrics` with its tap forced
-    off must hash to the `flagship` pin — the tap is the ONLY delta
-    between the tapped and untapped programs.  Returns a list of
-    failure strings (empty = ok); programs without a pin for `platform`
-    are skipped.
+    Proves the observability tap's and the fault-script engine's OFF
+    paths are statically absent — the compiled benchmark programs are
+    the pre-obs, pre-fault ones — rather than merely defaulted: each
+    program here is RE-LOWERED with explicit zeros (a distinct
+    `program_hash` cache key from the drift test's absent-key lowering,
+    so this check can fail independently).  Also checks the converse
+    anchors: `flagship_metrics` with its tap forced off must hash to
+    the `flagship` pin — the tap is the ONLY delta between the tapped
+    and untapped programs — and `flagship_faults` with its script
+    forced empty must hash to the `flagship_async` pin — the scheduled
+    events are the ONLY delta between the faulted and fault-free async
+    programs.  Returns a list of failure strings (empty = ok);
+    programs without a pin for `platform` are skipped.
     """
     archive = archive or _load_archive()
     failures = []
@@ -226,24 +251,32 @@ def verify_off_path(platform: str, archive: dict | None = None) -> list:
             continue
         workload = dict(entry.get("workload") or PROGRAMS[name][0])
         workload["metrics_every"] = 0
+        workload["faults"] = []
         current = program_hash(name, workload)
         if current != pinned:
             failures.append(
-                f"{name}: metrics-off program {current} != pinned "
-                f"{pinned} — the obs tap leaks into the off path")
-    met = archive.get("programs", {}).get("flagship_metrics")
-    flag = archive.get("programs", {}).get("flagship")
-    if met and flag and flag.get("hashes", {}).get(platform):
-        workload = dict(met.get("workload") or PROGRAMS["flagship_metrics"][0])
-        workload["metrics_every"] = 0
-        current = program_hash("flagship_metrics", workload)
-        pinned = flag["hashes"][platform]
+                f"{name}: metrics-off empty-script program {current} != "
+                f"pinned {pinned} — the obs tap or the fault-script "
+                f"engine leaks into the off path")
+    for tapped, base, knob, what in (
+            ("flagship_metrics", "flagship", "metrics_every",
+             "the tapped program differs from the untapped one by more "
+             "than the tap"),
+            ("flagship_faults", "flagship_async", "faults",
+             "the faulted program differs from the fault-free async one "
+             "by more than the scheduled events")):
+        on = archive.get("programs", {}).get(tapped)
+        off = archive.get("programs", {}).get(base)
+        if not (on and off and off.get("hashes", {}).get(platform)):
+            continue
+        workload = dict(on.get("workload") or PROGRAMS[tapped][0])
+        workload[knob] = 0 if knob == "metrics_every" else []
+        current = program_hash(tapped, workload)
+        pinned = off["hashes"][platform]
         if current != pinned:
             failures.append(
-                f"flagship_metrics with the tap forced off hashes to "
-                f"{current} != the flagship pin {pinned} — the tapped "
-                f"program differs from the untapped one by more than "
-                f"the tap")
+                f"{tapped} with {knob} forced off hashes to {current} "
+                f"!= the {base} pin {pinned} — {what}")
     return failures
 
 
@@ -271,11 +304,13 @@ def main() -> None:
     parser.add_argument("--list", action="store_true",
                         help="list pinned programs and their hashes")
     parser.add_argument("--verify-off-path", action="store_true",
-                        help="check the metrics-OFF flagship programs "
-                             "(cfg.metrics_every=0 forced explicitly) "
-                             "are byte-identical to the archived pins — "
-                             "the observability tap must be statically "
-                             "absent on the default path")
+                        help="check the off-path flagship programs "
+                             "(cfg.metrics_every=0 AND an empty "
+                             "cfg.fault_script forced explicitly) are "
+                             "byte-identical to the archived pins — the "
+                             "observability tap and the fault-script "
+                             "engine must both be statically absent on "
+                             "the default path")
     args = parser.parse_args()
 
     archive = _load_archive()
@@ -302,8 +337,8 @@ def main() -> None:
             print("OFF-PATH DRIFT:\n  " + "\n  ".join(failures),
                   file=sys.stderr)
             sys.exit(1)
-        print(f"ok: metrics-off flagship programs match their "
-              f"[{platform}] pins")
+        print(f"ok: metrics-off empty-fault-script flagship programs "
+              f"match their [{platform}] pins")
         return
 
     if args.update is not None:

@@ -1,0 +1,427 @@
+"""Fault-scenario library: scripted outages with machine-checked recovery.
+
+The scheduled fault-script engine (`cfg.fault_script`, PR 6) turned the
+single static partition of PR 3 into a schedule of timed events —
+partitions that heal, regional outages, latency spikes, churn bursts —
+and `obs/recovery.py` turned "does the network recover, and how fast"
+from a chart into a machine-checked property.  This script is both at
+work: a small library of named scenarios, each a fault script with a
+story, each run emitting (optionally) a flight-recorder JSONL trace and
+always ending in a RECOVERY VERDICT — the `obs.verify_recovery` report
+checked against the very script that ran.
+
+Scenarios (`--list` for the one-liners):
+
+  partition_heal    — the PR 3 canonical study kept verbatim
+                      (`measure()`, both absence semantics): a 50/50
+                      cluster-aligned split that heals; finality stalls
+                      (neutral) or merely slows (skip), recovery trails
+                      the heal by the timeout.
+  cascading_outage  — two regional outages overlapping in time
+                      (cluster 0 drops at round 10, cluster 1 at 20,
+                      staggered heals): the recovery checker merges the
+                      overlapping cuts into ONE composite window —
+                      occupancy cannot return to baseline between two
+                      cuts that share rounds.
+  flaky_isp         — a topology-coupled latency story, no cut at all:
+                      an `rtt_matrix` makes cluster 2's links slow
+                      (3 rounds vs 1 intra-cluster), and two scheduled
+                      latency spikes push exactly those slow links past
+                      the timeout — an EXPIRY STORM with zero
+                      partition-blocked queries, the signature that
+                      tells "slow" from "severed" in a trace.
+  eclipse           — eclipse-style isolation of a small node fraction
+                      (a 12.5% split for 30 rounds): the eclipsed
+                      minority stalls — it can't reach quorum alone —
+                      while the majority barely notices; after the heal
+                      the minority catches up within one timeout.
+
+    python examples/fault_scenarios.py                    # all scenarios
+    python examples/fault_scenarios.py eclipse flaky_isp
+    python examples/fault_scenarios.py --metrics /tmp/faults.jsonl
+    python examples/fault_scenarios.py --json
+
+With `--metrics PATH`, each scenario streams its per-round telemetry to
+`PATH.<scenario>.jsonl` (host-side `obs.MetricsSink.write_stacked`, one
+line per round, manifest next to it) and the recovery verdict is then
+checked FROM THE FILE — trace out, verdict in, the full loop the tier-1
+recovery tests drive.  The same traces come out of
+`run_sim --fault-script script.json --metrics trace.jsonl` (in-graph
+tap; sort by `round`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def measure(
+    nodes: int = 512,
+    txs: int = 64,
+    partition_start: int = 5,
+    partition_end: int = 60,
+    timeout_rounds: int = 4,
+    latency_rounds: int = 1,
+    finalization_score: int = 48,
+    n_rounds: int = 130,
+    skip_absent: bool = False,
+    seed: int = 0,
+    metrics_path: str | None = None,
+) -> dict:
+    """One partition-outage run; returns per-round telemetry + summary.
+
+    The PR 3 canonical study, API kept verbatim (tests/test_inflight.py
+    pins its numbers): contested priors (per-node 50/50) so the network
+    must genuinely converge per tx; fixed `latency_rounds` response
+    latency inside each side; the partition splits the nodes 50/50 for
+    ``[partition_start, partition_end)`` — spelled `partition_spec`,
+    the one-event fault-script sugar.  With `metrics_path`, the stacked
+    telemetry streams to that JSONL file (one line per round, tagged
+    with the engine config) and a manifest lands next to it.
+    """
+    import jax
+    import numpy as np
+
+    from go_avalanche_tpu import obs
+    from go_avalanche_tpu.config import AvalancheConfig
+    from go_avalanche_tpu.models import avalanche as av
+    from go_avalanche_tpu.ops import voterecord as vr
+
+    cfg = AvalancheConfig(
+        finalization_score=finalization_score,
+        latency_mode="fixed",
+        latency_rounds=latency_rounds,
+        partition_spec=(partition_start, partition_end, 0.5),
+        time_step_s=1.0,
+        request_timeout_s=float(timeout_rounds - 1),
+        skip_absent_votes=skip_absent,
+    )
+    state = av.init(jax.random.key(seed), nodes, txs, cfg,
+                    init_pref=av.contested_init_pref(seed, nodes, txs))
+    final, tel = av.run_scan(state, cfg, n_rounds=n_rounds)
+    fins = np.asarray(jax.device_get(tel.finalizations))       # [rounds]
+    blocked = np.asarray(jax.device_get(tel.partition_blocked))
+    expiries = np.asarray(jax.device_get(tel.expiries))
+    occupancy = np.asarray(jax.device_get(tel.ring_occupancy))
+    fin_frac = float(np.asarray(jax.device_get(vr.has_finalized(
+        final.records.confidence, cfg))).mean())
+
+    if metrics_path:
+        # Host-side streaming: ONE device_get for the whole stacked
+        # pytree, one JSON line per round, manifest next to the file.
+        mode_tag = obs.tag_from_config(cfg) + (
+            ", skip-absent" if skip_absent else "")
+        with obs.metrics_sink(metrics_path, tag=mode_tag) as sink:
+            sink.write_stacked(tel)
+        obs.write_manifest(metrics_path, cfg, extra={
+            "study": "fault_scenarios.partition_heal",
+            "mode": "skip" if skip_absent else "neutral",
+            "workload": {"nodes": nodes, "txs": txs, "rounds": n_rounds,
+                         "seed": seed},
+        })
+
+    # The stall window: expiry semantics take one timeout to kick in
+    # after the cut, and recovery trails the heal by the timeout too.
+    stall_lo = partition_start + cfg.timeout_rounds()
+    stall_hi = partition_end
+    cum = np.cumsum(fins) / (nodes * txs)
+    return {
+        "mode": "skip" if skip_absent else "neutral",
+        "per_round_finalizations": fins.tolist(),
+        "per_round_blocked": blocked.tolist(),
+        "per_round_expiries": expiries.tolist(),
+        "per_round_ring_occupancy": occupancy.tolist(),
+        "finalized_fraction_final": fin_frac,
+        "finalized_fraction_at_cut": float(cum[partition_start - 1]),
+        "finalized_fraction_at_heal": float(cum[stall_hi - 1]),
+        "stall_window_finalizations": int(fins[stall_lo:stall_hi].sum()),
+        "post_heal_finalizations": int(fins[stall_hi:].sum()),
+        "blocked_total": int(blocked.sum()),
+        "expiries_total": int(expiries.sum()),
+        "peak_ring_occupancy": int(occupancy.max()),
+        "timeout_rounds": cfg.timeout_rounds(),
+        "metrics_file": metrics_path,
+        "config": {
+            "nodes": nodes, "txs": txs,
+            "partition": [partition_start, partition_end, 0.5],
+            "latency_rounds": latency_rounds,
+            "finalization_score": finalization_score,
+            "rounds": n_rounds,
+        },
+    }
+
+
+# ----------------------------------------------------------- scenarios
+
+def _cascading_outage(timing: dict) -> tuple:
+    """Two regions fail in cascade: cluster 0 at round 10, cluster 1 at
+    20, heals staggered at 30 and 40.  The windows OVERLAP, so the
+    recovery checker verifies them as one composite [10, 40) outage."""
+    from go_avalanche_tpu.config import AvalancheConfig
+
+    cfg = AvalancheConfig(
+        finalization_score=48,
+        n_clusters=4,
+        latency_mode="fixed", latency_rounds=1,
+        fault_script=(("regional_outage", 10, 30, 0),
+                      ("regional_outage", 20, 40, 1)),
+        **timing,
+    )
+    return cfg, 70, ("cluster 0 dark rounds [10, 30), cluster 1 "
+                     "[20, 40): one merged recovery window [10, 40)")
+
+
+def _flaky_isp(timing: dict) -> tuple:
+    """No cut anywhere — cluster 2 just sits behind a slow ISP
+    (cluster-pair RTT 3 vs 1 intra-cluster), and two latency spikes
+    push those slow links past the timeout: expiries WITHOUT blocked
+    queries, the trace signature separating 'slow' from 'severed'."""
+    from go_avalanche_tpu.config import AvalancheConfig
+
+    slow = 2
+    rtt = tuple(tuple(3 if slow in (i, j) and i != j else 1
+                      for j in range(4)) for i in range(4))
+    cfg = AvalancheConfig(
+        finalization_score=48,
+        n_clusters=4,
+        latency_mode="rtt", rtt_matrix=rtt,
+        fault_script=(("latency_spike", 12, 16, 2),
+                      ("latency_spike", 30, 34, 2)),
+        **timing,
+    )
+    # rtt 3 + spike 2 == 5 >= timeout 4 -> the slow links' draws become
+    # the never-delivers sentinel during each spike; intra-cluster
+    # draws (1 + 2 == 3 < 4) keep delivering.
+    return cfg, 60, ("cluster 2 at RTT 3 (others 1); spikes [12, 16) "
+                     "and [30, 34) push only its links past the "
+                     "timeout — expiry storms, zero blocked")
+
+
+def _eclipse(timing: dict) -> tuple:
+    """Eclipse-style isolation: a 12.5% node fraction is split off for
+    rounds [15, 45).  The eclipsed minority cannot reach quorum alone
+    (k-of-N draws mostly cross the cut and expire); the majority loses
+    only 1-in-8 draws and barely slows."""
+    from go_avalanche_tpu.config import AvalancheConfig
+
+    cfg = AvalancheConfig(
+        finalization_score=48,
+        latency_mode="fixed", latency_rounds=1,
+        fault_script=(("partition", 15, 45, 0.125),),
+        **timing,
+    )
+    return cfg, 80, ("12.5% of nodes eclipsed rounds [15, 45): the "
+                     "minority stalls, the majority shrugs, the "
+                     "minority catches up within one timeout of heal")
+
+
+SCENARIOS = {
+    "cascading_outage": _cascading_outage,
+    "flaky_isp": _flaky_isp,
+    "eclipse": _eclipse,
+}
+
+
+def run_scenario(
+    name: str,
+    nodes: int = 512,
+    txs: int = 64,
+    timeout_rounds: int = 4,
+    seed: int = 0,
+    metrics_path: str | None = None,
+) -> dict:
+    """Run one named scenario end-to-end: simulate, (optionally) emit
+    the flight-recorder trace + manifest, verify the recovery
+    invariants against the script that ran, return summary + verdict.
+    """
+    import jax
+    import numpy as np
+
+    from go_avalanche_tpu import obs
+    from go_avalanche_tpu.models import avalanche as av
+    from go_avalanche_tpu.obs.sink import _flatten_telemetry
+    from go_avalanche_tpu.ops import voterecord as vr
+
+    timing = dict(time_step_s=1.0,
+                  request_timeout_s=float(timeout_rounds - 1))
+    cfg, n_rounds, story = SCENARIOS[name](timing)
+    state = av.init(jax.random.key(seed), nodes, txs, cfg,
+                    init_pref=av.contested_init_pref(seed, nodes, txs))
+    final, tel = av.run_scan(state, cfg, n_rounds=n_rounds)
+
+    if metrics_path:
+        with obs.metrics_sink(metrics_path,
+                              tag=obs.tag_from_config(cfg)) as sink:
+            sink.write_stacked(tel)
+        obs.write_manifest(metrics_path, cfg, extra={
+            "study": f"fault_scenarios.{name}",
+            "workload": {"nodes": nodes, "txs": txs, "rounds": n_rounds,
+                         "seed": seed},
+        })
+        records = obs.recovery.load_trace(metrics_path)
+    else:
+        host = _flatten_telemetry(jax.device_get(tel), {})
+        records = [{"round": r,
+                    **{k: int(np.asarray(v[r])) for k, v in host.items()}}
+                   for r in range(n_rounds)]
+
+    report = obs.verify_recovery(cfg, records)
+    fin_frac = float(np.asarray(jax.device_get(vr.has_finalized(
+        final.records.confidence, cfg))).mean())
+    return {
+        "scenario": name,
+        "story": story,
+        "recovered": report.ok,
+        "violations": report.violations,
+        "windows": report.windows,
+        "totals": report.totals,
+        "finalized_fraction_final": fin_frac,
+        "per_round_finalizations": [int(r["finalizations"])
+                                    for r in records],
+        "per_round_blocked": [int(r["partition_blocked"])
+                              for r in records],
+        "per_round_expiries": [int(r["expiries"]) for r in records],
+        "metrics_file": metrics_path,
+        "rounds": n_rounds,
+    }
+
+
+def _strip(series) -> str:
+    peak = max(max(series), 1)
+    return "".join(
+        " .:-=+*#@"[min(8, (9 * f) // (peak + 1))] for f in series)
+
+
+def _print_partition_heal(results: list) -> None:
+    for r in results:
+        fins = r["per_round_finalizations"]
+        ps, pe = r["config"]["partition"][0], r["config"]["partition"][1]
+        print(f"\n== partition_heal / {r['mode']} absence semantics "
+              f"(timeout {r['timeout_rounds']} rounds) ==")
+        print(f"finalized fraction: at cut "
+              f"{r['finalized_fraction_at_cut']:.3f}"
+              f" | at heal {r['finalized_fraction_at_heal']:.3f}"
+              f" | final {r['finalized_fraction_final']:.3f}")
+        print(f"blocked queries: {r['blocked_total']} "
+              f"(all reaped: {r['expiries_total']} expiries); "
+              f"peak ring occupancy {r['peak_ring_occupancy']}")
+        print(f"rounds 0..{len(fins) - 1} (partition [{ps}, {pe})):")
+        print(f"finalizations |{_strip(fins)}|")
+        print(f"blocked       |{_strip(r['per_round_blocked'])}|")
+        print(f"expiries      |{_strip(r['per_round_expiries'])}|")
+        if r["metrics_file"]:
+            print(f"trace: {r['metrics_file']} (+ .manifest.json)")
+
+
+def _print_scenario(r: dict) -> None:
+    verdict = "RECOVERED" if r["recovered"] else "VIOLATED"
+    print(f"\n== {r['scenario']} ==")
+    print(r["story"])
+    print(f"recovery verdict: {verdict}"
+          + (f" — {len(r['violations'])} violation(s)"
+             if r["violations"] else ""))
+    for v in r["violations"]:
+        print(f"  ! {v}")
+    for w in r["windows"]:
+        rec = (f"recovered {w['recovery_rounds']} round(s) after heal"
+               if w["recovery_rounds"] is not None else "NOT recovered")
+        print(f"  cut [{w['start']}, {w['heal']}): {w['blocked']} draws "
+              f"blocked, {rec} (baseline occupancy "
+              f"{w['baseline_occupancy']})")
+    t = r["totals"]
+    print(f"totals: {t['blocked_total']} blocked, "
+          f"{t['expiries_total']} expiries, "
+          f"{t['deliveries_total']} deliveries, peak occupancy "
+          f"{t['peak_occupancy']}; finalized fraction "
+          f"{r['finalized_fraction_final']:.3f}")
+    print(f"rounds 0..{r['rounds'] - 1}:")
+    print(f"finalizations |{_strip(r['per_round_finalizations'])}|")
+    print(f"blocked       |{_strip(r['per_round_blocked'])}|")
+    print(f"expiries      |{_strip(r['per_round_expiries'])}|")
+    if r["metrics_file"]:
+        print(f"trace: {r['metrics_file']} (+ .manifest.json)")
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("scenarios", nargs="*",
+                        choices=[[], *SCENARIOS, "partition_heal"],
+                        help="scenarios to run (default: all)")
+    parser.add_argument("--list", action="store_true",
+                        help="list scenarios and exit")
+    parser.add_argument("--nodes", type=int, default=512)
+    parser.add_argument("--txs", type=int, default=64)
+    parser.add_argument("--timeout-rounds", type=int, default=4)
+    parser.add_argument("--seed", type=int, default=0)
+    # partition_heal-only knobs (the old partition_outage.py CLI): vary
+    # the cut window / response latency / horizon without editing source.
+    parser.add_argument("--partition-start", type=int, default=5)
+    parser.add_argument("--partition-end", type=int, default=60)
+    parser.add_argument("--latency-rounds", type=int, default=1)
+    parser.add_argument("--finalization-score", type=int, default=48)
+    parser.add_argument("--rounds", type=int, default=130,
+                        help="partition_heal horizon (other scenarios "
+                             "fix their own)")
+    parser.add_argument("--metrics", type=str, default=None,
+                        metavar="PATH",
+                        help="stream each scenario's per-round telemetry "
+                             "to PATH.<scenario>.jsonl with a manifest "
+                             "next to each; the recovery verdict is then "
+                             "checked FROM the file")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the raw per-scenario dicts as JSON")
+    args = parser.parse_args()
+
+    if args.list:
+        print("partition_heal: the PR 3 canonical 50/50 split, both "
+              "absence semantics (measure())")
+        for name, fn in SCENARIOS.items():
+            print(f"{name}: {fn.__doc__.splitlines()[0].strip()}")
+        return
+
+    names = args.scenarios or ["partition_heal", *SCENARIOS]
+    out = []
+    for name in names:
+        metrics_path = None
+        if args.metrics:
+            p = Path(args.metrics)
+            metrics_path = str(p.with_name(f"{p.stem}.{name}{p.suffix}"))
+        if name == "partition_heal":
+            results = []
+            for skip in (False, True):
+                mp = None
+                if metrics_path:
+                    q = Path(metrics_path)
+                    mode = "skip" if skip else "neutral"
+                    mp = str(q.with_name(f"{q.stem}.{mode}{q.suffix}"))
+                results.append(measure(
+                    nodes=args.nodes, txs=args.txs,
+                    partition_start=args.partition_start,
+                    partition_end=args.partition_end,
+                    timeout_rounds=args.timeout_rounds,
+                    latency_rounds=args.latency_rounds,
+                    finalization_score=args.finalization_score,
+                    n_rounds=args.rounds,
+                    skip_absent=skip, seed=args.seed, metrics_path=mp))
+            out.extend(results)
+            if not args.json:
+                _print_partition_heal(results)
+        else:
+            r = run_scenario(name, nodes=args.nodes, txs=args.txs,
+                             timeout_rounds=args.timeout_rounds,
+                             seed=args.seed, metrics_path=metrics_path)
+            out.append(r)
+            if not args.json:
+                _print_scenario(r)
+
+    if args.json:
+        print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

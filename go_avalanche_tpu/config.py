@@ -52,6 +52,73 @@ class AdversaryStrategy(enum.Enum):
     OPPOSE_MAJORITY = "oppose_majority"
 
 
+# Fault-script event schema: kind -> positional field names after the
+# kind tag — the one source for both spellings (tuple arity/shape in
+# `_validate_fault_script`, JSON object keys in `fault_script_from_json`,
+# the `run_sim --fault-script file.json` / scenario-file format in
+# docs/observability.md).  Every event is a plain tuple so the whole
+# script stays hashable (the config is a jit-static argument) and every
+# ROUND FIELD is jit-STATIC: the script compiles into per-round masks
+# inside the round's existing cond structure (`ops/inflight.py`), never
+# into traced control flow.  All windows are END-EXCLUSIVE ([start,
+# end), like `partition_spec`).
+_FAULT_EVENT_FIELDS = {
+    "partition": ("start", "end", "frac"),
+    "regional_outage": ("start", "end", "cluster"),
+    "latency_spike": ("start", "end", "extra_rounds"),
+    "churn_burst": ("round", "frac"),
+}
+
+
+def fault_script_from_json(data) -> Tuple[Tuple, ...]:
+    """Parse a JSON-decoded fault script into the `cfg.fault_script`
+    tuple spelling — STRUCTURAL errors only (semantic validation —
+    ranges, overlaps, topology — stays in `AvalancheConfig`, so both
+    spellings hit the one validator).
+
+    Two event spellings, freely mixed in one list:
+
+      [["partition", 2, 6, 0.5], ...]                     — tuples
+      [{"kind": "partition", "start": 2, "end": 6,
+        "frac": 0.5}, ...]                                — objects
+
+    Raises `ValueError` with the offending index; `run_sim` funnels
+    that into `parser.error` so a malformed script dies at the parser,
+    never in the worker (the PR 5 `--metrics-every` rule).
+    """
+    if not isinstance(data, (list, tuple)):
+        raise ValueError(
+            f"a fault script is a JSON LIST of events, got "
+            f"{type(data).__name__}")
+    events = []
+    for i, ev in enumerate(data):
+        if isinstance(ev, dict):
+            kind = ev.get("kind")
+            if kind not in _FAULT_EVENT_FIELDS:
+                raise ValueError(
+                    f"event[{i}]: unknown event kind {kind!r}; known "
+                    f"kinds: {', '.join(sorted(_FAULT_EVENT_FIELDS))}")
+            fields = _FAULT_EVENT_FIELDS[kind]
+            extra = set(ev) - {"kind", *fields}
+            missing = [f for f in fields if f not in ev]
+            if missing or extra:
+                raise ValueError(
+                    f"event[{i}]: {kind} events carry fields "
+                    f"{', '.join(fields)}"
+                    + (f" — missing {', '.join(missing)}" if missing
+                       else "")
+                    + (f" — unknown {', '.join(sorted(extra))}" if extra
+                       else ""))
+            events.append((kind,) + tuple(ev[f] for f in fields))
+        elif isinstance(ev, (list, tuple)):
+            events.append(tuple(ev))
+        else:
+            raise ValueError(
+                f"event[{i}]: an event is a [kind, ...] list or a "
+                f"{{'kind': ...}} object, got {type(ev).__name__}")
+    return tuple(events)
+
+
 @dataclasses.dataclass(frozen=True)
 class AvalancheConfig:
     """All protocol constants of the reference plus simulator knobs.
@@ -194,7 +261,13 @@ class AvalancheConfig:
                                       # (round_start, round_end,
                                       #   split_frac): a network
                                       #   partition active for rounds
-                                      #   [start, end).  Nodes split at
+                                      #   [start, end) — END-EXCLUSIVE:
+                                      #   the cut fires in rounds start
+                                      #   .. end-1 and round `end` is
+                                      #   the first healed round, so
+                                      #   start == end is a zero-length
+                                      #   window that never fires and
+                                      #   is REJECTED.  Nodes split at
                                       #   floor(split_frac * N) —
                                       #   cluster-aligned when
                                       #   n_clusters > 1 (the cut lands
@@ -211,7 +284,74 @@ class AvalancheConfig:
                                       #   this turns on the in-flight
                                       #   engine even with latency_mode
                                       #   "none" semantics (latency 0
-                                      #   within each side).
+                                      #   within each side).  SUGAR: it
+                                      #   is exactly the one-event
+                                      #   fault_script
+                                      #   (("partition", start, end,
+                                      #   frac),) — `fault_events()`
+                                      #   merges the two spellings.
+    fault_script: Optional[Tuple[Tuple, ...]] = None
+                                      # Scheduled fault-script engine
+                                      #   (ops/inflight.py): a static,
+                                      #   validated tuple of timed
+                                      #   events compiled into
+                                      #   jit-static per-round masks.
+                                      #   Event tuples (windows all
+                                      #   END-EXCLUSIVE, like
+                                      #   partition_spec):
+                                      #   ("partition", start, end,
+                                      #    frac) — cluster-aligned node
+                                      #    split, cross-cut queries
+                                      #    time out (partition_spec
+                                      #    semantics);
+                                      #   ("regional_outage", start,
+                                      #    end, cluster) — cluster
+                                      #    `cluster` unreachable: every
+                                      #    query INTO or OUT OF it
+                                      #    times out, intra-region and
+                                      #    outside traffic unaffected
+                                      #    (needs n_clusters > 1);
+                                      #   ("latency_spike", start, end,
+                                      #    extra_rounds) — queries
+                                      #    ISSUED during the window
+                                      #    take extra_rounds longer;
+                                      #    latencies pushed to
+                                      #    timeout_rounds() expire
+                                      #    unanswered;
+                                      #   ("churn_burst", round, frac)
+                                      #    — at `round` each node
+                                      #    toggles dead<->alive with
+                                      #    probability frac (a one-shot
+                                      #    churn_probability impulse).
+                                      #   Same-kind events (same
+                                      #   cluster for outages) must not
+                                      #   overlap.  Any non-churn event
+                                      #   turns the in-flight engine on
+                                      #   (async_queries()); None / ()
+                                      #   leaves every compiled program
+                                      #   byte-identical (hlo_pin
+                                      #   --verify-off-path).
+    rtt_matrix: Optional[Tuple[Tuple[int, ...], ...]] = None
+                                      # Cluster-pair RTT matrix for
+                                      #   latency_mode "rtt": a static
+                                      #   C x C tuple-of-tuples
+                                      #   (C == n_clusters) of response
+                                      #   latencies in ROUNDS — a draw
+                                      #   from querier cluster i to
+                                      #   responder cluster j takes
+                                      #   rtt_matrix[i][j] rounds,
+                                      #   composing topology-coupled
+                                      #   latency with the clustered
+                                      #   sampler (ops/sampling.py)
+                                      #   without an O(N^2) plane.
+                                      #   Entries >= timeout_rounds()
+                                      #   never deliver (expire
+                                      #   unanswered).  A uniform
+                                      #   matrix of value L is
+                                      #   trajectory-identical to
+                                      #   latency_mode="fixed",
+                                      #   latency_rounds=L (pinned by
+                                      #   tests/test_faults.py).
     inflight_engine: str = "walk"     # async delivery engine
                                       #   (ops/inflight.py), active only
                                       #   when async_queries().  "walk":
@@ -301,12 +441,44 @@ class AvalancheConfig:
 
     # ------------------------------------------------------- derived (async)
 
+    def fault_events(self) -> Tuple[Tuple, ...]:
+        """The canonical merged fault script: `partition_spec` (the
+        one-event sugar spelling) first, then `fault_script` in given
+        order.  Every consumer of the fault model reads THIS, so the
+        two spellings can never diverge."""
+        events = tuple(self.fault_script or ())
+        if self.partition_spec is not None:
+            events = (("partition",) + tuple(self.partition_spec),) + events
+        return events
+
+    def cut_events(self) -> Tuple[Tuple, ...]:
+        """Events that sever (querier, responder) pairs — partitions and
+        regional outages; their draws get the never-delivers sentinel at
+        issue time (`ops/inflight.partition_cut`)."""
+        return tuple(e for e in self.fault_events()
+                     if e[0] in ("partition", "regional_outage"))
+
+    def spike_events(self) -> Tuple[Tuple, ...]:
+        """latency_spike events — additive latency on queries ISSUED
+        during the window (`ops/inflight.apply_latency_spikes`)."""
+        return tuple(e for e in self.fault_events()
+                     if e[0] == "latency_spike")
+
+    def churn_burst_events(self) -> Tuple[Tuple, ...]:
+        """churn_burst events — one-shot alive-toggle impulses applied by
+        every model's churn stage (`ops/inflight.apply_churn_bursts`);
+        the only event kind that does NOT need the in-flight engine."""
+        return tuple(e for e in self.fault_events()
+                     if e[0] == "churn_burst")
+
     def async_queries(self) -> bool:
         """True when the in-flight query engine (`ops/inflight.py`) is on:
-        a latency distribution is selected or a partition fault is
-        scheduled.  False = the synchronous ideal, the exact pre-async
-        code path (flagship `hlo_pin` program unchanged)."""
-        return self.latency_mode != "none" or self.partition_spec is not None
+        a latency distribution is selected or any cut/spike fault event
+        is scheduled (partition_spec or fault_script; churn bursts alone
+        need no ring).  False = the synchronous ideal, the exact
+        pre-async code path (flagship `hlo_pin` program unchanged)."""
+        return (self.latency_mode != "none" or bool(self.cut_events())
+                or bool(self.spike_events()))
 
     def timeout_rounds(self) -> int:
         """First round-AGE at which an outstanding query is expired.
@@ -373,23 +545,45 @@ class AvalancheConfig:
                 f"inflight_engine must be 'walk', 'walk_earlyout' or "
                 f"'coalesced', got {self.inflight_engine!r}")
         if self.latency_mode not in ("none", "fixed", "geometric",
-                                     "weighted"):
+                                     "weighted", "rtt"):
             raise ValueError(
-                f"latency_mode must be 'none', 'fixed', 'geometric' or "
-                f"'weighted', got {self.latency_mode!r}")
+                f"latency_mode must be 'none', 'fixed', 'geometric', "
+                f"'weighted' or 'rtt', got {self.latency_mode!r}")
         if self.latency_rounds < 0:
             raise ValueError("latency_rounds must be >= 0")
         if self.partition_spec is not None:
             if len(self.partition_spec) != 3:
                 raise ValueError("partition_spec is (round_start, "
                                  "round_end, split_frac)")
+            object.__setattr__(self, "partition_spec",
+                               tuple(self.partition_spec))
             start, end, frac = self.partition_spec
+            if start == end:
+                raise ValueError(
+                    f"partition_spec window [{start}, {end}) is "
+                    f"zero-length: windows are END-EXCLUSIVE, so a "
+                    f"start == end cut never fires — rounds must "
+                    f"satisfy 0 <= start < end")
             if not (0 <= start < end):
                 raise ValueError("partition_spec rounds must satisfy "
-                                 "0 <= start < end")
+                                 "0 <= start < end (end-exclusive "
+                                 "window)")
             if not (0.0 < frac < 1.0):
                 raise ValueError("partition_spec split_frac must be in "
                                  "(0, 1)")
+        self._validate_fault_script()
+        self._validate_rtt_matrix()
+        if self.latency_mode == "rtt":
+            if self.rtt_matrix is None:
+                raise ValueError(
+                    "latency_mode 'rtt' needs an rtt_matrix (a "
+                    "C x C tuple of per-cluster-pair latencies in "
+                    "rounds, C == n_clusters)")
+        elif self.rtt_matrix is not None:
+            raise ValueError(
+                f"rtt_matrix is only read by latency_mode 'rtt', got "
+                f"latency_mode {self.latency_mode!r} — a silently "
+                f"ignored matrix would mislabel the run")
         if self.async_queries():
             if self.vote_mode is not VoteMode.SEQUENTIAL:
                 raise ValueError(
@@ -414,6 +608,121 @@ class AvalancheConfig:
                     f"{self.time_step_s}; lower request_timeout_s or "
                     f"raise time_step_s (e.g. time_step_s=1.0, "
                     f"request_timeout_s=7.0 for an 8-round timeout)")
+
+    def _validate_fault_script(self) -> None:
+        """Reject malformed / out-of-range / overlapping fault events at
+        CONSTRUCTION, never at trace time: run_sim mirrors these errors
+        at its parser (the PR 5 `--metrics-every` lesson — a bad script
+        must fail before the worker retry loop ever sees it)."""
+        if self.fault_script is None:
+            return
+        script = tuple(tuple(e) for e in self.fault_script)
+        object.__setattr__(self, "fault_script", script)
+        for i, ev in enumerate(script):
+            if not ev or ev[0] not in _FAULT_EVENT_FIELDS:
+                raise ValueError(
+                    f"fault_script[{i}]: unknown event kind "
+                    f"{ev[0] if ev else ev!r}; known kinds: "
+                    f"{', '.join(sorted(_FAULT_EVENT_FIELDS))}")
+            kind = ev[0]
+            fields = _FAULT_EVENT_FIELDS[kind]
+            if len(ev) != 1 + len(fields):
+                raise ValueError(
+                    f"fault_script[{i}]: {kind} events are "
+                    f"(kind, {', '.join(fields)}), got {len(ev)} fields")
+            if kind == "churn_burst":
+                _, round_, frac = ev
+                if int(round_) != round_ or round_ < 0:
+                    raise ValueError(
+                        f"fault_script[{i}]: churn_burst round must be "
+                        f"a non-negative integer, got {round_!r}")
+                if not (0.0 < frac <= 1.0):
+                    raise ValueError(
+                        f"fault_script[{i}]: churn_burst frac must be "
+                        f"in (0, 1], got {frac!r}")
+                continue
+            _, start, end, param = ev
+            if int(start) != start or int(end) != end:
+                raise ValueError(
+                    f"fault_script[{i}]: {kind} start/end must be "
+                    f"integer rounds, got ({start!r}, {end!r})")
+            if start == end:
+                raise ValueError(
+                    f"fault_script[{i}]: {kind} window [{start}, {end}) "
+                    f"is zero-length: windows are END-EXCLUSIVE, so a "
+                    f"start == end event never fires — use "
+                    f"0 <= start < end")
+            if not (0 <= start < end):
+                raise ValueError(
+                    f"fault_script[{i}]: {kind} rounds must satisfy "
+                    f"0 <= start < end (end-exclusive window), got "
+                    f"[{start}, {end})")
+            if kind == "partition" and not (0.0 < param < 1.0):
+                raise ValueError(
+                    f"fault_script[{i}]: partition split_frac must be "
+                    f"in (0, 1), got {param!r}")
+            if kind == "regional_outage":
+                if self.n_clusters < 2:
+                    raise ValueError(
+                        f"fault_script[{i}]: regional_outage needs a "
+                        f"clustered topology (n_clusters > 1), got "
+                        f"n_clusters={self.n_clusters}")
+                if int(param) != param or not (0 <= param
+                                               < self.n_clusters):
+                    raise ValueError(
+                        f"fault_script[{i}]: regional_outage cluster "
+                        f"must be an integer in [0, "
+                        f"{self.n_clusters}), got {param!r}")
+            if kind == "latency_spike" and (int(param) != param
+                                            or param < 1):
+                raise ValueError(
+                    f"fault_script[{i}]: latency_spike extra_rounds "
+                    f"must be an integer >= 1, got {param!r}")
+        # Overlap: two same-kind events (same cluster for outages)
+        # active in the same round are ambiguous — which frac?  double
+        # the spike? — so the merged script (partition_spec sugar
+        # included) rejects them; different clusters / different kinds
+        # compose freely (cascading regional failures are the point).
+        windows: dict = {}
+        for ev in self.fault_events():
+            kind = ev[0]
+            if kind == "churn_burst":
+                key, span = (kind,), (ev[1], ev[1] + 1)
+            elif kind == "regional_outage":
+                key, span = (kind, ev[3]), (ev[1], ev[2])
+            else:
+                key, span = (kind,), (ev[1], ev[2])
+            for other in windows.setdefault(key, []):
+                if span[0] < other[1] and other[0] < span[1]:
+                    raise ValueError(
+                        f"fault_script: overlapping {kind} events"
+                        f"{' for cluster ' + str(ev[3]) if kind == 'regional_outage' else ''}"
+                        f" — [{other[0]}, {other[1]}) and [{span[0]}, "
+                        f"{span[1]}) are both active in round "
+                        f"{max(other[0], span[0])} (partition_spec "
+                        f"counts as a partition event)")
+            windows[key].append(span)
+
+    def _validate_rtt_matrix(self) -> None:
+        """The cluster-pair RTT matrix must be square, match the
+        clustered topology, and carry non-negative integer rounds."""
+        if self.rtt_matrix is None:
+            return
+        matrix = tuple(tuple(row) for row in self.rtt_matrix)
+        object.__setattr__(self, "rtt_matrix", matrix)
+        c = self.n_clusters
+        if len(matrix) != c or any(len(row) != c for row in matrix):
+            raise ValueError(
+                f"rtt_matrix must be n_clusters x n_clusters = "
+                f"{c} x {c} (one row per querier cluster), got "
+                f"{len(matrix)} row(s) of lengths "
+                f"{[len(r) for r in matrix]}")
+        for i, row in enumerate(matrix):
+            for j, entry in enumerate(row):
+                if int(entry) != entry or entry < 0:
+                    raise ValueError(
+                        f"rtt_matrix[{i}][{j}] must be a non-negative "
+                        f"integer latency in rounds, got {entry!r}")
 
 
 DEFAULT_CONFIG = AvalancheConfig()

@@ -358,13 +358,13 @@ def round_step(
     with annotate("ingest_votes"):
         if inflight.enabled(cfg):
             # Async query lifecycle (ops/inflight.py): stamp this round's
-            # polls with per-draw latencies (+ partition cuts), enqueue
-            # them, then run the delivery/expiry pass over the whole
-            # ring.  SEQUENTIAL-only (config-validated).
+            # polls with per-draw latencies (+ the fault script's spikes
+            # and cuts), enqueue them, then run the delivery/expiry pass
+            # over the whole ring.  SEQUENTIAL-only (config-validated).
             lat = inflight.draw_latency(k_sample, cfg, peers,
-                                        state.latency_weight)
-            lat = inflight.apply_partition(lat, cfg, state.round, 0,
-                                           peers, n)
+                                        state.latency_weight, n)
+            lat = inflight.apply_faults(lat, cfg, state.round, 0,
+                                        peers, n)
             ring = inflight.enqueue(state.inflight, state.round, peers,
                                     lat, responded, lie, polled)
             records, changed, votes_applied = inflight.deliver_multi_engine(
@@ -396,10 +396,11 @@ def round_step(
     if cfg.churn_probability > 0.0:
         toggle = jax.random.bernoulli(k_churn, cfg.churn_probability, (n,))
         alive = jnp.logical_xor(alive, toggle)
+    alive = inflight.apply_churn_bursts(alive, cfg, state.round, k_churn)
 
     # Async-era counters (PR 5): ring-entry accounting from the no-T
-    # latency planes plus the issue-time partition cut — all statically
-    # zero when the in-flight engine / partition is off.
+    # latency planes plus the issue-time fault cut — all statically
+    # zero when the in-flight engine / fault script is off.
     rt = inflight.ring_telemetry(ring, cfg, state.round)
     cut = (inflight.partition_cut(cfg, state.round, 0, peers, n)
            if inflight.enabled(cfg) else None)

@@ -236,8 +236,8 @@ def round_step(
         # responder's preferred-in-set plane AS OF the delivery round's
         # start (the synchronous round's own observation convention).
         lat = inflight.draw_latency(k_sample, cfg, peers,
-                                    base.latency_weight)
-        lat = inflight.apply_partition(lat, cfg, base.round, 0, peers, n)
+                                    base.latency_weight, n)
+        lat = inflight.apply_faults(lat, cfg, base.round, 0, peers, n)
         ring = inflight.enqueue(base.inflight, base.round, peers, lat,
                                 responded, lie, polled)
         records, changed, votes_applied = inflight.deliver_multi_engine(
@@ -261,6 +261,7 @@ def round_step(
     if cfg.churn_probability > 0.0:
         toggle = jax.random.bernoulli(k_churn, cfg.churn_probability, (n,))
         alive = jnp.logical_xor(alive, toggle)
+    alive = inflight.apply_churn_bursts(alive, cfg, base.round, k_churn)
 
     # Async-era ring counters: same accounting as the flat simulator
     # (statically zero when the in-flight engine is off); the DAG round

@@ -127,8 +127,8 @@ def round_step(
         # no latency_weight plane, so the "weighted" mode degenerates to
         # uniform weights (all-zero latency).
         lat = inflight.draw_latency(k_sample, cfg, peers,
-                                    jnp.ones((n,), jnp.float32))
-        lat = inflight.apply_partition(lat, cfg, state.round, 0, peers, n)
+                                    jnp.ones((n,), jnp.float32), n)
+        lat = inflight.apply_faults(lat, cfg, state.round, 0, peers, n)
         ring = inflight.enqueue(state.inflight, state.round, peers, lat,
                                 responded, lie, update_mask)
         records, changed = inflight.deliver_1d_engine(ring, state.records, cfg,
@@ -167,11 +167,12 @@ def round_step(
         newly_final & (state.finalized_at < 0),
         state.round, state.finalized_at)
 
-    # --- churn: nodes toggle dead<->alive.
+    # --- churn: nodes toggle dead<->alive (+ scheduled churn bursts).
     alive = state.alive
     if cfg.churn_probability > 0.0:
         toggle = jax.random.bernoulli(k_churn, cfg.churn_probability, (n,))
         alive = jnp.logical_xor(alive, toggle)
+    alive = inflight.apply_churn_bursts(alive, cfg, state.round, k_churn)
 
     rt = inflight.ring_telemetry(ring, cfg, state.round)
     cut = (inflight.partition_cut(cfg, state.round, 0, peers, n)

@@ -12,7 +12,10 @@ The observability layer (docs/observability.md):
   * `tags`     — `tag_from_config`: the one metric-tag spelling shared
                  by bench, roofline and the sink;
   * `watchdog` — opt-in invariant checks (`run_sim --check-invariants`)
-                 that turn silent state corruption into loud failures.
+                 that turn silent state corruption into loud failures;
+  * `recovery` — recovery-curve checker (PR 6): machine-verifies a
+                 fault script's cut accounting, occupancy recovery and
+                 finality monotonicity from a flight-recorder trace.
 """
 
 from go_avalanche_tpu.obs.manifest import (  # noqa: F401
@@ -25,10 +28,17 @@ from go_avalanche_tpu.obs.sink import (  # noqa: F401
     emit_round,
     metrics_sink,
 )
+from go_avalanche_tpu.obs.recovery import (  # noqa: F401
+    RecoveryReport,
+    RecoveryViolation,
+    check_recovery,
+    verify_recovery,
+)
 from go_avalanche_tpu.obs.tags import tag_from_config  # noqa: F401
 from go_avalanche_tpu.obs.watchdog import (  # noqa: F401
     InvariantViolation,
     Watchdog,
     check_records,
     check_ring,
+    check_ring_cut,
 )

@@ -1,0 +1,254 @@
+"""Recovery-curve checker: machine-verify "does the network recover,
+and how fast" from a flight-recorder trace.
+
+The fault-script engine (`cfg.fault_script`, `ops/inflight.py`) turned
+`examples/partition_outage.py` into a scenario library
+(now `examples/fault_scenarios.py`); this module
+turns its strip charts into tier-1-testable PROPERTIES.  Given the
+config that ran (the script is static — the schedule is known) and the
+per-round JSONL trace the flight recorder emitted (`--metrics`, or a
+`MetricsSink.write_stacked` of a `run_scan`'s telemetry), it verifies
+the three invariants every healing network must satisfy:
+
+  1. **Cut accounting** — every fault-blocked draw is reaped exactly
+     once, `timeout_rounds()` later: per round,
+     ``expiries[r] == partition_blocked[r - timeout]``.  Nothing
+     vanishes silently, nothing is reaped twice.  The equality is
+     STRICT when cuts are the only expiry source (bounded latency
+     modes whose worst case — base max + active spike extra — stays
+     below the timeout); stochastic tails (geometric) and
+     over-the-timeout spikes add expiries of their own, so those
+     configs get the one-sided ``>=`` check.
+  2. **Occupancy recovery** — the ring's fill returns to its pre-fault
+     baseline within ``timeout_rounds() + slack`` rounds of each heal:
+     blocked entries swell the ring for exactly one timeout after the
+     cut ends, then drain.  A ring that stays swollen is a leak; one
+     that never swelled means the cut never fired.
+  3. **Finality monotonicity** — the finalized count never decreases
+     across fault events (per-round `finalizations` >= 0 everywhere;
+     finalized records freeze — the watchdog's end-of-round invariant,
+     asserted here on the trace itself).
+
+Traces must be stride-1 (`metrics_every=1` / unstrided write_stacked)
+and are re-sorted by `round` (the in-graph tap's unordered io_callback
+may land lines out of order).
+
+    from go_avalanche_tpu.obs import recovery
+    report = recovery.check_recovery(cfg, "trace.jsonl")   # raises
+    report = recovery.verify_recovery(cfg, records)        # inspects
+
+See docs/observability.md (fault scripts & recovery curves) for the
+event schema and `examples/fault_scenarios.py` for worked scenarios
+that emit a trace and a recovery verdict in one run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Union
+
+from go_avalanche_tpu.config import AvalancheConfig
+
+
+class RecoveryViolation(AssertionError):
+    """A recovery invariant of the fault script failed on the trace."""
+
+
+@dataclasses.dataclass
+class RecoveryReport:
+    """Outcome of `verify_recovery`: the machine-checked verdict plus
+    the recovery curve's summary numbers (per merged cut window)."""
+
+    ok: bool
+    violations: List[str]
+    # One dict per MERGED cut window (overlapping cut events — e.g. a
+    # cascading two-region outage — verify as one composite outage):
+    #   start, heal, baseline_occupancy, recovery_round (first round
+    #   >= heal with occupancy back at baseline; None if never),
+    #   recovery_rounds (recovery_round - heal), blocked (draws severed
+    #   during the window).
+    windows: List[Dict]
+    totals: Dict
+
+    def __bool__(self) -> bool:  # `assert report` reads naturally
+        return self.ok
+
+
+def load_trace(path: Union[str, Path]) -> List[Dict]:
+    """Read a flight-recorder JSONL trace, sorted by `round`.
+
+    Accepts both emission modes (docs/observability.md): the in-graph
+    tap's unordered lines and `write_stacked`'s pre-sorted ones.
+    """
+    records = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    return sorted(records, key=lambda r: r["round"])
+
+
+def merged_cut_windows(cfg: AvalancheConfig) -> List[tuple]:
+    """The script's cut events collapsed into disjoint ``[start, heal)``
+    outage intervals: overlapping or back-to-back cuts (a cascading
+    multi-region failure) recover as ONE composite window — occupancy
+    cannot return to baseline between two cuts that share rounds."""
+    spans = sorted((e[1], e[2]) for e in cfg.cut_events())
+    merged: List[tuple] = []
+    for start, end in spans:
+        if merged and start <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], end))
+        else:
+            merged.append((start, end))
+    return merged
+
+
+def _max_scheduled_latency(cfg: AvalancheConfig) -> Optional[int]:
+    """Worst-case deliverable latency any draw can be stamped with
+    (base mode max + the tallest active spike), or None when the mode
+    is unbounded (geometric)."""
+    if cfg.latency_mode in ("none",):
+        base = 0
+    elif cfg.latency_mode in ("fixed", "weighted"):
+        base = cfg.latency_rounds
+    elif cfg.latency_mode == "rtt":
+        base = max(entry for row in cfg.rtt_matrix for entry in row)
+    else:  # geometric: unbounded tail expires on its own
+        return None
+    spike = max((e[3] for e in cfg.spike_events()), default=0)
+    return base + spike
+
+
+def _series(records: Sequence[Dict], field: str) -> List[int]:
+    try:
+        return [int(r[field]) for r in records]
+    except KeyError:
+        raise ValueError(
+            f"trace records lack the {field!r} counter — recovery "
+            f"checking needs the async-era ring telemetry "
+            f"(deliveries/expiries/ring_occupancy/partition_blocked; "
+            f"every model's round carries it since PR 5)")
+
+
+def verify_recovery(
+    cfg: AvalancheConfig,
+    records: Sequence[Dict],
+    occupancy_slack: int = 2,
+) -> RecoveryReport:
+    """Verify the recovery invariants of `cfg`'s fault script against a
+    stride-1 per-round trace; returns a `RecoveryReport` (violations
+    collected, not raised — `check_recovery` is the raising wrapper).
+
+    `occupancy_slack` widens the occupancy-recovery bound past the
+    structural ``timeout_rounds()`` tail (default 2 rounds: scheduling
+    jitter from entries issued in the heal round itself).
+    """
+    violations: List[str] = []
+    records = sorted(records, key=lambda r: r["round"])
+    rounds = [int(r["round"]) for r in records]
+    n_rounds = len(records)
+    if rounds != list(range(n_rounds)):
+        raise ValueError(
+            f"recovery checking needs a stride-1 trace covering rounds "
+            f"0..R-1 (metrics_every=1); got rounds "
+            f"{rounds[:3]}..{rounds[-3:] if n_rounds >= 3 else rounds}")
+    expiries = _series(records, "expiries")
+    occupancy = _series(records, "ring_occupancy")
+    blocked = _series(records, "partition_blocked")
+    finalizations = _series(records, "finalizations")
+    timeout = cfg.timeout_rounds()
+
+    # --- 1. cut accounting: blocked draws expire exactly one timeout
+    # later; strict equality when cuts are the only expiry source.
+    max_lat = _max_scheduled_latency(cfg)
+    strict = max_lat is not None and max_lat < timeout
+    for r in range(n_rounds):
+        expected = blocked[r - timeout] if r >= timeout else 0
+        if strict and expiries[r] != expected:
+            violations.append(
+                f"cut accounting: round {r} reaped {expiries[r]} "
+                f"expiries but round {r - timeout} blocked {expected} "
+                f"draws (blocked queries must expire exactly "
+                f"timeout_rounds={timeout} later, and nothing else "
+                f"expires under this config)")
+        elif not strict and expiries[r] < expected:
+            violations.append(
+                f"cut accounting: round {r} reaped only {expiries[r]} "
+                f"expiries for {expected} draws blocked at round "
+                f"{r - timeout} — blocked queries vanished unreaped")
+
+    # --- 2. occupancy returns to the pre-fault baseline after each heal.
+    windows = []
+    for start, heal in merged_cut_windows(cfg):
+        if 1 <= start <= n_rounds:
+            baseline = occupancy[start - 1]
+        else:
+            # A cut live from round 0 has no pre-fault round to anchor
+            # on — anchor on the trace's final occupancy, the post-heal
+            # steady state the drain must reach (never 0: any nonzero
+            # latency keeps ~N*k queries permanently in flight).
+            baseline = occupancy[-1] if n_rounds else 0
+        bound = heal + timeout + occupancy_slack
+        recovery_round = next(
+            (r for r in range(min(heal, n_rounds), n_rounds)
+             if occupancy[r] <= baseline), None)
+        window_blocked = sum(blocked[start:heal])
+        windows.append(dict(start=start, heal=heal,
+                            baseline_occupancy=baseline,
+                            recovery_round=recovery_round,
+                            recovery_rounds=(None if recovery_round is None
+                                             else recovery_round - heal),
+                            blocked=window_blocked))
+        if heal >= n_rounds:
+            violations.append(
+                f"occupancy recovery: the trace ({n_rounds} rounds) ends "
+                f"before the cut window [{start}, {heal}) heals — run "
+                f"past the heal to verify recovery")
+        elif recovery_round is None or recovery_round > bound:
+            at = (f"round {recovery_round}" if recovery_round is not None
+                  else "never")
+            violations.append(
+                f"occupancy recovery: after the heal at round {heal}, "
+                f"ring occupancy first returned to its pre-fault "
+                f"baseline ({baseline}) {at}, past the bound "
+                f"heal + timeout + slack = {bound} — blocked entries "
+                f"must drain within one timeout of the heal")
+
+    # --- 3. finality monotonicity across events.
+    for r, f in enumerate(finalizations):
+        if f < 0:
+            violations.append(
+                f"finality monotonicity: round {r} reports "
+                f"{f} finalizations — the finalized count decreased "
+                f"(finalized records must freeze across fault events)")
+
+    totals = dict(rounds=n_rounds,
+                  blocked_total=sum(blocked),
+                  expiries_total=sum(expiries),
+                  deliveries_total=sum(_series(records, "deliveries")),
+                  finalizations_total=sum(finalizations),
+                  peak_occupancy=max(occupancy, default=0),
+                  strict_cut_accounting=strict)
+    return RecoveryReport(ok=not violations, violations=violations,
+                          windows=windows, totals=totals)
+
+
+def check_recovery(
+    cfg: AvalancheConfig,
+    trace: Union[str, Path, Sequence[Dict]],
+    occupancy_slack: int = 2,
+) -> RecoveryReport:
+    """`verify_recovery` that LOADS a JSONL path (or takes records) and
+    RAISES `RecoveryViolation` listing every failed invariant; returns
+    the passing report otherwise."""
+    if isinstance(trace, (str, Path)):
+        trace = load_trace(trace)
+    report = verify_recovery(cfg, trace, occupancy_slack=occupancy_slack)
+    if not report.ok:
+        raise RecoveryViolation(
+            "recovery invariants violated:\n  "
+            + "\n  ".join(report.violations))
+    return report

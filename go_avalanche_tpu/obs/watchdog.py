@@ -23,7 +23,15 @@ engine maintains by construction:
     per-shard byte block (the exact aliased-repack corruption);
   * the finalized count never DECREASES across steps (finalized records
     freeze; streaming schedulers legitimately reset refilled columns —
-    construct `Watchdog(monotonic=False)` there).
+    construct `Watchdog(monotonic=False)` there);
+  * EVENT ACCOUNTING (PR 6): no ring entry can deliver across an
+    active cut — every (querier, peer) draw severed by a fault-script
+    cut event (partition / regional_outage) active at its ISSUE round
+    must carry the never-delivers timeout sentinel
+    (`check_ring_cut`, a host-numpy re-derivation of
+    `ops/inflight.partition_cut` from the ring's own peer plane; slot
+    ``r % depth`` dates each entry, so the check needs the state's
+    round counter).
 
 Host-side by design: a `jax.device_get` per check keeps the checks out
 of the compiled program entirely (the traced step is byte-identical
@@ -129,8 +137,63 @@ def check_ring(ring, cfg: AvalancheConfig, t: Optional[int] = None,
                     f"{_offenders(bad)}")
 
 
+def check_ring_cut(ring, cfg: AvalancheConfig, round_: int,
+                   n_global: int, row_offset: int = 0) -> None:
+    """Event accounting: no delivery can be pending across an active cut.
+
+    Re-derives, in host numpy, which of the ring's stored (querier,
+    peer) draws were severed by a cut event (partition /
+    regional_outage) active at their ISSUE round — slot ``r % depth``
+    holds round r's queries, so `round_` (the state's NEXT-round
+    counter) dates every slot — and asserts each severed entry carries
+    the never-delivers timeout sentinel, exactly what
+    `ops/inflight.apply_faults` stamped at issue.  A severed entry
+    with a deliverable latency is a query that would cross the cut —
+    the fault model's cardinal sin.  Pre-fault / init slots pass
+    vacuously (the init ring is all-sentinel).  None ring or empty cut
+    schedule: no-op.
+    """
+    if ring is None:
+        return
+    events = cfg.cut_events()
+    if not events:
+        return
+    from go_avalanche_tpu.ops import inflight
+
+    timeout = cfg.timeout_rounds()
+    depth = int(ring.peers.shape[0])
+    peers, lat = (np.asarray(x) for x in
+                  jax.device_get((ring.peers, ring.lat)))
+    rows = peers.shape[1]
+    qids = np.arange(rows, dtype=np.int64) + row_offset
+    for slot in range(depth):
+        if round_ <= slot:            # slot never written yet
+            continue
+        issue = round_ - 1 - ((round_ - 1 - slot) % depth)
+        severed = np.zeros(peers[slot].shape, np.bool_)
+        for kind, start, end, param in events:
+            if not (start <= issue < end):
+                continue
+            if kind == "partition":
+                split = inflight._partition_split(cfg, n_global, param)
+                qside = qids < split
+                pside = peers[slot] < split
+            else:                      # regional_outage
+                qside = (qids * cfg.n_clusters // n_global) == param
+                pside = (peers[slot].astype(np.int64)
+                         * cfg.n_clusters // n_global) == param
+            severed |= qside[:, None] != pside
+        bad = severed & (lat[slot] != timeout)
+        if bad.any():
+            raise InvariantViolation(
+                f"ring slot {slot} (issued round {issue}) holds "
+                f"deliverable entries across an active cut — severed "
+                f"draws must carry the timeout sentinel {timeout}: "
+                f"{_offenders(bad)}")
+
+
 def _resolve(state):
-    """(records, ring, t) from any model's state pytree."""
+    """(records, ring, t, round) from any model's state pytree."""
     if hasattr(state, "dag"):                  # StreamingDagState
         state = state.dag
     if hasattr(state, "sim"):                  # BacklogSimState
@@ -139,7 +202,8 @@ def _resolve(state):
         state = state.base
     records = state.records
     t = records.votes.shape[1] if records.votes.ndim == 2 else None
-    return records, getattr(state, "inflight", None), t
+    return (records, getattr(state, "inflight", None), t,
+            getattr(state, "round", None))
 
 
 class Watchdog:
@@ -162,9 +226,12 @@ class Watchdog:
     def check(self, state) -> int:
         """Run every invariant against `state`; returns the finalized
         count.  Raises `InvariantViolation` on the first failure."""
-        records, ring, t = _resolve(state)
+        records, ring, t, round_ = _resolve(state)
         finalized = check_records(records, self.cfg)
         check_ring(ring, self.cfg, t=t, tx_shards=self.tx_shards)
+        if round_ is not None:
+            check_ring_cut(ring, self.cfg, int(jax.device_get(round_)),
+                           n_global=int(records.votes.shape[0]))
         if (self.monotonic and self._prev_finalized is not None
                 and finalized < self._prev_finalized):
             raise InvariantViolation(

@@ -36,10 +36,22 @@ no host round-trips:
     register nothing (reference-host semantics, an expired response
     never reaches RegisterVotes), otherwise they shift the window as a
     delivered neutral, the same absence semantics drops get;
-  * a partition fault (`cfg.partition_spec`) marks cross-cut draws
-    undeliverable at ISSUE time — those queries time out rather than
-    silently vanishing, so a healed partition shows the timeout tail,
-    not an instant recovery.
+  * the FAULT-SCRIPT engine (`cfg.fault_script`, with `partition_spec`
+    as the one-event sugar) applies at ISSUE time: latency_spike events
+    add rounds to the drawn latency (`apply_latency_spikes`), and cut
+    events — partitions and regional outages — mark severed draws
+    undeliverable (`partition_cut` -> the timeout sentinel), so those
+    queries time out rather than silently vanishing and a healed cut
+    shows the timeout tail, not an instant recovery; churn_burst events
+    are one-shot alive-toggle impulses applied by the models' churn
+    stage (`apply_churn_bursts`).  Every event window is jit-static:
+    the script compiles into per-round masks gated by scalar
+    round-range tests, and an empty script is statically absent (every
+    archived hlo pin byte-identical — `hlo_pin.py --verify-off-path`);
+  * `latency_mode="rtt"` draws topology-coupled latency from the
+    static C x C `cfg.rtt_matrix` over the clustered topology's
+    contiguous-block clusters — per-(querier, responder) latency
+    without an O(N^2) plane.
 
 Latency-0 (`latency_mode="fixed"`, `latency_rounds=0`) is bit-exact with
 the synchronous round on every model and config axis
@@ -217,11 +229,25 @@ def init_ring(cfg: AvalancheConfig, rows: int,
     )
 
 
+def _cluster_of(ids: jax.Array, n_clusters: int,
+                n_global: int) -> jax.Array:
+    """Cluster of each global node id — `ops/sampling.cluster_of`, THE
+    one spelling of the clustered topology's partition (``i * C // N``,
+    contiguous blocks, derived, never stored): the cluster an outage
+    severs / an RTT row indexes is exactly the cluster the sampler
+    draws from."""
+    from go_avalanche_tpu.ops.sampling import cluster_of
+
+    return cluster_of(ids, n_clusters, n_global)
+
+
 def draw_latency(
     key: jax.Array,
     cfg: AvalancheConfig,
     peers: jax.Array,
     latency_weight: jax.Array,
+    n_global: int,
+    row_offset=0,
 ) -> jax.Array:
     """Per-(querier, draw) response latency in rounds; int32 ``[rows, k]``
     clipped to ``[0, timeout_rounds()]`` (the top value never delivers).
@@ -236,18 +262,35 @@ def draw_latency(
                 rounds, the min-weight peer in `latency_rounds`, linear
                 in the weight in between.  Uniform weights give all-0 —
                 bit-exact with the synchronous round.
+    rtt       — topology-coupled: ``cfg.rtt_matrix[cq][cp]`` rounds for
+                a draw from querier cluster cq to responder cluster cp
+                (contiguous-block clusters, the clustered sampler's own
+                partition) — per-(querier, responder) latency from a
+                tiny static C x C gather, no O(N^2) plane.  A uniform
+                matrix is trajectory-identical to "fixed".
 
-    `key` is the round's SAMPLING key: the latency stream derives from it
-    by an internal fold, so turning latency on never perturbs the peer /
-    fault draws (the latency-0 parity pin depends on this).
+    `n_global` / `row_offset` place this block's rows in the global id
+    space (sharded drivers pass their shard offset; cluster membership
+    derives from GLOBAL ids).  `key` is the round's SAMPLING key: the
+    latency stream derives from it by an internal fold, so turning
+    latency on never perturbs the peer / fault draws (the latency-0
+    parity pin depends on this).
     """
     key = jax.random.fold_in(key, _LAT_FOLD)
     timeout = cfg.timeout_rounds()
     if cfg.latency_mode in ("none", "fixed"):
-        # "none" reaches here only when partition_spec turned the engine
-        # on: latency 0 within each side of the cut.
+        # "none" reaches here only when a scheduled cut/spike turned the
+        # engine on: latency 0 within each intact path.
         base = cfg.latency_rounds if cfg.latency_mode == "fixed" else 0
         return jnp.full(peers.shape, min(base, timeout), jnp.int32)
+    if cfg.latency_mode == "rtt":
+        matrix = jnp.asarray(cfg.rtt_matrix, jnp.int32)
+        rows = peers.shape[0]
+        qc = _cluster_of(jnp.arange(rows, dtype=jnp.int32)
+                         + jnp.asarray(row_offset, jnp.int32),
+                         cfg.n_clusters, n_global)
+        pc = _cluster_of(peers, cfg.n_clusters, n_global)
+        return jnp.clip(matrix[qc[:, None], pc], 0, timeout)
     if cfg.latency_mode == "geometric":
         if cfg.latency_rounds == 0:
             return jnp.zeros(peers.shape, jnp.int32)
@@ -264,6 +307,27 @@ def draw_latency(
     return jnp.clip(lat, 0, timeout)
 
 
+def _partition_split(cfg: AvalancheConfig, n_global: int,
+                     frac: float) -> int:
+    """Static node-index split point of a partition event.
+
+    Snapped to the nearest INTERIOR cluster boundary when the topology
+    is clustered: at least one cluster on each side (a 0- or
+    n_clusters-cluster "split" is no partition at all, and clamping at
+    node granularity would break the no-cluster-straddles-the-cut
+    contract).  floor(x+0.5), not round(): banker's rounding would turn
+    a 0.5 frac at odd cluster counts into an off-by-one split.
+    """
+    if cfg.n_clusters > 1:
+        split_cluster = int(math.floor(frac * cfg.n_clusters + 0.5))
+        split_cluster = max(1, min(split_cluster, cfg.n_clusters - 1))
+        # First id of cluster `split_cluster` under cluster_of's
+        # ``i * C // N`` partition: ceil(c*N/C).  ``c * (N // C)``
+        # lands inside a cluster whenever C does not divide N.
+        return -(-split_cluster * n_global // cfg.n_clusters)
+    return max(1, min(int(math.floor(frac * n_global)), n_global - 1))
+
+
 def partition_cut(
     cfg: AvalancheConfig,
     round_: jax.Array,
@@ -271,39 +335,78 @@ def partition_cut(
     peers: jax.Array,
     n_global: int,
 ) -> Optional[jax.Array]:
-    """Bool ``[rows, k]`` — draws severed by the active partition cut
-    this round; None (statically) when no partition is scheduled.
+    """Bool ``[rows, k]`` — draws severed by any active CUT event this
+    round; None (statically) when the merged fault script
+    (`cfg.cut_events()`: partitions + regional outages, with
+    `partition_spec` as the one-event sugar) schedules none.
 
-    The mask `apply_partition` stamps with the timeout sentinel, exposed
-    on its own so the round's telemetry can count partition-blocked
-    queries from the same plane (XLA CSEs the shared computation; with
-    `partition_spec` None both callers are statically absent).
+    Every event's window is jit-STATIC: `round_` is the only traced
+    input, so each event compiles to one ``[rows, k]`` mask AND'd with a
+    scalar round-range test — the cond structure of the round is
+    untouched, and an empty script is statically absent (all archived
+    hlo pins byte-identical).
+
+      partition(start, end, frac)        — querier and peer on opposite
+        sides of the static split ``_partition_split`` (cluster-aligned
+        when `n_clusters` > 1);
+      regional_outage(start, end, c)     — exactly one endpoint inside
+        cluster c (contiguous-block clusters, the clustered sampler's
+        own partition): traffic into or out of the region is severed,
+        intra-region and outside traffic unaffected.
+
+    The mask `apply_faults` stamps with the timeout sentinel, exposed on
+    its own so the round's telemetry can count fault-blocked queries
+    from the same plane (XLA CSEs the shared computation).
     """
-    if cfg.partition_spec is None:
+    events = cfg.cut_events()
+    if not events:
         return None
-    start, end, frac = cfg.partition_spec
-    if cfg.n_clusters > 1:
-        # Snap to the nearest INTERIOR cluster boundary: at least one
-        # cluster on each side (a 0- or n_clusters-cluster "split" is no
-        # partition at all, and clamping at node granularity would break
-        # the no-cluster-straddles-the-cut contract).  floor(x+0.5), not
-        # round(): banker's rounding would turn a 0.5 frac at odd
-        # cluster counts into an off-by-one split.
-        csize = n_global // cfg.n_clusters
-        split_cluster = int(math.floor(frac * cfg.n_clusters + 0.5))
-        split_cluster = max(1, min(split_cluster, cfg.n_clusters - 1))
-        split = split_cluster * csize
-    else:
-        split = max(1, min(int(math.floor(frac * n_global)), n_global - 1))
     rows = peers.shape[0]
-    active = (round_ >= start) & (round_ < end)
-    qside = (jnp.arange(rows, dtype=jnp.int32)
-             + jnp.asarray(row_offset, jnp.int32)) < split
-    pside = peers < split
-    return active & (qside[:, None] != pside)
+    qids = (jnp.arange(rows, dtype=jnp.int32)
+            + jnp.asarray(row_offset, jnp.int32))
+    cut = jnp.zeros(peers.shape, jnp.bool_)
+    for kind, start, end, param in events:
+        active = (round_ >= start) & (round_ < end)
+        if kind == "partition":
+            split = _partition_split(cfg, n_global, param)
+            qside = qids < split
+            pside = peers < split
+        else:  # regional_outage
+            region = jnp.int32(param)
+            qside = _cluster_of(qids, cfg.n_clusters, n_global) == region
+            pside = _cluster_of(peers, cfg.n_clusters,
+                                n_global) == region
+        cut = cut | (active & (qside[:, None] != pside))
+    return cut
 
 
-def apply_partition(
+def apply_latency_spikes(
+    lat: jax.Array,
+    cfg: AvalancheConfig,
+    round_: jax.Array,
+) -> jax.Array:
+    """Add every active latency_spike event's extra rounds to this
+    round's ISSUE-time latency draws (entries already in flight keep
+    their stamped latency — a spike delays queries issued during it).
+
+    Clipped back to ``[0, timeout_rounds()]``: a spiked latency reaching
+    the timeout becomes the never-delivers sentinel, so a spike taller
+    than the timeout headroom turns into an expiry storm — exactly what
+    a production timeout does to a latency excursion.  Statically absent
+    with no spike events.
+    """
+    events = cfg.spike_events()
+    if not events:
+        return lat
+    extra = jnp.int32(0)
+    for _, start, end, rounds_ in events:
+        active = (round_ >= start) & (round_ < end)
+        extra = extra + jnp.where(active, jnp.int32(rounds_),
+                                  jnp.int32(0))
+    return jnp.clip(lat + extra, 0, cfg.timeout_rounds())
+
+
+def apply_faults(
     lat: jax.Array,
     cfg: AvalancheConfig,
     round_: jax.Array,
@@ -311,20 +414,56 @@ def apply_partition(
     peers: jax.Array,
     n_global: int,
 ) -> jax.Array:
-    """Mark cross-partition draws undeliverable while the cut is active.
+    """The fault-script engine's issue-time pass: latency spikes, then
+    cut events (partitions / regional outages).
 
-    During rounds ``[start, end)`` of `cfg.partition_spec`, a query whose
-    querier and sampled peer sit on opposite sides of the split never
-    delivers — its latency becomes the timeout sentinel, so it EXPIRES
-    unanswered at age `timeout_rounds()` (the host Processor's reap),
-    including entries issued just before the heal.  The split point is
-    ``floor(split_frac * N)``, snapped to a cluster boundary when
-    `cfg.n_clusters > 1` (contiguous-block clusters, `ops/sampling.py`).
+    A draw severed by an active cut never delivers — its latency becomes
+    the timeout sentinel, so it EXPIRES unanswered at age
+    `timeout_rounds()` (the host Processor's reap), including entries
+    issued just before a heal: recovery trails every heal by the
+    timeout.  With an empty merged script both passes are statically
+    absent and `lat` flows through untouched (pins unchanged).
     """
+    lat = apply_latency_spikes(lat, cfg, round_)
     cut = partition_cut(cfg, round_, row_offset, peers, n_global)
     if cut is None:
         return lat
     return jnp.where(cut, jnp.int32(cfg.timeout_rounds()), lat)
+
+
+# Back-compat spelling from PR 3, when the only schedulable fault was
+# the single partition; same contract as `apply_faults`.
+apply_partition = apply_faults
+
+
+_BURST_FOLD = 0x0B57
+
+
+def apply_churn_bursts(
+    alive: jax.Array,
+    cfg: AvalancheConfig,
+    round_: jax.Array,
+    key: jax.Array,
+) -> jax.Array:
+    """Apply every scheduled churn_burst event to the alive plane.
+
+    At event round r, each row toggles dead<->alive with probability
+    `frac` — a one-shot `churn_probability` impulse, same toggle
+    semantics (a dead node revives with the same coin).  `key` is the
+    round's CHURN key (already shard-folded on the sharded drivers); the
+    burst stream folds in `_BURST_FOLD` plus the event index so bursts
+    never perturb the steady-state churn draws, and multiple bursts stay
+    independent.  Statically absent with no churn_burst events — the
+    alive plane passes through untraced (pins unchanged).
+    """
+    events = cfg.churn_burst_events()
+    if not events:
+        return alive
+    for i, (_, r, frac) in enumerate(events):
+        k = jax.random.fold_in(jax.random.fold_in(key, _BURST_FOLD), i)
+        toggle = jax.random.bernoulli(k, frac, alive.shape)
+        alive = jnp.logical_xor(alive, toggle & (round_ == r))
+    return alive
 
 
 def enqueue(
@@ -657,16 +796,20 @@ def _static_single_age(cfg: AvalancheConfig):
     """The one ring age that can ever register under this config, or
     None when that is not statically known.
 
-    With ``latency_mode="fixed"`` and no partition, every enqueued
-    entry carries the SAME latency ``min(latency_rounds, timeout)``:
-    if it is below the timeout, only that age ever delivers (and
-    nothing ever expires — the stored latency never reaches the
-    sentinel); if it IS the timeout sentinel, nothing ever delivers and
-    only the expiry age registers.  Either way exactly one age needs
-    processing, so the coalesced drain skips the per-age activity loop
-    entirely — ring depth affects nothing but slot arithmetic, which is
-    what makes the fixed-latency bench lane depth-independent
-    (PERF_NOTES PR 4 depth sweep).
+    With ``latency_mode="fixed"`` and no cut/spike events scheduled,
+    every enqueued entry carries the SAME latency
+    ``min(latency_rounds, timeout)``: if it is below the timeout, only
+    that age ever delivers (and nothing ever expires — the stored
+    latency never reaches the sentinel); if it IS the timeout sentinel,
+    nothing ever delivers and only the expiry age registers.  Either
+    way exactly one age needs processing, so the coalesced drain skips
+    the per-age activity loop entirely — ring depth affects nothing but
+    slot arithmetic, which is what makes the fixed-latency bench lane
+    depth-independent (PERF_NOTES PR 4 depth sweep).  A UNIFORM
+    cluster-pair RTT matrix is the same constant-latency invariant, so
+    "rtt" qualifies too when every entry is equal.  Any scheduled cut
+    or spike breaks the invariant (sentinel stamps / shifted windows),
+    so a non-empty merged script falls back to the general bounds.
 
     This is an invariant of rings POPULATED UNDER the same config
     (`draw_latency` stamps the constant; every model does).  A
@@ -674,8 +817,14 @@ def _static_single_age(cfg: AvalancheConfig):
     `latency_mode` — which is also the only way production reaches
     such a state (tests/test_inflight.py collision parity).
     """
-    if cfg.latency_mode == "fixed" and cfg.partition_spec is None:
+    if cfg.cut_events() or cfg.spike_events():
+        return None
+    if cfg.latency_mode == "fixed":
         return min(cfg.latency_rounds, cfg.timeout_rounds())
+    if cfg.latency_mode == "rtt":
+        entries = {entry for row in cfg.rtt_matrix for entry in row}
+        if len(entries) == 1:
+            return min(entries.pop(), cfg.timeout_rounds())
     return None
 
 

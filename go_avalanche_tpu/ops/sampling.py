@@ -127,6 +127,19 @@ def sample_peers_weighted(
     return jnp.clip(idx, 0, weights.shape[0] - 1).astype(jnp.int32)
 
 
+def cluster_of(ids: jax.Array, n_clusters: int,
+               n_nodes: int) -> jax.Array:
+    """Cluster of each global node id: ``i * C // N`` — contiguous
+    blocks, derived, never stored.  THE one spelling of the clustered
+    topology's partition, shared by the clustered sampler below, the
+    fault-script engine's regional-outage cuts and the cluster-pair RTT
+    latency draw (`ops/inflight.py`), and the watchdog's host-side
+    re-derivation (`obs/watchdog.check_ring_cut`) — a second spelling
+    anywhere would let "the cluster the sampler draws from" and "the
+    cluster the outage severs" silently disagree."""
+    return ids * jnp.int32(n_clusters) // jnp.int32(n_nodes)
+
+
 def sample_peers_clustered(
     key: jax.Array,
     weights: jax.Array,
@@ -155,17 +168,17 @@ def sample_peers_clustered(
     weights = jnp.asarray(weights, jnp.float32)
     n_nodes = weights.shape[0]
     c_ids = jnp.arange(n_clusters, dtype=jnp.int32)
-    cluster_of_all = (jnp.arange(n_nodes, dtype=jnp.int32)
-                      * n_clusters // n_nodes)                  # [N]
+    cluster_of_all = cluster_of(jnp.arange(n_nodes, dtype=jnp.int32),
+                                n_clusters, n_nodes)            # [N]
     onehot = cluster_of_all[None, :] == c_ids[:, None]          # [C, N]
     spread = (1.0 - locality) / max(n_clusters - 1, 1)
     w_cn = jnp.where(onehot, locality, spread) * weights[None, :]
     cdf = jnp.cumsum(w_cn, axis=1)                              # [C, N]
     total = cdf[:, -1]                                          # [C]
 
-    rows_cluster = ((jnp.arange(n_rows, dtype=jnp.int32)
-                     + jnp.asarray(id_offset, jnp.int32))
-                    * n_clusters // n_nodes)                    # [rows]
+    rows_cluster = cluster_of(jnp.arange(n_rows, dtype=jnp.int32)
+                              + jnp.asarray(id_offset, jnp.int32),
+                              n_clusters, n_nodes)              # [rows]
     u = jax.random.uniform(key, (n_rows, k), jnp.float32) \
         * total[rows_cluster][:, None]
     peers = jnp.zeros((n_rows, k), jnp.int32)

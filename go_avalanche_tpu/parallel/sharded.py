@@ -332,9 +332,10 @@ def _local_round(
         # synchronous gather; the ring's per-draw planes are node-row
         # sharded, so the whole pass stays collective-free.
         lat = inflight.draw_latency(k_sample, cfg, peers,
-                                    state.latency_weight)
-        lat = inflight.apply_partition(lat, cfg, state.round, offset,
-                                       peers, n_global)
+                                    state.latency_weight, n_global,
+                                    row_offset=offset)
+        lat = inflight.apply_faults(lat, cfg, state.round, offset,
+                                    peers, n_global)
         ring = inflight.enqueue(state.inflight, state.round, peers, lat,
                                 responded, lie, polled)
         records, changed, votes_applied = inflight.deliver_multi_engine(
@@ -372,10 +373,17 @@ def _local_round(
                                   state.round)
 
     alive = state.alive
+    alive_local_new = alive_local
     if cfg.churn_probability > 0.0:
         toggle = jax.random.bernoulli(k_churn, cfg.churn_probability,
                                       (n_local,))
         alive_local_new = jnp.logical_xor(alive_local, toggle)
+    # Scheduled churn bursts toggle this shard's own rows (k_churn is
+    # already shard-folded), then the replicated [N] plane is rebuilt —
+    # statically absent with no burst events.
+    alive_local_new = inflight.apply_churn_bursts(alive_local_new, cfg,
+                                                  state.round, k_churn)
+    if cfg.churn_probability > 0.0 or cfg.churn_burst_events():
         alive = lax.all_gather(alive_local_new, NODES_AXIS, axis=0,
                                tiled=True)
 

@@ -195,9 +195,10 @@ def _local_round(
         # the all-gathered preferred-in-set plane — same observation
         # convention as the synchronous round.
         lat = inflight.draw_latency(k_sample, cfg, peers,
-                                    base.latency_weight)
-        lat = inflight.apply_partition(lat, cfg, base.round, offset,
-                                       peers, n_global)
+                                    base.latency_weight, n_global,
+                                    row_offset=offset)
+        lat = inflight.apply_faults(lat, cfg, base.round, offset,
+                                    peers, n_global)
         ring = inflight.enqueue(base.inflight, base.round, peers, lat,
                                 responded, lie, polled)
         records, changed, votes_applied = inflight.deliver_multi_engine(
@@ -222,10 +223,14 @@ def _local_round(
     # replicated [N] plane is rebuilt with one all-gather (the
     # `parallel/sharded.py` recipe).
     alive = base.alive
+    alive_local_new = alive_local
     if cfg.churn_probability > 0.0:
         toggle = jax.random.bernoulli(k_churn, cfg.churn_probability,
                                       (n_local,))
         alive_local_new = jnp.logical_xor(alive_local, toggle)
+    alive_local_new = inflight.apply_churn_bursts(alive_local_new, cfg,
+                                                  base.round, k_churn)
+    if cfg.churn_probability > 0.0 or cfg.churn_burst_events():
         alive = lax.all_gather(alive_local_new, NODES_AXIS, axis=0,
                                tiled=True)
 

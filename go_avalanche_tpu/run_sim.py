@@ -34,8 +34,43 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from go_avalanche_tpu.config import AdversaryStrategy, AvalancheConfig, VoteMode
+from go_avalanche_tpu.config import (
+    AdversaryStrategy,
+    AvalancheConfig,
+    VoteMode,
+    fault_script_from_json,
+)
 from go_avalanche_tpu.utils import metrics, tracing
+
+
+def _parse_rtt_matrix(spec: str):
+    """`--rtt-matrix` SPEC -> tuple-of-tuples: inline ``'1,3;3,1'`` rows
+    or a path to a JSON file holding a list of lists.  Structural errors
+    raise `ValueError` (funnelled into `parser.error`); squareness /
+    topology-match / entry-range checks live in `AvalancheConfig`."""
+    import os
+
+    if os.path.exists(spec) or spec.endswith(".json"):
+        with open(spec) as fh:
+            data = json.load(fh)
+        if (not isinstance(data, list)
+                or not all(isinstance(r, list) for r in data)):
+            raise ValueError(
+                f"{spec} must hold a JSON list of lists (one row per "
+                f"querier cluster)")
+        if not all(isinstance(x, (int, float))
+                   for row in data for x in row):
+            raise ValueError(
+                f"{spec}: matrix entries must be numbers (latencies "
+                f"in rounds)")
+        return tuple(map(tuple, data))
+    try:
+        return tuple(tuple(int(x) for x in row.split(","))
+                     for row in spec.split(";"))
+    except ValueError:
+        raise ValueError(
+            f"inline matrix rows are ';'-separated integer lists "
+            f"(e.g. '1,3;3,1'), got {spec!r}")
 
 
 def build_config(args: argparse.Namespace) -> AvalancheConfig:
@@ -43,7 +78,9 @@ def build_config(args: argparse.Namespace) -> AvalancheConfig:
     # request_timeout_s=R-1), which makes cfg.timeout_rounds() == R
     # exactly; the seconds-based fields stay at reference defaults when
     # the async engine is off so the synchronous configs are unchanged.
-    async_on = (args.latency_mode != "none" or args.partition is not None)
+    script = getattr(args, "fault_script_events", None)
+    async_on = (args.latency_mode != "none" or args.partition is not None
+                or any(e and e[0] != "churn_burst" for e in script or ()))
     timing = {}
     if async_on:
         if args.timeout_rounds < 1:
@@ -65,6 +102,8 @@ def build_config(args: argparse.Namespace) -> AvalancheConfig:
         latency_mode=args.latency_mode,
         latency_rounds=args.latency_rounds,
         partition_spec=partition,
+        fault_script=script,
+        rtt_matrix=getattr(args, "rtt_matrix_parsed", None),
         **timing,
         window=args.window,
         quorum=args.quorum,
@@ -421,7 +460,8 @@ def main(argv=None) -> Dict:
     parser.add_argument("--drop", type=float, default=0.0)
     parser.add_argument("--churn", type=float, default=0.0)
     parser.add_argument("--latency-mode",
-                        choices=["none", "fixed", "geometric", "weighted"],
+                        choices=["none", "fixed", "geometric", "weighted",
+                                 "rtt"],
                         default="none",
                         help="async query lifecycle (ops/inflight.py): "
                              "per-(querier, draw) response latency in "
@@ -432,9 +472,12 @@ def main(argv=None) -> Dict:
                              "farthest --latency-rounds; snowball has "
                              "no such plane, so 'weighted' there "
                              "degenerates to latency 0 — use "
-                             "fixed/geometric).  'none' = the "
-                             "synchronous ideal.  Works with every "
-                             "model; sequential vote mode only")
+                             "fixed/geometric), 'rtt' = topology-"
+                             "coupled from the --rtt-matrix cluster-"
+                             "pair matrix (needs --clusters > 1).  "
+                             "'none' = the synchronous ideal.  Works "
+                             "with every model; sequential vote mode "
+                             "only")
     parser.add_argument("--latency-rounds", type=int, default=0,
                         help="latency parameter (see --latency-mode); "
                              "draws beyond --timeout-rounds expire "
@@ -448,6 +491,32 @@ def main(argv=None) -> Dict:
                              "silently vanishing, then the partition "
                              "heals.  Turns on the async engine even "
                              "with --latency-mode none")
+    parser.add_argument("--fault-script", type=str, default=None,
+                        metavar="PATH.json",
+                        help="scheduled fault-script engine "
+                             "(cfg.fault_script): a JSON list of timed "
+                             "events — partition / regional_outage / "
+                             "latency_spike / churn_burst, tuple or "
+                             "object spelling (see docs/observability.md "
+                             "for the schema; examples/fault_scenarios.py "
+                             "for worked scenarios).  Windows are "
+                             "END-EXCLUSIVE rounds; composes with "
+                             "--partition (the one-event sugar).  "
+                             "Malformed, out-of-range or overlapping "
+                             "events are rejected HERE at the parser, "
+                             "never in the worker.  Works with every "
+                             "model")
+    parser.add_argument("--rtt-matrix", type=str, default=None,
+                        metavar="SPEC",
+                        help="cluster-pair RTT matrix for --latency-mode "
+                             "rtt (cfg.rtt_matrix): 'C x C' latencies in "
+                             "rounds, either inline rows "
+                             "('1,3;3,1' — rows ';'-separated) or a "
+                             "path to a JSON file holding a list of "
+                             "lists.  Row i column j = latency of a "
+                             "query from cluster i to cluster j; "
+                             "entries >= --timeout-rounds never deliver. "
+                             "Needs --clusters == C")
     parser.add_argument("--timeout-rounds", type=int, default=8,
                         help="async modes: rounds before an outstanding "
                              "query expires unanswered (the in-flight "
@@ -530,7 +599,7 @@ def main(argv=None) -> Dict:
                              "schedulers inherit it from the inner "
                              "round).  Sharded runs stream host-side "
                              "instead (obs.MetricsSink.write_stacked — "
-                             "see examples/partition_outage.py), so "
+                             "see examples/fault_scenarios.py), so "
                              "--metrics excludes --mesh")
     parser.add_argument("--metrics-every", type=int, default=0,
                         metavar="N",
@@ -587,13 +656,38 @@ def main(argv=None) -> Dict:
             parser.error("--metrics is the dense in-graph tap; sharded "
                          "drivers stream stacked telemetry host-side "
                          "(obs.MetricsSink.write_stacked — see "
-                         "examples/partition_outage.py)")
+                         "examples/fault_scenarios.py)")
         if args.metrics_every == 0:
             args.metrics_every = 1
     elif args.metrics_every:
         parser.error("--metrics-every requires --metrics (without a sink "
                      "the tap's records are dropped)")
-    cfg = build_config(args)
+    # Fault-script / RTT-matrix files parse HERE and the whole config
+    # validates HERE: a malformed scenario must die at the parser with
+    # the validator's message, never as a worker traceback (the PR 5
+    # --metrics-every rule).
+    args.fault_script_events = None
+    if args.fault_script:
+        try:
+            with open(args.fault_script) as fh:
+                data = json.load(fh)
+            args.fault_script_events = fault_script_from_json(data)
+        except OSError as e:
+            parser.error(f"--fault-script: {e}")
+        except (json.JSONDecodeError, ValueError, TypeError) as e:
+            parser.error(f"--fault-script {args.fault_script}: {e}")
+    args.rtt_matrix_parsed = None
+    if args.rtt_matrix:
+        try:
+            args.rtt_matrix_parsed = _parse_rtt_matrix(args.rtt_matrix)
+        except (OSError, json.JSONDecodeError, ValueError, TypeError) as e:
+            parser.error(f"--rtt-matrix: {e}")
+    try:
+        cfg = build_config(args)
+    except (ValueError, TypeError) as e:
+        # validation arithmetic on a non-numeric JSON value (e.g. a
+        # null event field) raises TypeError, not ValueError
+        parser.error(str(e))
     runner = {"slush": run_slush, "snowflake": run_snowflake,
               "snowball": run_snowball, "avalanche": run_avalanche,
               "dag": run_dag, "backlog": run_backlog,

@@ -1,0 +1,484 @@
+"""The scheduled fault-script engine + cluster-pair RTT substrate (PR 6).
+
+Load-bearing pins:
+
+  * SCRIPT-VS-SUGAR parity — a one-event fault script is bit-exact with
+    the `partition_spec` spelling on dense AND sharded (per-shard tx
+    width 6 ∉ 8ℤ, coalesced packed ring) — the two spellings can never
+    diverge because every consumer reads the merged `fault_events()`;
+  * RTT DEGENERACY — a uniform cluster-pair RTT matrix is bit-exact
+    with `latency_mode="fixed"` at the same value (the topology-coupled
+    substrate is a strict generalization, not a fork);
+  * RECOVERY CURVES — `obs/recovery.py` machine-verifies a scripted
+    partition-heal on every inflight engine, dense and sharded (the
+    ISSUE 6 acceptance bar), and a cascading two-region outage verifies
+    as one merged composite window.
+
+Wall-budget note: every jitted config costs ~2.5 s CPU compile; the
+tier-1 members here are the acceptance core, the wider grids ride slow.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_avalanche_tpu.config import (
+    AvalancheConfig,
+    fault_script_from_json,
+)
+from go_avalanche_tpu.models import avalanche as av
+from go_avalanche_tpu.obs import recovery
+from go_avalanche_tpu.ops import inflight
+
+# Timing that makes cfg.timeout_rounds() == 4 (ring depth 5).
+TIMING = dict(time_step_s=1.0, request_timeout_s=3.0)
+
+# The tier-1 partition-heal scenario: cut rounds [2, 6), heal at 6,
+# strict cut accounting (fixed latency 1 < timeout 4, no spikes).
+HEAL_SCRIPT = (("partition", 2, 6, 0.5),)
+
+
+def jit_step(step_fn, cfg):
+    import functools
+
+    return jax.jit(functools.partial(step_fn, cfg=cfg))
+
+
+def assert_trajectory_equal(run_a, run_b, steps, ctx=""):
+    """Step two (state, step) pairs in lockstep; assert records +
+    telemetry stacks bit-equal each round.  Returns the final states."""
+    (sa, stepa), (sb, stepb) = run_a, run_b
+    for r in range(steps):
+        sa, ta = stepa(sa)
+        sb, tb = stepb(sb)
+        ra = sa.records if hasattr(sa, "records") else sa.base.records
+        rb = sb.records if hasattr(sb, "records") else sb.base.records
+        for name in ("votes", "consider", "confidence"):
+            np.testing.assert_array_equal(
+                np.asarray(jax.device_get(getattr(ra, name))),
+                np.asarray(jax.device_get(getattr(rb, name))),
+                err_msg=f"{ctx}: round {r} {name} plane diverged")
+        for f in ta._fields:
+            assert int(jax.device_get(getattr(ta, f))) == int(
+                jax.device_get(getattr(tb, f))), (ctx, r, f)
+    return sa, sb
+
+
+def collect_records(step, state, n_rounds):
+    """Run `n_rounds` and collect the recovery checker's trace fields —
+    exactly what the flight recorder would emit per round."""
+    recs = []
+    for r in range(n_rounds):
+        state, tel = step(state)
+        recs.append({
+            "round": r,
+            "deliveries": int(jax.device_get(tel.deliveries)),
+            "expiries": int(jax.device_get(tel.expiries)),
+            "ring_occupancy": int(jax.device_get(tel.ring_occupancy)),
+            "partition_blocked": int(
+                jax.device_get(tel.partition_blocked)),
+            "finalizations": int(jax.device_get(tel.finalizations)),
+        })
+    return state, recs
+
+
+# ---------------------------------------------------------------------------
+# Config surface: validation at construction, never at trace time
+
+
+def test_partition_spec_rejects_zero_length_window():
+    with pytest.raises(ValueError, match="zero-length"):
+        AvalancheConfig(partition_spec=(7, 7, 0.5), **TIMING)
+
+
+def test_fault_script_validation():
+    ok = dict(**TIMING)
+    with pytest.raises(ValueError, match="unknown event kind"):
+        AvalancheConfig(fault_script=(("meteor", 1, 2, 0.5),), **ok)
+    with pytest.raises(ValueError, match="got 3 fields"):
+        AvalancheConfig(fault_script=(("partition", 1, 2),), **ok)
+    with pytest.raises(ValueError, match="zero-length"):
+        AvalancheConfig(fault_script=(("latency_spike", 3, 3, 1),), **ok)
+    with pytest.raises(ValueError, match="split_frac"):
+        AvalancheConfig(fault_script=(("partition", 1, 2, 1.5),), **ok)
+    with pytest.raises(ValueError, match="clustered topology"):
+        AvalancheConfig(fault_script=(("regional_outage", 1, 2, 0),),
+                        **ok)
+    with pytest.raises(ValueError, match=r"cluster must be an integer"):
+        AvalancheConfig(fault_script=(("regional_outage", 1, 2, 7),),
+                        n_clusters=4, **ok)
+    with pytest.raises(ValueError, match="extra_rounds"):
+        AvalancheConfig(fault_script=(("latency_spike", 1, 2, 0),), **ok)
+    with pytest.raises(ValueError, match="churn_burst frac"):
+        AvalancheConfig(fault_script=(("churn_burst", 1, 0.0),), **ok)
+    # Overlap: same-kind events sharing a round are ambiguous; the
+    # sugar partition counts as a partition event.
+    with pytest.raises(ValueError, match="overlapping partition"):
+        AvalancheConfig(fault_script=(("partition", 1, 5, 0.5),
+                                      ("partition", 4, 8, 0.25)), **ok)
+    with pytest.raises(ValueError, match="overlapping partition"):
+        AvalancheConfig(partition_spec=(1, 5, 0.5),
+                        fault_script=(("partition", 4, 8, 0.25),), **ok)
+    with pytest.raises(ValueError, match="overlapping regional_outage"):
+        AvalancheConfig(fault_script=(("regional_outage", 1, 5, 2),
+                                      ("regional_outage", 4, 8, 2)),
+                        n_clusters=4, **ok)
+    # ...but DIFFERENT clusters / kinds compose freely (cascades are
+    # the point), and churn bursts alone never need the ring.
+    cfg = AvalancheConfig(fault_script=(("regional_outage", 1, 5, 0),
+                                        ("regional_outage", 4, 8, 1),
+                                        ("latency_spike", 2, 6, 1)),
+                          n_clusters=4, **ok)
+    assert cfg.async_queries()
+    assert len(cfg.cut_events()) == 2 and len(cfg.spike_events()) == 1
+    burst_only = AvalancheConfig(
+        fault_script=(("churn_burst", 3, 0.5),))
+    assert not burst_only.async_queries()
+    assert burst_only.churn_burst_events() == (("churn_burst", 3, 0.5),)
+
+
+def test_partition_spec_is_one_event_sugar():
+    cfg = AvalancheConfig(partition_spec=(2, 6, 0.5), **TIMING)
+    assert cfg.fault_events() == (("partition", 2, 6, 0.5),)
+    assert cfg.cut_events() == (("partition", 2, 6, 0.5),)
+
+
+def test_rtt_matrix_validation():
+    with pytest.raises(ValueError, match="needs an rtt_matrix"):
+        AvalancheConfig(latency_mode="rtt", n_clusters=2, **TIMING)
+    with pytest.raises(ValueError, match="only read by latency_mode"):
+        AvalancheConfig(latency_mode="fixed",
+                        rtt_matrix=((1, 1), (1, 1)), n_clusters=2,
+                        **TIMING)
+    with pytest.raises(ValueError, match="n_clusters x n_clusters"):
+        AvalancheConfig(latency_mode="rtt", rtt_matrix=((1, 1),),
+                        n_clusters=2, **TIMING)
+    with pytest.raises(ValueError, match="non-negative integer"):
+        AvalancheConfig(latency_mode="rtt",
+                        rtt_matrix=((1, -2), (1, 1)), n_clusters=2,
+                        **TIMING)
+
+
+def test_fault_script_from_json_spellings():
+    tup = fault_script_from_json(
+        [["partition", 2, 6, 0.5],
+         {"kind": "latency_spike", "start": 3, "end": 5,
+          "extra_rounds": 2},
+         {"kind": "churn_burst", "round": 4, "frac": 0.25}])
+    assert tup == (("partition", 2, 6, 0.5),
+                   ("latency_spike", 3, 5, 2),
+                   ("churn_burst", 4, 0.25))
+    with pytest.raises(ValueError, match="JSON LIST"):
+        fault_script_from_json({"kind": "partition"})
+    with pytest.raises(ValueError, match="unknown event kind"):
+        fault_script_from_json([{"kind": "asteroid"}])
+    with pytest.raises(ValueError, match="missing frac"):
+        fault_script_from_json([{"kind": "partition", "start": 1,
+                                 "end": 2}])
+    with pytest.raises(ValueError, match="unknown oops"):
+        fault_script_from_json([{"kind": "churn_burst", "round": 1,
+                                 "frac": 0.5, "oops": 1}])
+
+
+# ---------------------------------------------------------------------------
+# Op-level semantics (eager, tiny — no jit cost)
+
+
+def test_regional_outage_severs_only_cross_region_draws():
+    cfg = AvalancheConfig(
+        fault_script=(("regional_outage", 0, 10, 1),), n_clusters=4,
+        **TIMING)
+    # 8 nodes, 4 clusters of 2: cluster 1 = nodes {2, 3}.
+    peers = jnp.array([[0, 2], [3, 5], [4, 6], [2, 3],
+                       [7, 1], [2, 2], [0, 7], [3, 0]], jnp.int32)
+    cut = np.asarray(inflight.partition_cut(
+        cfg, jnp.int32(0), 0, peers, 8))
+    qin = np.arange(8) // 2 == 1
+    pin = np.asarray(peers) // 2 == 1
+    np.testing.assert_array_equal(cut, qin[:, None] != pin)
+    # Outside the window the script is inert.
+    assert not np.asarray(inflight.partition_cut(
+        cfg, jnp.int32(10), 0, peers, 8)).any()
+
+
+def test_latency_spike_adds_inside_window_and_clips_to_sentinel():
+    cfg = AvalancheConfig(
+        fault_script=(("latency_spike", 2, 4, 3),), **TIMING)
+    lat = jnp.full((2, 3), 2, jnp.int32)
+    spiked = np.asarray(inflight.apply_latency_spikes(
+        lat, cfg, jnp.int32(2)))
+    assert (spiked == 4).all()          # 2 + 3 clipped to timeout 4
+    calm = np.asarray(inflight.apply_latency_spikes(
+        lat, cfg, jnp.int32(4)))        # end-exclusive: round 4 healed
+    assert (calm == 2).all()
+
+
+def test_churn_burst_toggles_at_its_round_only():
+    cfg = AvalancheConfig(fault_script=(("churn_burst", 3, 1.0),))
+    alive = jnp.ones((16,), jnp.bool_)
+    key = jax.random.key(0)
+    out = np.asarray(inflight.apply_churn_bursts(
+        alive, cfg, jnp.int32(3), key))
+    assert not out.any()                # frac 1.0: everyone toggles
+    out = np.asarray(inflight.apply_churn_bursts(
+        alive, cfg, jnp.int32(2), key))
+    assert out.all()                    # not the burst round
+
+
+def test_rtt_draw_is_cluster_pair_lookup():
+    matrix = ((0, 2, 3), (2, 1, 4), (3, 4, 0))
+    cfg = AvalancheConfig(latency_mode="rtt", rtt_matrix=matrix,
+                          n_clusters=3, **TIMING)
+    # 6 nodes, clusters of 2; row_offset places rows 0-1 at global 2-3
+    # (cluster 1) — the sharded drivers' global-id contract.
+    peers = jnp.array([[0, 3, 5], [1, 2, 4]], jnp.int32)
+    lat = np.asarray(inflight.draw_latency(
+        jax.random.key(0), cfg, peers,
+        jnp.ones((2,), jnp.float32), 6, row_offset=2))
+    expect = np.array([[matrix[1][0], matrix[1][1], matrix[1][2]],
+                       [matrix[1][0], matrix[1][1], matrix[1][2]]])
+    np.testing.assert_array_equal(lat, expect)
+
+
+# ---------------------------------------------------------------------------
+# Trajectory parity: script-vs-sugar and RTT degeneracy (dense)
+
+
+def test_script_vs_sugar_parity_dense():
+    base = AvalancheConfig(finalization_score=16, **TIMING,
+                           latency_mode="fixed", latency_rounds=1)
+    sugar = dataclasses.replace(base, partition_spec=(2, 6, 0.5))
+    script = dataclasses.replace(base, fault_script=HEAL_SCRIPT)
+    pref = av.contested_init_pref(0, 24, 12)
+    s1 = av.init(jax.random.key(0), 24, 12, sugar, init_pref=pref)
+    s2 = av.init(jax.random.key(0), 24, 12, script, init_pref=pref)
+    assert_trajectory_equal(
+        (s1, jit_step(av.round_step, sugar)),
+        (s2, jit_step(av.round_step, script)), 9, "script-vs-sugar")
+
+
+def test_rtt_uniform_matrix_matches_fixed_latency():
+    uniform = tuple(tuple(2 for _ in range(3)) for _ in range(3))
+    fixed = AvalancheConfig(finalization_score=16, n_clusters=3,
+                            latency_mode="fixed", latency_rounds=2,
+                            **TIMING)
+    rtt = dataclasses.replace(fixed, latency_mode="rtt",
+                              rtt_matrix=uniform, latency_rounds=0)
+    pref = av.contested_init_pref(1, 24, 12)
+    s1 = av.init(jax.random.key(1), 24, 12, fixed, init_pref=pref)
+    s2 = av.init(jax.random.key(1), 24, 12, rtt, init_pref=pref)
+    assert_trajectory_equal(
+        (s1, jit_step(av.round_step, fixed)),
+        (s2, jit_step(av.round_step, rtt)), 9, "rtt-vs-fixed")
+    # The uniform matrix keeps the coalesced drain's single-age fast
+    # path (the depth-independence invariant generalizes to "rtt").
+    assert inflight._static_single_age(rtt) == 2
+    assert inflight._static_single_age(
+        dataclasses.replace(rtt, rtt_matrix=((0, 1, 2),) * 3)) is None
+
+
+# ---------------------------------------------------------------------------
+# Recovery curves: the ISSUE 6 acceptance bar
+
+
+@pytest.mark.parametrize("engine", ["walk", "walk_earlyout", "coalesced"])
+def test_recovery_curve_partition_heal_dense(engine):
+    cfg = AvalancheConfig(finalization_score=16, **TIMING,
+                          latency_mode="fixed", latency_rounds=1,
+                          fault_script=HEAL_SCRIPT,
+                          inflight_engine=engine)
+    state = av.init(jax.random.key(0), 24, 12, cfg,
+                    init_pref=av.contested_init_pref(0, 24, 12))
+    _, recs = collect_records(jit_step(av.round_step, cfg), state, 14)
+    report = recovery.check_recovery(cfg, recs)   # raises on violation
+    assert report.totals["strict_cut_accounting"]
+    (w,) = report.windows
+    assert w["blocked"] > 0 and w["recovery_round"] is not None
+    assert w["recovery_rounds"] <= cfg.timeout_rounds() + 2
+
+
+@pytest.mark.parametrize("engine", ["walk", "walk_earlyout", "coalesced"])
+def test_recovery_curve_partition_heal_sharded(engine, sharded_mesh):
+    # Per-shard tx width 12/2 = 6 ∉ 8ℤ: the coalesced member also
+    # exercises the per-shard-padded packed ring poll masks.
+    from go_avalanche_tpu.parallel import sharded
+
+    cfg = AvalancheConfig(finalization_score=16, **TIMING,
+                          latency_mode="fixed", latency_rounds=1,
+                          fault_script=HEAL_SCRIPT,
+                          inflight_engine=engine)
+    state = sharded.shard_state(
+        av.init(jax.random.key(0), 16, 12, cfg,
+                init_pref=av.contested_init_pref(0, 16, 12)),
+        sharded_mesh)
+    step = sharded.make_sharded_round_step(sharded_mesh, cfg)
+    _, recs = collect_records(step, state, 14)
+    report = recovery.check_recovery(cfg, recs)
+    (w,) = report.windows
+    assert w["blocked"] > 0 and w["recovery_round"] is not None
+
+
+def test_script_vs_sugar_parity_sharded(sharded_mesh):
+    # One-event script bit-exact with partition_spec through shard_map
+    # on the coalesced engine (packed rings at per-shard width 6).
+    from go_avalanche_tpu.parallel import sharded
+
+    base = AvalancheConfig(finalization_score=16, **TIMING,
+                           latency_mode="fixed", latency_rounds=1,
+                           inflight_engine="coalesced")
+    sugar = dataclasses.replace(base, partition_spec=(2, 6, 0.5))
+    script = dataclasses.replace(base, fault_script=HEAL_SCRIPT)
+    pref = av.contested_init_pref(0, 16, 12)
+    s1 = sharded.shard_state(
+        av.init(jax.random.key(0), 16, 12, sugar, init_pref=pref),
+        sharded_mesh)
+    s2 = sharded.shard_state(
+        av.init(jax.random.key(0), 16, 12, script, init_pref=pref),
+        sharded_mesh)
+    assert_trajectory_equal(
+        (s1, sharded.make_sharded_round_step(sharded_mesh, sugar)),
+        (s2, sharded.make_sharded_round_step(sharded_mesh, script)),
+        9, "sharded script-vs-sugar")
+
+
+@pytest.fixture(scope="module")
+def sharded_mesh():
+    from go_avalanche_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(n_node_shards=4, n_tx_shards=2)
+
+
+def test_recovery_curve_cascading_two_region_outage():
+    # Overlapping outages of clusters 0 and 1 verify as ONE merged
+    # composite window [2, 9): occupancy cannot return to baseline
+    # between cuts that share rounds.
+    cfg = AvalancheConfig(finalization_score=16, n_clusters=4,
+                          **TIMING, latency_mode="fixed",
+                          latency_rounds=1,
+                          fault_script=(("regional_outage", 2, 6, 0),
+                                        ("regional_outage", 4, 9, 1)))
+    assert recovery.merged_cut_windows(cfg) == [(2, 9)]
+    state = av.init(jax.random.key(0), 32, 12, cfg,
+                    init_pref=av.contested_init_pref(0, 32, 12))
+    _, recs = collect_records(jit_step(av.round_step, cfg), state, 17)
+    report = recovery.check_recovery(cfg, recs)
+    (w,) = report.windows                 # merged, not two windows
+    assert (w["start"], w["heal"]) == (2, 9)
+    assert w["blocked"] > 0 and w["recovery_round"] is not None
+
+
+# ---------------------------------------------------------------------------
+# The checker itself must catch broken curves (pure python, no jax)
+
+
+def _flat(n, **series):
+    base = dict(deliveries=0, expiries=0, ring_occupancy=0,
+                partition_blocked=0, finalizations=0)
+    recs = [{"round": r, **base} for r in range(n)]
+    for field, pairs in series.items():
+        for r, v in pairs:
+            recs[r][field] = v
+    return recs
+
+
+def test_checker_catches_vanished_expiries():
+    cfg = AvalancheConfig(fault_script=(("partition", 0, 2, 0.5),),
+                          latency_mode="fixed", latency_rounds=1,
+                          **TIMING)
+    recs = _flat(8, partition_blocked=[(0, 5), (1, 5)],
+                 expiries=[(4, 5)])     # round 5's reap went missing
+    report = recovery.verify_recovery(cfg, recs)
+    assert not report.ok
+    assert any("cut accounting" in v for v in report.violations)
+    with pytest.raises(recovery.RecoveryViolation):
+        recovery.check_recovery(cfg, recs)
+
+
+def test_checker_catches_leaked_occupancy():
+    cfg = AvalancheConfig(fault_script=(("partition", 1, 2, 0.5),),
+                          latency_mode="fixed", latency_rounds=1,
+                          **TIMING)
+    recs = _flat(12, partition_blocked=[(1, 4)], expiries=[(5, 4)],
+                 ring_occupancy=[(r, 7) for r in range(1, 12)])
+    report = recovery.verify_recovery(cfg, recs)
+    assert any("occupancy recovery" in v for v in report.violations)
+
+
+def test_checker_catches_decreasing_finality():
+    cfg = AvalancheConfig(fault_script=(("partition", 1, 2, 0.5),),
+                          latency_mode="fixed", latency_rounds=1,
+                          **TIMING)
+    recs = _flat(8, partition_blocked=[(1, 2)], expiries=[(5, 2)],
+                 finalizations=[(3, -1)])
+    report = recovery.verify_recovery(cfg, recs)
+    assert any("finality monotonicity" in v for v in report.violations)
+
+
+def test_checker_rejects_strided_traces():
+    cfg = AvalancheConfig(fault_script=(("partition", 1, 2, 0.5),),
+                          latency_mode="fixed", latency_rounds=1,
+                          **TIMING)
+    recs = _flat(8)[::2]
+    with pytest.raises(ValueError, match="stride-1"):
+        recovery.verify_recovery(cfg, recs)
+
+
+def test_merged_cut_windows():
+    def cfg_for(*events):
+        return AvalancheConfig(fault_script=events, n_clusters=4,
+                               **TIMING)
+
+    assert recovery.merged_cut_windows(cfg_for(
+        ("regional_outage", 10, 30, 0),
+        ("regional_outage", 20, 40, 1))) == [(10, 40)]
+    assert recovery.merged_cut_windows(cfg_for(
+        ("regional_outage", 10, 20, 0),
+        ("regional_outage", 30, 40, 1))) == [(10, 20), (30, 40)]
+    # latency spikes are not cuts
+    assert recovery.merged_cut_windows(cfg_for(
+        ("latency_spike", 5, 50, 2))) == []
+
+
+# ---------------------------------------------------------------------------
+# run_sim CLI: reject at the parser, never in the worker
+
+
+def test_run_sim_rejects_bad_fault_scripts(tmp_path):
+    from go_avalanche_tpu.run_sim import main
+
+    p = tmp_path / "script.json"
+    p.write_text('[["partition", 3, 3, 0.5]]')
+    with pytest.raises(SystemExit):
+        main(["--fault-script", str(p)])
+    p.write_text('[{"kind": "warp_core_breach"}]')
+    with pytest.raises(SystemExit):
+        main(["--fault-script", str(p)])
+    p.write_text("not json")
+    with pytest.raises(SystemExit):
+        main(["--fault-script", str(p)])
+    with pytest.raises(SystemExit):    # missing file
+        main(["--fault-script", str(tmp_path / "nope.json")])
+    with pytest.raises(SystemExit):    # matrix without rtt mode
+        main(["--rtt-matrix", "1,2;2,1"])
+    with pytest.raises(SystemExit):    # non-square matrix
+        main(["--latency-mode", "rtt", "--clusters", "2",
+              "--rtt-matrix", "1,2,3;1,2,3"])
+
+
+def test_run_sim_fault_script_end_to_end(tmp_path, capsys):
+    from go_avalanche_tpu.run_sim import main
+
+    p = tmp_path / "script.json"
+    p.write_text('[{"kind": "partition", "start": 2, "end": 5,'
+                 ' "frac": 0.5},'
+                 ' {"kind": "churn_burst", "round": 6, "frac": 0.2}]')
+    result = main(["--model", "snowball", "--nodes", "48",
+                   "--finalization-score", "16", "--max-rounds", "60",
+                   "--fault-script", str(p), "--timeout-rounds", "4",
+                   "--json"])
+    assert result["finalized_fraction"] == 1.0

@@ -337,11 +337,12 @@ def test_full_partition_isolates_sides():
 
 
 def test_partition_stalls_then_recovers():
-    # The examples/partition_outage.py acceptance shape, small: under the
+    # The examples/fault_scenarios.py measure() acceptance shape, small:
+    # under the
     # default neutral semantics a 50/50 cut stalls finalization (each
     # window is half unanswered expiries -> the 7-of-8 quorum almost
     # never fires), and healing recovers it.
-    from examples.partition_outage import measure
+    from examples.fault_scenarios import measure
 
     r = measure(nodes=128, txs=16, partition_start=5, partition_end=45,
                 timeout_rounds=4, latency_rounds=1, finalization_score=48,
@@ -815,3 +816,17 @@ def test_partition_split_cluster_aligned_and_interior():
     # 5 clusters of 8, frac 0.5: floor(2.5+0.5)=3 clusters on side A
     # (deterministic half-up, not banker's round(2.5)=2).
     assert cut_rows(5, 0.5) == n - 24
+    # C does not divide N: the split must sit on cluster_of's own
+    # boundary ceil(c*N/C), never c*(N//C) inside a cluster.  N=10,
+    # C=4 puts ids {3, 4} in cluster 1; a frac-0.5 split lands at 5
+    # (first id of cluster 2), and every cluster stays whole.
+    from go_avalanche_tpu.ops.sampling import cluster_of
+
+    timing10 = dict(time_step_s=1.0, request_timeout_s=3.0)
+    cfg10 = AvalancheConfig(n_clusters=4, partition_spec=(0, 10, 0.5),
+                            **timing10)
+    split = inflight._partition_split(cfg10, 10, 0.5)
+    assert split == 5
+    sides = np.asarray(cluster_of(jnp.arange(10), 4, 10))
+    assert len({c for i, c in enumerate(sides) if i < split}
+               & {c for i, c in enumerate(sides) if i >= split}) == 0
