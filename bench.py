@@ -83,11 +83,39 @@ def flagship_program(cfg, n_rounds: int):
     return run
 
 
+def fleet_program(cfg, n_rounds: int, fleet: int):
+    """The `--fleet` variant of `flagship_program`: `fleet` whole
+    flagship scans batched on a leading trial axis inside ONE jit
+    (state donated) — a fleet of small sims is one compiled program and
+    one dispatch, the Monte-Carlo driver's dispatch-amortization
+    workload (`go_avalanche_tpu/fleet.py`).  ``fleet=1`` returns
+    `flagship_program` itself — the f=1 spelling IS the pinned flagship
+    program (`benchmarks/hlo_pin.py --verify-off-path` machine-checks
+    the collapse).  Module-level so `hlo_pin.py` hashes the timed
+    program (`fleet_small`), not a reconstruction of it.
+    """
+    import jax
+
+    from go_avalanche_tpu.models import avalanche as av
+
+    if fleet == 1:
+        return flagship_program(cfg, n_rounds)
+
+    def run_one(s):
+        def body(st, _):
+            new_s, _ = av.round_step(st, cfg)
+            return new_s, None
+        out, _ = jax.lax.scan(body, s, None, length=n_rounds)
+        return out
+
+    return jax.jit(jax.vmap(run_one), donate_argnums=0)
+
+
 def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
           repeats: int = 3, exchange: str = "fused",
           ingest: str = "u8", latency: int = 0,
           latency_mode: str = "fixed", timeout_rounds: int | None = None,
-          inflight: str = "walk",
+          inflight: str = "walk", fleet: int | None = None,
           metrics: str | None = None, metrics_every: int = 0,
           profile: bool = False) -> dict:
     import contextlib
@@ -113,11 +141,26 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
         metrics_every = 0
     elif metrics_every == 0:
         metrics_every = 1
-    state, cfg = flagship_state(n_nodes, n_txs, k, latency,
-                                latency_mode=latency_mode,
-                                timeout_rounds=timeout_rounds,
-                                inflight_engine=inflight,
-                                metrics_every=metrics_every)
+    if fleet is not None:
+        # The in-graph tap's io_callback has no per-trial identity
+        # under the fleet vmap (same rule as fleet.run_fleet); the CLI
+        # rejects the pairing at the parser, the function API here.
+        if metrics:
+            raise ValueError("--fleet cannot stream --metrics: the "
+                             "in-graph tap has no per-trial identity "
+                             "under the fleet vmap")
+        from benchmarks.workload import fleet_flagship_state
+
+        state, cfg = fleet_flagship_state(
+            fleet, n_nodes, n_txs, k, latency,
+            latency_mode=latency_mode, timeout_rounds=timeout_rounds,
+            inflight_engine=inflight)
+    else:
+        state, cfg = flagship_state(n_nodes, n_txs, k, latency,
+                                    latency_mode=latency_mode,
+                                    timeout_rounds=timeout_rounds,
+                                    inflight_engine=inflight,
+                                    metrics_every=metrics_every)
     if exchange != "fused":
         cfg = dataclasses.replace(cfg, fused_exchange=False)
     if ingest != "u8":
@@ -127,6 +170,11 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
     # run times a DIFFERENT program (the in-graph io_callback tap), so
     # the tag keeps it out of the untapped delta chain.
     engine_tag = obs.tag_from_config(cfg)
+    if fleet is not None:
+        # Not a config knob (the batching lives in the program, not the
+        # round), so the fleet width tags the metric here — same-metric
+        # deltas never cross fleet widths.
+        engine_tag += f", fleet{fleet}"
     sink_ctx = (obs.metrics_sink(metrics, tag=engine_tag)
                 if metrics else contextlib.nullcontext())
 
@@ -136,7 +184,8 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
     # Donation means each call consumes its input, so the repeats chain
     # the evolved state (shape-invariant workload: nothing finalizes,
     # throughput per round is identical from any round's state).
-    run = flagship_program(cfg, n_rounds)
+    run = (fleet_program(cfg, n_rounds, fleet) if fleet is not None
+           else flagship_program(cfg, n_rounds))
 
     with sink_ctx:
         # Warm-up: compile + one executed sweep.
@@ -162,7 +211,7 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
             "tag": engine_tag,
         })
 
-    votes = n_nodes * n_txs * k * n_rounds
+    votes = n_nodes * n_txs * k * n_rounds * (fleet or 1)
     votes_per_sec = votes / best_dt
     result = {
         "metric": f"sustained vote ingest ({n_nodes} nodes x {n_txs} txs, "
@@ -206,7 +255,7 @@ def _worker_main(args: argparse.Namespace) -> None:
                    exchange=args.exchange, ingest=args.ingest,
                    latency=args.latency, latency_mode=args.latency_mode,
                    timeout_rounds=args.timeout_rounds,
-                   inflight=args.inflight_engine,
+                   inflight=args.inflight_engine, fleet=args.fleet,
                    metrics=args.metrics, metrics_every=args.metrics_every,
                    profile=args.profile)
     if args.nonce:
@@ -374,6 +423,20 @@ def main() -> None:
                              "ingest; cost tracks deliveries, not "
                              "depth).  Bit-exact all three ways; "
                              "non-default engines tag the metric")
+    parser.add_argument("--fleet", type=int, default=None, metavar="F",
+                        help="dispatch-amortization lane: batch F whole "
+                             "flagship sims on a leading trial axis "
+                             "inside the one timed jit "
+                             "(bench.fleet_program — the Monte-Carlo "
+                             "fleet driver's workload shape, "
+                             "go_avalanche_tpu/fleet.py).  Votes scale "
+                             "by F; the metric gains a ', fleetF' tag "
+                             "so same-metric deltas never cross fleet "
+                             "widths.  F=1 times THE flagship program "
+                             "(hlo_pin --verify-off-path checks the "
+                             "collapse).  A/B at small shape: fleet=1 "
+                             "vs fleet=64 isolates per-dispatch "
+                             "overhead (PERF_NOTES PR 7)")
     parser.add_argument("--metrics", type=str, default=None, metavar="PATH",
                         help="stream per-round telemetry to this JSONL "
                              "file through the in-graph metrics tap "
@@ -407,6 +470,19 @@ def main() -> None:
                         help="accelerator attempts before the CPU fallback")
     args = parser.parse_args()
 
+    if args.fleet is not None:
+        # Parser-level rejection (the PR 5 rule): a worker ValueError
+        # reads as an accelerator failure and spins the retry loop.
+        if args.fleet < 1:
+            parser.error(f"--fleet must be >= 1 trials, got {args.fleet}")
+        if args.metrics:
+            parser.error("--fleet cannot stream --metrics: the in-graph "
+                         "tap has no per-trial identity under the fleet "
+                         "vmap")
+        if args.profile:
+            parser.error("--profile replays one eager round on the "
+                         "timed state; a fleet-stacked state has no "
+                         "single-round spelling")
     if args.metrics_every < 0:
         # Reject here: the worker subprocess's ValueError would read as
         # an accelerator failure and spin the retry/fallback loop.
@@ -425,6 +501,7 @@ def main() -> None:
              f"--latency={args.latency}",
              f"--latency-mode={args.latency_mode}",
              f"--inflight-engine={args.inflight_engine}"] \
+        + ([f"--fleet={args.fleet}"] if args.fleet is not None else []) \
         + ([f"--timeout-rounds={args.timeout_rounds}"]
            if args.timeout_rounds is not None else []) \
         + ([f"--metrics={args.metrics}",

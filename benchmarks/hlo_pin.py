@@ -41,6 +41,15 @@ entry):
                      engine's on-path program.  The OFF path (empty
                      script == every archived pin byte-identical) is
                      covered by `--verify-off-path`;
+  fleet_small      — the `bench.py --fleet 8` program at flagship-mini
+                     shape (256x256): 8 whole flagship scans vmapped on
+                     a leading trial axis inside one jit
+                     (`bench.fleet_program`, PR 7) — the Monte-Carlo
+                     fleet driver's dispatch-amortization workload.
+                     `--verify-off-path` additionally proves the
+                     fleet=1 spelling with an explicitly-empty
+                     stochastic fault block lowers to the archived
+                     `flagship` pin byte-identical;
   streaming_step   — one `models/streaming_dag.step` at the roofline's
                      streaming shape (the north-star scheduler's inner
                      program).
@@ -80,6 +89,10 @@ FLAGSHIP = dict(nodes=16384, txs=16384, rounds=20, k=8)
 # The roofline's streaming shape (roofline.py's non-quick northstar_state).
 STREAMING = dict(nodes=4096, backlog_sets=20000, set_cap=2,
                  window_sets=1024)
+# The fleet dispatch-amortization shape (`bench.py --fleet`): 8 whole
+# flagship-mini sims batched on a leading trial axis inside one jit —
+# the Monte-Carlo fleet driver's workload (go_avalanche_tpu/fleet.py).
+FLEET_SMALL = dict(fleet=8, nodes=256, txs=256, rounds=20, k=8)
 
 
 def flagship_stablehlo(nodes: int, txs: int, rounds: int, k: int,
@@ -125,6 +138,35 @@ def flagship_stablehlo(nodes: int, txs: int, rounds: int, k: int,
     return bench.flagship_program(cfg, rounds).lower(state_abs).as_text()
 
 
+def fleet_stablehlo(fleet: int, nodes: int, txs: int, rounds: int,
+                    k: int, faults=None) -> str:
+    """StableHLO text of the `bench.py --fleet` program: `fleet` whole
+    flagship scans vmapped on a leading trial axis inside one jit
+    (`bench.fleet_program` — the timed program itself, like the
+    flagship entries).  ``fleet=1`` collapses to THE flagship program;
+    `--verify-off-path` uses that to prove the fleet lane's f=1
+    spelling with an explicitly-empty stochastic block lowers to the
+    archived flagship pin byte-identical.  `faults` follows
+    `flagship_stablehlo`'s convention (``[]`` = explicit empty script,
+    None = absent).
+    """
+    import jax
+
+    import bench
+    from benchmarks.workload import flagship_config, fleet_flagship_state
+
+    cfg = flagship_config(txs, k)
+    if faults is not None:
+        from go_avalanche_tpu.config import fault_script_from_json
+
+        cfg = dataclasses.replace(cfg,
+                                  fault_script=fault_script_from_json(faults))
+    state_abs = jax.eval_shape(
+        lambda: fleet_flagship_state(fleet, nodes, txs, k)[0])
+    return bench.fleet_program(cfg, rounds, fleet).lower(
+        state_abs).as_text()
+
+
 def streaming_step_stablehlo(nodes: int, backlog_sets: int, set_cap: int,
                              window_sets: int) -> str:
     """StableHLO text of one north-star streaming-scheduler step
@@ -161,6 +203,8 @@ PROGRAMS = {
                              faults=[["partition", 5, 10, 0.5],
                                      ["latency_spike", 12, 15, 2]]),
                         lambda w: flagship_stablehlo(**w)),
+    "fleet_small": (dict(FLEET_SMALL),
+                    lambda w: fleet_stablehlo(**w)),
     "streaming_step": (dict(STREAMING),
                        lambda w: streaming_step_stablehlo(**w)),
 }
@@ -277,6 +321,20 @@ def verify_off_path(platform: str, archive: dict | None = None) -> list:
             failures.append(
                 f"{tapped} with {knob} forced off hashes to {current} "
                 f"!= the {base} pin {pinned} — {what}")
+    # The fleet lane's f=1 off path (PR 7): `bench --fleet 1` with an
+    # EXPLICITLY empty fault script (stochastic block included) must
+    # lower to THE archived flagship program — fleet batching and the
+    # stochastic fault engine both statically absent at fleet=1.
+    flag = archive.get("programs", {}).get("flagship")
+    if flag and flag.get("hashes", {}).get(platform):
+        workload = dict(flag.get("workload") or FLAGSHIP)
+        current = hlo_hash(fleet_stablehlo(fleet=1, faults=[], **workload))
+        pinned = flag["hashes"][platform]
+        if current != pinned:
+            failures.append(
+                f"fleet=1 empty-stochastic program {current} != the "
+                f"flagship pin {pinned} — the fleet lane's f=1 spelling "
+                f"no longer times the pinned flagship program")
     return failures
 
 

@@ -91,6 +91,32 @@ def flagship_state(nodes: int, txs: int, k: int = 8, latency: int = 0,
     return av.init(jax.random.key(0), nodes, txs, cfg), cfg
 
 
+def fleet_flagship_state(fleet: int, nodes: int, txs: int, k: int = 8,
+                         latency: int = 0, **async_kw):
+    """The `bench.py --fleet` workload: `fleet` flagship states stacked
+    on a leading trial axis (per-trial keys split from the flagship sim
+    seed) plus the shared config — the dispatch-amortization lane's
+    state (`bench.fleet_program` vmaps the whole timed scan over the
+    trial axis; a fleet of small sims is one compiled program and one
+    dispatch).
+
+    ``fleet=1`` returns THE flagship state unstacked: the fleet lane's
+    f=1 spelling is exactly the pinned flagship program
+    (`benchmarks/hlo_pin.py --verify-off-path` machine-checks the
+    collapse).  `async_kw` passes through to `flagship_config` like
+    `flagship_state`'s."""
+    import jax
+
+    from go_avalanche_tpu.models import avalanche as av
+
+    if fleet == 1:
+        return flagship_state(nodes, txs, k, latency, **async_kw)
+    cfg = flagship_config(txs, k, latency, **async_kw)
+    keys = jax.random.split(jax.random.key(_SIM_SEED), fleet)
+    state = jax.vmap(lambda key: av.init(key, nodes, txs, cfg))(keys)
+    return state, cfg
+
+
 def northstar_config(window_sets: int, set_cap: int):
     """The AvalancheConfig every north-star surface runs under: gossip off
     (every node pre-seeded, as in the reference example's feed) and a poll

@@ -35,6 +35,16 @@ Scenarios (`--list` for the one-liners):
                       minority stalls — it can't reach quorum alone —
                       while the majority barely notices; after the heal
                       the minority catches up within one timeout.
+  monte_carlo       — the Monte-Carlo fleet (PR 7): a STOCHASTIC
+                      partition whose length (and split fraction) is
+                      drawn per trial from the init key
+                      (`cfg.fault_script` stochastic_partition ranges,
+                      `go_avalanche_tpu/fleet.py`), a whole fleet of
+                      sims vmapped into one program, each trial's recovery
+                      checked against ITS realized window
+                      (`FleetResult.cut_windows`) — ending in a printed
+                      P(recovery) ± Wilson-CI verdict instead of one
+                      anecdote, with a realized-length breakdown.
 
     python examples/fault_scenarios.py                    # all scenarios
     python examples/fault_scenarios.py eclipse flaky_isp
@@ -227,6 +237,119 @@ SCENARIOS = {
 }
 
 
+def run_monte_carlo(
+    nodes: int = 128,
+    txs: int = 32,
+    fleet: int = 48,
+    timeout_rounds: int = 4,
+    n_rounds: int = 70,
+    seed: int = 0,
+    metrics_path: str | None = None,
+) -> dict:
+    """The Monte-Carlo scenario: a stochastic partition-length sweep.
+
+    One `cfg.fault_script` stochastic_partition event — start drawn from
+    rounds [5, 10], LENGTH from [6, 28] rounds, split fraction from
+    [0.35, 0.65] — realized independently per trial from the init key
+    (`ops/inflight.draw_fault_params`), a fleet of whole sims vmapped
+    into one compiled program (`fleet.run_fleet`), and every trial's
+    recovery invariants checked against ITS OWN realized ``[start,
+    heal)`` window (`obs.check_recovery` on the fleet-stacked trace +
+    `FleetResult.cut_windows`).  The verdict is a POPULATION number:
+    P(recovery) with a Wilson CI, plus the recovery rate bucketed by
+    realized outage length — short cuts always heal, cuts approaching
+    the horizon run out of rounds to drain their expiry tail.
+
+    With `metrics_path`, the fleet-stacked trace streams to that JSONL
+    file (per-round rows whose counters are per-trial LISTS — the
+    fleet-trace format, docs/observability.md) and the verdicts are
+    then checked FROM the file.
+    """
+    from go_avalanche_tpu import fleet as fl
+    from go_avalanche_tpu import obs
+    from go_avalanche_tpu.config import AvalancheConfig
+
+    cfg = AvalancheConfig(
+        finalization_score=48,
+        latency_mode="fixed", latency_rounds=1,
+        fault_script=(
+            ("stochastic_partition", (5, 10), (6, 28), (0.35, 0.65)),),
+        time_step_s=1.0,
+        request_timeout_s=float(timeout_rounds - 1),
+    )
+    res = fl.run_fleet("avalanche", cfg, fleet=fleet, n_nodes=nodes,
+                       n_txs=txs, n_rounds=n_rounds, seed=seed)
+    records = fl.fleet_trace_records(res.telemetry, fleet)
+
+    if metrics_path:
+        with obs.metrics_sink(metrics_path,
+                              tag=obs.tag_from_config(cfg)) as sink:
+            for rec in records:
+                sink.write(rec)
+        obs.write_manifest(metrics_path, cfg, extra={
+            "study": "fault_scenarios.monte_carlo",
+            "workload": {"nodes": nodes, "txs": txs, "rounds": n_rounds,
+                         "fleet": fleet, "seed": seed},
+        })
+        records = obs.recovery.load_trace(metrics_path)
+
+    # One verdict per trial, each against its own realized window —
+    # check_recovery returns the vector (no raise) on a fleet trace.
+    reports = obs.check_recovery(cfg, records, windows=res.cut_windows)
+    oks = [r.ok for r in reports]
+    recovered = sum(oks)
+    ci = fl.wilson_interval(recovered, fleet)
+
+    # Recovery rate by realized outage length (the sweep's x-axis).
+    lengths = (res.cut_windows[:, 0, 1] - res.cut_windows[:, 0, 0])
+    by_length: dict = {}
+    for lo, hi in ((6, 12), (12, 20), (20, 29)):
+        sel = [i for i in range(fleet) if lo <= int(lengths[i]) < hi]
+        if sel:
+            by_length[f"[{lo}, {hi})"] = {
+                "trials": len(sel),
+                "recovered": sum(oks[i] for i in sel),
+            }
+    return {
+        "scenario": "monte_carlo",
+        "fleet": fleet,
+        "recovered": int(recovered),
+        "p_recovery": recovered / fleet,
+        "recovery_ci": list(ci),
+        "p_settled": res.p_settled,
+        "settled_ci": list(res.settled_ci),
+        "violations": int(res.violations.sum()),
+        "by_length": by_length,
+        "realized_windows": res.cut_windows[:, 0, :].tolist(),
+        "failed_trials": [i for i, ok in enumerate(oks) if not ok],
+        "metrics_file": metrics_path,
+        "rounds": n_rounds,
+    }
+
+
+def _print_monte_carlo(r: dict) -> None:
+    lo, hi = r["recovery_ci"]
+    print("\n== monte_carlo ==")
+    print(f"stochastic partition: start ~ U[5, 10], length ~ U[6, 28] "
+          f"rounds, split ~ U[0.35, 0.65] — realized per trial, "
+          f"{r['fleet']} trials in one vmapped program")
+    print(f"P(recovery) = {r['recovered']}/{r['fleet']} "
+          f"= {r['p_recovery']:.3f}  (95% Wilson CI "
+          f"[{lo:.3f}, {hi:.3f}])")
+    print(f"P(settled)  = {r['p_settled']:.3f}  (CI "
+          f"[{r['settled_ci'][0]:.3f}, {r['settled_ci'][1]:.3f}]); "
+          f"{r['violations']} safety violations")
+    print("recovery by realized outage length:")
+    for bucket, b in r["by_length"].items():
+        print(f"  length {bucket:>9}: {b['recovered']}/{b['trials']} "
+              f"recovered")
+    if r["failed_trials"]:
+        print(f"unrecovered trials: {r['failed_trials']}")
+    if r["metrics_file"]:
+        print(f"trace: {r['metrics_file']} (+ .manifest.json; "
+              f"fleet-stacked rows — per-trial LISTS per counter)")
+
+
 def run_scenario(
     name: str,
     nodes: int = 512,
@@ -350,7 +473,8 @@ def _print_scenario(r: dict) -> None:
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("scenarios", nargs="*",
-                        choices=[[], *SCENARIOS, "partition_heal"],
+                        choices=[[], *SCENARIOS, "partition_heal",
+                                 "monte_carlo"],
                         help="scenarios to run (default: all)")
     parser.add_argument("--list", action="store_true",
                         help="list scenarios and exit")
@@ -367,6 +491,9 @@ def main() -> None:
     parser.add_argument("--rounds", type=int, default=130,
                         help="partition_heal horizon (other scenarios "
                              "fix their own)")
+    parser.add_argument("--fleet", type=int, default=48,
+                        help="monte_carlo trial count (one vmapped "
+                             "program; Wilson CI tightens as 1/sqrt(F))")
     parser.add_argument("--metrics", type=str, default=None,
                         metavar="PATH",
                         help="stream each scenario's per-round telemetry "
@@ -382,9 +509,13 @@ def main() -> None:
               "absence semantics (measure())")
         for name, fn in SCENARIOS.items():
             print(f"{name}: {fn.__doc__.splitlines()[0].strip()}")
+        print("monte_carlo: stochastic partition-length sweep — a "
+              "vmapped fleet, per-trial realized windows, "
+              "P(recovery) ± Wilson CI (run_monte_carlo())")
         return
 
-    names = args.scenarios or ["partition_heal", *SCENARIOS]
+    names = args.scenarios or ["partition_heal", *SCENARIOS,
+                               "monte_carlo"]
     out = []
     for name in names:
         metrics_path = None
@@ -411,6 +542,15 @@ def main() -> None:
             out.extend(results)
             if not args.json:
                 _print_partition_heal(results)
+        elif name == "monte_carlo":
+            r = run_monte_carlo(nodes=args.nodes, txs=args.txs,
+                                fleet=args.fleet,
+                                timeout_rounds=args.timeout_rounds,
+                                seed=args.seed,
+                                metrics_path=metrics_path)
+            out.append(r)
+            if not args.json:
+                _print_monte_carlo(r)
         else:
             r = run_scenario(name, nodes=args.nodes, txs=args.txs,
                              timeout_rounds=args.timeout_rounds,

@@ -67,7 +67,24 @@ _FAULT_EVENT_FIELDS = {
     "regional_outage": ("start", "end", "cluster"),
     "latency_spike": ("start", "end", "extra_rounds"),
     "churn_burst": ("round", "frac"),
+    # Stochastic events (PR 7, the Monte-Carlo fleet): every field is a
+    # [lo, hi] RANGE (inclusive), not a scalar — the realized value is
+    # drawn per SIMULATION from the sim's init key
+    # (`ops/inflight.draw_fault_params`, stored as `state.fault_params`),
+    # so each fleet trial sees a different realized schedule while the
+    # event STRUCTURE (how many events, which kind, which ranges) stays
+    # jit-static.  Windows are [start, start + length) — length replaces
+    # the end field because a stochastic end could precede a stochastic
+    # start.
+    "stochastic_partition": ("start", "length", "frac"),
+    "stochastic_spike": ("start", "length", "extra_rounds"),
 }
+
+# The event kinds whose parameters are drawn at init rather than fixed
+# in the script; their realized windows are per-trial, so they are
+# exempt from the static overlap check (realized cut masks OR and spike
+# extras ADD, so overlapping realizations compose deterministically).
+_STOCHASTIC_KINDS = ("stochastic_partition", "stochastic_spike")
 
 
 def fault_script_from_json(data) -> Tuple[Tuple, ...]:
@@ -452,17 +469,41 @@ class AvalancheConfig:
         return events
 
     def cut_events(self) -> Tuple[Tuple, ...]:
-        """Events that sever (querier, responder) pairs — partitions and
-        regional outages; their draws get the never-delivers sentinel at
-        issue time (`ops/inflight.partition_cut`)."""
+        """STATIC events that sever (querier, responder) pairs —
+        partitions and regional outages; their draws get the
+        never-delivers sentinel at issue time
+        (`ops/inflight.partition_cut`)."""
         return tuple(e for e in self.fault_events()
                      if e[0] in ("partition", "regional_outage"))
 
     def spike_events(self) -> Tuple[Tuple, ...]:
-        """latency_spike events — additive latency on queries ISSUED
-        during the window (`ops/inflight.apply_latency_spikes`)."""
+        """STATIC latency_spike events — additive latency on queries
+        ISSUED during the window (`ops/inflight.apply_latency_spikes`)."""
         return tuple(e for e in self.fault_events()
                      if e[0] == "latency_spike")
+
+    def stochastic_cut_events(self) -> Tuple[Tuple, ...]:
+        """stochastic_partition events — cut events whose realized
+        (start, length, frac) is drawn per sim from the init key
+        (`ops/inflight.draw_fault_params`); every range field here is a
+        validated (lo, hi) tuple."""
+        return tuple(e for e in self.fault_events()
+                     if e[0] == "stochastic_partition")
+
+    def stochastic_spike_events(self) -> Tuple[Tuple, ...]:
+        """stochastic_spike events — latency spikes whose realized
+        (start, length, extra_rounds) is drawn per sim from the init
+        key."""
+        return tuple(e for e in self.fault_events()
+                     if e[0] == "stochastic_spike")
+
+    def stochastic_events(self) -> Tuple[Tuple, ...]:
+        """All stochastic events, in script order — the list
+        `ops/inflight.draw_fault_params` realizes (its PRNG stream folds
+        the index into THIS ordering, so a sim's realized schedule is a
+        pure function of (config, init key))."""
+        return tuple(e for e in self.fault_events()
+                     if e[0] in _STOCHASTIC_KINDS)
 
     def churn_burst_events(self) -> Tuple[Tuple, ...]:
         """churn_burst events — one-shot alive-toggle impulses applied by
@@ -475,10 +516,13 @@ class AvalancheConfig:
         """True when the in-flight query engine (`ops/inflight.py`) is on:
         a latency distribution is selected or any cut/spike fault event
         is scheduled (partition_spec or fault_script; churn bursts alone
-        need no ring).  False = the synchronous ideal, the exact
-        pre-async code path (flagship `hlo_pin` program unchanged)."""
+        need no ring).  Stochastic events always need the ring — their
+        realized windows are unknown until the init key draws them.
+        False = the synchronous ideal, the exact pre-async code path
+        (flagship `hlo_pin` program unchanged)."""
         return (self.latency_mode != "none" or bool(self.cut_events())
-                or bool(self.spike_events()))
+                or bool(self.spike_events())
+                or bool(self.stochastic_events()))
 
     def timeout_rounds(self) -> int:
         """First round-AGE at which an outstanding query is expired.
@@ -616,7 +660,18 @@ class AvalancheConfig:
         must fail before the worker retry loop ever sees it)."""
         if self.fault_script is None:
             return
-        script = tuple(tuple(e) for e in self.fault_script)
+
+        def _canon(ev):
+            # Deep-tuple: stochastic range fields arrive as JSON lists;
+            # the whole script must stay hashable (jit-static config).
+            ev = tuple(ev)
+            if ev and ev[0] in _STOCHASTIC_KINDS:
+                return (ev[0],) + tuple(
+                    tuple(f) if isinstance(f, (list, tuple)) else f
+                    for f in ev[1:])
+            return ev
+
+        script = tuple(_canon(e) for e in self.fault_script)
         object.__setattr__(self, "fault_script", script)
         for i, ev in enumerate(script):
             if not ev or ev[0] not in _FAULT_EVENT_FIELDS:
@@ -640,6 +695,9 @@ class AvalancheConfig:
                     raise ValueError(
                         f"fault_script[{i}]: churn_burst frac must be "
                         f"in (0, 1], got {frac!r}")
+                continue
+            if kind in _STOCHASTIC_KINDS:
+                self._validate_stochastic_event(i, ev)
                 continue
             _, start, end, param = ev
             if int(start) != start or int(end) != end:
@@ -683,9 +741,14 @@ class AvalancheConfig:
         # the spike? — so the merged script (partition_spec sugar
         # included) rejects them; different clusters / different kinds
         # compose freely (cascading regional failures are the point).
+        # Stochastic events are EXEMPT: their realized windows are
+        # per-trial, and overlap is well-defined anyway (cut masks OR,
+        # spike extras add).
         windows: dict = {}
         for ev in self.fault_events():
             kind = ev[0]
+            if kind in _STOCHASTIC_KINDS:
+                continue
             if kind == "churn_burst":
                 key, span = (kind,), (ev[1], ev[1] + 1)
             elif kind == "regional_outage":
@@ -702,6 +765,60 @@ class AvalancheConfig:
                         f"{max(other[0], span[0])} (partition_spec "
                         f"counts as a partition event)")
             windows[key].append(span)
+
+    def _validate_stochastic_event(self, i: int, ev: Tuple) -> None:
+        """One stochastic event: every field a (lo, hi) range with
+        lo <= hi — start/length/extra integer rounds, frac a float in
+        (0, 1).  The realized draw is uniform over [lo, hi] (inclusive
+        for the integer fields), so a degenerate lo == hi range pins
+        that parameter while the others stay random."""
+        kind = ev[0]
+        fields = _FAULT_EVENT_FIELDS[kind]
+
+        def _range(name, value, *, integer, lo_min):
+            if (not isinstance(value, tuple) or len(value) != 2):
+                raise ValueError(
+                    f"fault_script[{i}]: {kind} {name} must be a "
+                    f"[lo, hi] range, got {value!r}")
+            lo, hi = value
+            for v in (lo, hi):
+                # bools, strings and nulls all reject with the indexed
+                # message (int("a") would escape as a raw ValueError,
+                # int(True) would validate as the range [1, 1],
+                # None as a raw TypeError from the comparison — the
+                # --rtt-matrix bug class the PR 6 review closed).
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    raise ValueError(
+                        f"fault_script[{i}]: {kind} {name} bounds must "
+                        f"be numbers, got {value!r}")
+                if integer and int(v) != v:
+                    raise ValueError(
+                        f"fault_script[{i}]: {kind} {name} bounds must "
+                        f"be integers, got {value!r}")
+            if not (lo_min <= lo <= hi):
+                raise ValueError(
+                    f"fault_script[{i}]: {kind} {name} range must "
+                    f"satisfy {lo_min} <= lo <= hi, got {value!r}")
+
+        _range(fields[0], ev[1], integer=True, lo_min=0)       # start
+        _range(fields[1], ev[2], integer=True, lo_min=1)       # length
+        if kind == "stochastic_partition":
+            # frac needs OPEN bounds on both sides, which _range's
+            # lo_min<=lo<=hi shape doesn't spell — validated here with
+            # the same non-numeric rejection (None/str/bool all take
+            # the indexed message, never a raw TypeError).
+            lo, hi = (ev[3] if isinstance(ev[3], tuple) and len(ev[3]) == 2
+                      else (None, None))
+            for v in (lo, hi):
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    lo = None
+                    break
+            if lo is None or not (0.0 < lo <= hi < 1.0):
+                raise ValueError(
+                    f"fault_script[{i}]: stochastic_partition frac must "
+                    f"be a [lo, hi] range inside (0, 1), got {ev[3]!r}")
+        else:                                                  # spike
+            _range(fields[2], ev[3], integer=True, lo_min=1)
 
     def _validate_rtt_matrix(self) -> None:
         """The cluster-pair RTT matrix must be square, match the

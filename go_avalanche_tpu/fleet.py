@@ -1,0 +1,550 @@
+"""Monte-Carlo fleet driver: whole-sim vmap over a batched seed axis.
+
+"Quantifying Liveness and Safety of Avalanche's Snowball"
+(arXiv:2409.02217) and "An Analysis of Avalanche Consensus"
+(arXiv:2401.02811) derive failure probabilities as functions of
+(k, quorum, byzantine fraction, adversary strategy) — exactly the axes
+`AvalancheConfig` exposes.  This module turns one simulated trajectory
+into a STATISTICAL GUARANTEE: `run_fleet` vmaps an **entire sim** —
+init from a per-trial key, the full `round_step` scan, and the in-graph
+safety/finality reduction — over a batched seed axis, so a fleet of
+``F`` trials is ONE compiled program over ``[F, N, T]`` planes (one
+compile per config point; config axes sweep by re-jit, the seed axis
+batches in-graph).  A fleet of 1024 small sims is also the ideal
+dispatch-amortization workload (`bench.py --fleet`).
+
+What a trial reports (`TrialOutcome`, reduced in-graph to scalars):
+
+  * **safety violation** — the papers' safety event, detected on the
+    final state among HONEST nodes only (byzantine rows may "finalize"
+    anything; the protocol's guarantee is about correct nodes):
+    snowball = quorum divergence (two honest nodes finalized opposite
+    colors); avalanche = any tx finalized accepted by one honest node
+    and rejected by another; dag = two txs of one conflict set both
+    finalized ACCEPTED somewhere among honest nodes (a double-spend
+    committed twice);
+  * **settled** + **finality round** — did every honest record (set,
+    for the DAG) finalize within the horizon, and the round the LAST
+    one landed (-1 while unsettled): the per-trial finality capture
+    behind E(finality) and its CI;
+  * the realized stochastic fault windows (`cfg.stochastic_events()`,
+    `ops/inflight.draw_fault_params`) so per-trial recovery checking
+    (`obs.recovery.verify_recovery(..., windows=...)`) knows each
+    trial's actual schedule.
+
+Fleet estimates carry **Wilson confidence intervals**
+(`wilson_interval`) — the phase-diagram numbers are P(violation) /
+P(settled) with CIs that behave at 0 and 1 (a 512-trial fleet with no
+violations excludes rates above ~0.75%, which is what makes "safe at
+this config point" a checkable claim rather than an anecdote).
+
+Phase diagrams: `run_phase_grid` sweeps a validated axis grid
+(`phase_points`) by re-jit, one fleet per point, and streams one JSONL
+row per point through the `obs` sink with `tag_from_config` tags —
+the phase-diagram format documented in docs/observability.md.
+
+    from go_avalanche_tpu import fleet
+    res = fleet.run_fleet("snowball", cfg, fleet=512, n_nodes=64,
+                          n_rounds=120)
+    res.p_violation, res.violation_ci     # P(safety violation) + CI
+
+    rows = fleet.run_phase_grid(
+        "snowball", cfg, {"byzantine_fraction": [0.0, 0.2, 0.4]},
+        fleet=512, n_nodes=64, n_rounds=120)
+
+vmap-cleanliness contract (the PR 7 audit): every model's init/run
+path is free of data-dependent Python branching — statics come from
+the config and shapes, never from traced values — pinned by the
+`vmap(run_scan)` == stacked-individual-runs bit-parity tests
+(tests/test_fleet.py, all three inflight engines, dense + sharded).
+`cfg.metrics_every` must be 0 here: the in-graph tap's io_callback
+has no per-trial identity under vmap (phase rows stream host-side
+through the sink instead).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import itertools
+import math
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from go_avalanche_tpu.config import AdversaryStrategy, AvalancheConfig
+from go_avalanche_tpu.ops import voterecord as vr
+
+FLEET_MODELS = ("snowball", "avalanche", "dag")
+
+
+# --------------------------------------------------------------------------
+# Wilson confidence interval — the fleet's one spelling of "how sure".
+
+
+def wilson_interval(successes: int, trials: int,
+                    z: float = 1.96) -> Tuple[float, float]:
+    """Wilson score interval for a binomial proportion; (lo, hi).
+
+    Chosen over the normal approximation because the phase diagram's
+    interesting points sit at the extremes: 0 successes gives a
+    non-degenerate upper bound (z²/(n+z²) ≈ 0.75% at n=512) and any
+    success count >= 1 gives a strictly positive lower bound — exactly
+    the "CI excludes 0" / "CI excludes rates above x%" claims the
+    acceptance pins make.
+    """
+    if trials <= 0:
+        raise ValueError(f"wilson_interval needs trials >= 1, got {trials}")
+    if not (0 <= successes <= trials):
+        raise ValueError(f"successes {successes} outside [0, {trials}]")
+    p = successes / trials
+    z2 = z * z
+    denom = 1.0 + z2 / trials
+    center = (p + z2 / (2 * trials)) / denom
+    half = (z * math.sqrt(p * (1 - p) / trials + z2 / (4 * trials * trials))
+            / denom)
+    return max(0.0, center - half), min(1.0, center + half)
+
+
+# --------------------------------------------------------------------------
+# In-graph safety-violation detectors (final-state reductions).
+
+
+def snowball_safety_violated(state, cfg: AvalancheConfig) -> jax.Array:
+    """Quorum divergence: two HONEST nodes finalized opposite colors.
+
+    Scalar bool, in-graph.  Byzantine rows are excluded — the papers'
+    safety property quantifies over correct nodes (an adversary
+    "finalizing" both colors is its prerogative, not a protocol
+    failure).
+    """
+    fin = vr.has_finalized(state.records.confidence, cfg)
+    acc = vr.is_accepted(state.records.confidence)
+    honest_fin = fin & jnp.logical_not(state.byzantine)
+    return (honest_fin & acc).any() & (honest_fin & ~acc).any()
+
+
+def avalanche_safety_violated(state, cfg: AvalancheConfig) -> jax.Array:
+    """Per-target divergence: some tx finalized ACCEPTED by one honest
+    node and REJECTED by another.  Scalar bool, in-graph."""
+    fin = vr.has_finalized(state.records.confidence, cfg)
+    acc = vr.is_accepted(state.records.confidence)
+    honest = jnp.logical_not(state.byzantine)[:, None]
+    yes = (fin & acc & honest).any(axis=0)          # [T]
+    no = (fin & ~acc & honest).any(axis=0)
+    return (yes & no).any()
+
+
+def dag_safety_violated(state, cfg: AvalancheConfig) -> jax.Array:
+    """Conflict-set double-finalize: two txs of ONE conflict set both
+    finalized ACCEPTED somewhere among honest nodes — the committed
+    double-spend.  Scalar bool, in-graph; cross-node counts (node A
+    commits tx1, node B commits its rival) are violations too, which is
+    why the reduction ORs over nodes BEFORE counting per set."""
+    base = state.base
+    fin_acc = (vr.has_finalized(base.records.confidence, cfg)
+               & vr.is_accepted(base.records.confidence))
+    honest = jnp.logical_not(base.byzantine)[:, None]
+    committed_t = (fin_acc & honest).any(axis=0)    # [T]
+    if state.set_size is not None:
+        t = committed_t.shape[0]
+        per_set = committed_t.reshape(t // state.set_size,
+                                      state.set_size).sum(axis=1)
+    else:
+        per_set = jax.ops.segment_sum(committed_t.astype(jnp.int32),
+                                      state.conflict_set,
+                                      num_segments=state.n_sets)
+    return (per_set >= 2).any()
+
+
+class TrialOutcome(NamedTuple):
+    """One fleet trial's in-graph reduction (scalars; ``[F]``-stacked
+    under the fleet vmap)."""
+
+    violation: jax.Array          # bool — safety violated at the horizon
+    settled: jax.Array            # bool — every honest record/set final
+    finality_round: jax.Array     # int32 — round the LAST honest record
+                                  #   finalized; -1 while unsettled
+    finalized_fraction: jax.Array  # float32 — honest records finalized
+    cut_start: Optional[jax.Array] = None  # int32 [Ec] realized windows
+    cut_end: Optional[jax.Array] = None    # (None: no stochastic cuts)
+
+
+def _outcome_snowball(state, cfg: AvalancheConfig) -> TrialOutcome:
+    fin = vr.has_finalized(state.records.confidence, cfg)
+    honest = jnp.logical_not(state.byzantine)
+    settled = (fin | ~honest).all()
+    stamped = jnp.where(honest & fin, state.finalized_at, -1)
+    return TrialOutcome(
+        violation=snowball_safety_violated(state, cfg),
+        settled=settled,
+        finality_round=jnp.where(settled, stamped.max(), jnp.int32(-1)),
+        finalized_fraction=(fin & honest).sum() / honest.sum(),
+        cut_start=(None if state.fault_params is None
+                   else state.fault_params.cut_start),
+        cut_end=(None if state.fault_params is None
+                 else state.fault_params.cut_end))
+
+
+def _outcome_avalanche(state, cfg: AvalancheConfig) -> TrialOutcome:
+    fin = vr.has_finalized(state.records.confidence, cfg)
+    honest = jnp.logical_not(state.byzantine)[:, None]
+    settled = (fin | ~honest).all()
+    stamped = jnp.where(honest & fin, state.finalized_at, -1)
+    return TrialOutcome(
+        violation=avalanche_safety_violated(state, cfg),
+        settled=settled,
+        finality_round=jnp.where(settled, stamped.max(), jnp.int32(-1)),
+        finalized_fraction=((fin & honest).sum()
+                            / honest.sum() / fin.shape[1]),
+        cut_start=(None if state.fault_params is None
+                   else state.fault_params.cut_start),
+        cut_end=(None if state.fault_params is None
+                 else state.fault_params.cut_end))
+
+
+def _outcome_dag(state, cfg: AvalancheConfig) -> TrialOutcome:
+    from go_avalanche_tpu.models import dag as dag_model
+
+    base = state.base
+    fin_acc = (vr.has_finalized(base.records.confidence, cfg)
+               & vr.is_accepted(base.records.confidence))
+    honest = jnp.logical_not(base.byzantine)[:, None]
+    if state.set_size is not None:
+        resolved = dag_model.set_any_fixed(fin_acc, state.set_size)
+        n_sets_f = fin_acc.shape[1] // state.set_size
+    else:
+        set_done = jax.ops.segment_max(fin_acc.astype(jnp.uint8).T,
+                                       state.conflict_set,
+                                       num_segments=state.n_sets)
+        resolved = set_done.T[:, state.conflict_set] > 0
+        n_sets_f = state.n_sets
+    settled = (resolved | ~honest).all()
+    stamped = jnp.where(honest & fin_acc, base.finalized_at, -1)
+    # resolved is per (node, tx); fraction counts (honest node, set)
+    # pairs with a committed winner.
+    if state.set_size is not None:
+        n, t = resolved.shape
+        per_set = resolved.reshape(n, n_sets_f, state.set_size).any(axis=2)
+    else:
+        per_set = (jax.ops.segment_max(resolved.astype(jnp.uint8).T,
+                                       state.conflict_set,
+                                       num_segments=state.n_sets).T > 0)
+    honest_rows = jnp.logical_not(base.byzantine)
+    frac = ((per_set & honest_rows[:, None]).sum()
+            / honest_rows.sum() / n_sets_f)
+    return TrialOutcome(
+        violation=dag_safety_violated(state, cfg),
+        settled=settled,
+        finality_round=jnp.where(settled, stamped.max(), jnp.int32(-1)),
+        finalized_fraction=frac,
+        cut_start=(None if base.fault_params is None
+                   else base.fault_params.cut_start),
+        cut_end=(None if base.fault_params is None
+                 else base.fault_params.cut_end))
+
+
+# --------------------------------------------------------------------------
+# The fleet program: vmap(init -> scan(round_step) -> reduce) over keys.
+
+
+@functools.lru_cache(maxsize=16)  # bounded, like models/avalanche's jits
+def _compiled_fleet(model: str, cfg: AvalancheConfig, n_nodes: int,
+                    n_txs: int, n_rounds: int, conflict_size: int,
+                    yes_fraction: float, contested: bool):
+    """One jitted ``keys [F] -> (TrialOutcome [F], telemetry [F, R])``
+    program — the whole sim (init included) lives inside the vmap, so a
+    fleet is one compile and one dispatch per config point."""
+
+    def trial(key):
+        if model == "snowball":
+            from go_avalanche_tpu.models import snowball as sb
+
+            state = sb.init(key, n_nodes, cfg, yes_fraction=yes_fraction)
+            step, outcome = sb.round_step, _outcome_snowball
+        elif model == "avalanche":
+            from go_avalanche_tpu.models import avalanche as av
+
+            init_pref = (av.contested_init_pref_from_key(key, n_nodes,
+                                                         n_txs)
+                         if contested else None)
+            state = av.init(key, n_nodes, n_txs, cfg,
+                            init_pref=init_pref)
+            step, outcome = av.round_step, _outcome_avalanche
+        else:
+            from go_avalanche_tpu.models import dag as dag_model
+
+            state = dag_model.init(
+                key, n_nodes,
+                jnp.arange(n_txs, dtype=jnp.int32) // conflict_size, cfg,
+                n_sets=n_txs // conflict_size, set_size=conflict_size)
+            step, outcome = dag_model.round_step, _outcome_dag
+
+        def body(s, _):
+            new_s, tel = step(s, cfg)
+            return new_s, tel
+
+        final, tel = lax.scan(body, state, None, length=n_rounds)
+        return outcome(final, cfg), tel
+
+    return jax.jit(jax.vmap(trial))
+
+
+@dataclasses.dataclass
+class FleetResult:
+    """Host-side reduction of one fleet: per-trial vectors plus the
+    Wilson-CI estimates the phase diagram plots."""
+
+    model: str
+    fleet: int
+    rounds: int
+    violations: np.ndarray          # bool [F]
+    settled: np.ndarray             # bool [F]
+    finality_round: np.ndarray      # int32 [F]; -1 where unsettled
+    finalized_fraction: np.ndarray  # float32 [F]
+    telemetry: object               # stacked telemetry pytree [F, R]
+    cut_windows: Optional[np.ndarray]  # int32 [F, Ec, 2] realized
+                                    #   stochastic [start, end) windows
+    p_violation: float = 0.0
+    violation_ci: Tuple[float, float] = (0.0, 0.0)
+    p_settled: float = 0.0
+    settled_ci: Tuple[float, float] = (0.0, 0.0)
+    finality_mean: Optional[float] = None   # over settled trials
+    finality_ci: Optional[Tuple[float, float]] = None
+
+    def summary(self) -> Dict:
+        """The phase-diagram JSONL row body (docs/observability.md)."""
+        return {
+            "model": self.model,
+            "fleet": self.fleet,
+            "rounds": self.rounds,
+            "violations": int(self.violations.sum()),
+            "p_violation": round(self.p_violation, 6),
+            "violation_ci": [round(x, 6) for x in self.violation_ci],
+            "p_settled": round(self.p_settled, 6),
+            "settled_ci": [round(x, 6) for x in self.settled_ci],
+            "finality_mean": (None if self.finality_mean is None
+                              else round(self.finality_mean, 3)),
+            "finality_ci": (None if self.finality_ci is None else
+                            [round(x, 3) for x in self.finality_ci]),
+            "finalized_fraction_mean": round(
+                float(self.finalized_fraction.mean()), 6),
+        }
+
+
+def run_fleet(
+    model: str,
+    cfg: AvalancheConfig,
+    fleet: int,
+    n_nodes: int,
+    n_txs: int = 64,
+    n_rounds: int = 100,
+    seed: int = 0,
+    conflict_size: int = 2,
+    yes_fraction: float = 0.5,
+    contested: bool = True,
+) -> FleetResult:
+    """Run `fleet` independent trials of one config point as ONE
+    vmapped program; reduce to Wilson-CI estimates.
+
+    Per-trial keys are `split(key(seed), fleet)`, so trial i of a fleet
+    is deterministic in (config, seed, fleet) and trials never share a
+    stream.  `contested` (avalanche only) seeds per-node 50/50 priors
+    from each trial's key — the convergence workload; `yes_fraction`
+    is the snowball prior.
+    """
+    if model not in FLEET_MODELS:
+        raise ValueError(f"fleet models are {', '.join(FLEET_MODELS)}, "
+                         f"got {model!r}")
+    if fleet < 1:
+        raise ValueError(f"fleet must be >= 1, got {fleet}")
+    if cfg.metrics_every > 0:
+        raise ValueError(
+            "the in-graph metrics tap (cfg.metrics_every > 0) cannot "
+            "run under the fleet vmap — an io_callback has no per-trial "
+            "identity there; phase rows stream host-side through the "
+            "obs sink instead")
+    if model == "dag" and n_txs % conflict_size:
+        raise ValueError(f"n_txs ({n_txs}) must divide by conflict_size "
+                         f"({conflict_size})")
+    keys = jax.random.split(jax.random.key(seed), fleet)
+    outcome, telemetry = _compiled_fleet(
+        model, cfg, int(n_nodes), int(n_txs), int(n_rounds),
+        int(conflict_size), float(yes_fraction), bool(contested))(keys)
+    violations = np.asarray(jax.device_get(outcome.violation))
+    settled = np.asarray(jax.device_get(outcome.settled))
+    finality = np.asarray(jax.device_get(outcome.finality_round))
+    frac = np.asarray(jax.device_get(outcome.finalized_fraction))
+    cut_windows = None
+    if outcome.cut_start is not None:
+        cut_windows = np.stack(
+            [np.asarray(jax.device_get(outcome.cut_start)),
+             np.asarray(jax.device_get(outcome.cut_end))], axis=-1)
+
+    res = FleetResult(
+        model=model, fleet=fleet, rounds=n_rounds,
+        violations=violations, settled=settled, finality_round=finality,
+        finalized_fraction=frac, telemetry=jax.device_get(telemetry),
+        cut_windows=cut_windows,
+        p_violation=float(violations.mean()),
+        violation_ci=wilson_interval(int(violations.sum()), fleet),
+        p_settled=float(settled.mean()),
+        settled_ci=wilson_interval(int(settled.sum()), fleet),
+    )
+    if settled.any():
+        fr = finality[settled].astype(np.float64)
+        res.finality_mean = float(fr.mean())
+        half = (float(1.96 * fr.std(ddof=1) / math.sqrt(fr.size))
+                if fr.size > 1 else 0.0)
+        res.finality_ci = (res.finality_mean - half,
+                           res.finality_mean + half)
+    return res
+
+
+def fleet_trace_records(telemetry, fleet: int) -> List[Dict]:
+    """A fleet's stacked telemetry (`[F, R]` leaves) as FLEET-STACKED
+    trace records: one dict per round whose counter values are
+    per-trial LISTS — the format `obs.recovery.check_recovery`
+    dispatches on (and the `--metrics` JSONL spelling of a fleet run,
+    docs/observability.md)."""
+    from go_avalanche_tpu.obs.sink import _flatten_telemetry
+
+    flat = _flatten_telemetry(jax.device_get(telemetry), {})
+    n_rounds = int(next(iter(flat.values())).shape[1])
+    return [{"round": r,
+             **{k: [int(v[i, r]) for i in range(fleet)]
+                for k, v in flat.items()}}
+            for r in range(n_rounds)]
+
+
+# --------------------------------------------------------------------------
+# Phase grids: config axes swept by re-jit, one fleet per point.
+
+# Axis name -> coercion.  The sweepable axes are exactly the papers'
+# (k, quorum, byzantine fraction, adversary strategy) plus the fault /
+# latency knobs a phase diagram wants on its other axis.
+_GRID_AXES = {
+    "k": int,
+    "quorum": int,
+    "window": int,
+    "alpha": float,
+    "finalization_score": int,
+    "byzantine_fraction": float,
+    "flip_probability": float,
+    "drop_probability": float,
+    "churn_probability": float,
+    "latency_rounds": int,
+    "adversary_strategy": str,
+}
+
+
+def phase_points(grid: Dict) -> List[Dict]:
+    """Validate a phase-grid spec and expand it to the cartesian list
+    of config-override points.
+
+    A grid is ``{axis: [value, ...], ...}`` with axes from
+    `_GRID_AXES`; entries must be numeric (strings only for
+    `adversary_strategy`).  Raises `ValueError` with the offending
+    axis/index — `run_sim --phase-grid` funnels this into
+    `parser.error` (the PR 5 rule: a malformed sweep dies at the
+    parser, never in the worker).
+    """
+    if not isinstance(grid, dict) or not grid:
+        raise ValueError("a phase grid is a non-empty JSON object "
+                         "{axis: [values...]}")
+    axes, levels = [], []
+    for axis, values in grid.items():
+        if axis not in _GRID_AXES:
+            raise ValueError(
+                f"unknown phase-grid axis {axis!r}; sweepable axes: "
+                f"{', '.join(sorted(_GRID_AXES))}")
+        if not isinstance(values, (list, tuple)) or not values:
+            raise ValueError(
+                f"phase-grid axis {axis!r} needs a non-empty list of "
+                f"values, got {values!r}")
+        coerce = _GRID_AXES[axis]
+        coerced = []
+        for i, v in enumerate(values):
+            if coerce is str:
+                if not isinstance(v, str):
+                    raise ValueError(
+                        f"phase-grid {axis}[{i}] must be a strategy "
+                        f"name, got {v!r}")
+                coerced.append(AdversaryStrategy(v).value)
+            else:
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    raise ValueError(
+                        f"phase-grid {axis}[{i}] must be numeric, "
+                        f"got {v!r}")
+                if coerce is int and int(v) != v:
+                    # A truncated 8.5 would silently measure (and
+                    # label) the k=8 point — reject, don't round.
+                    raise ValueError(
+                        f"phase-grid {axis}[{i}] must be an integer, "
+                        f"got {v!r}")
+                coerced.append(coerce(v))
+        axes.append(axis)
+        levels.append(coerced)
+    return [dict(zip(axes, combo))
+            for combo in itertools.product(*levels)]
+
+
+def point_config(base_cfg: AvalancheConfig, point: Dict) -> AvalancheConfig:
+    """`base_cfg` with one phase point's overrides applied (validated by
+    the config's own `__post_init__`)."""
+    overrides = dict(point)
+    if "adversary_strategy" in overrides:
+        overrides["adversary_strategy"] = AdversaryStrategy(
+            overrides["adversary_strategy"])
+    return dataclasses.replace(base_cfg, **overrides)
+
+
+def run_phase_grid(
+    model: str,
+    base_cfg: AvalancheConfig,
+    grid: Dict,
+    fleet: int,
+    n_nodes: int,
+    n_txs: int = 64,
+    n_rounds: int = 100,
+    seed: int = 0,
+    conflict_size: int = 2,
+    yes_fraction: float = 0.5,
+    contested: bool = True,
+    sink=None,
+) -> List[Dict]:
+    """Sweep a phase grid: one `run_fleet` per cartesian point (re-jit
+    per point — the config is jit-static), returning one summary row
+    per point and streaming each to `sink` (an `obs.MetricsSink`) as it
+    lands — the phase-diagram JSONL, each row carrying its `point`,
+    the fleet estimates, and the point config's `tag_from_config` tag.
+    """
+    from go_avalanche_tpu.obs import tag_from_config
+
+    points = phase_points(grid)
+    if (base_cfg.latency_mode == "none"
+            and any("latency_rounds" in p for p in points)):
+        # The knob is inert without a latency mode: the sweep would
+        # emit identical measurements labeled as different points —
+        # the silent-mislabeling class phase_points already rejects
+        # for truncated integers.
+        raise ValueError(
+            "a latency_rounds phase axis needs the base config's "
+            "latency_mode set (it is 'none', under which the knob is "
+            "inert — every point would measure the same program)")
+    rows = []
+    for point in points:
+        cfg = point_config(base_cfg, point)
+        res = run_fleet(model, cfg, fleet, n_nodes, n_txs=n_txs,
+                        n_rounds=n_rounds, seed=seed,
+                        conflict_size=conflict_size,
+                        yes_fraction=yes_fraction, contested=contested)
+        row = {"point": point, **res.summary(),
+               "tag": tag_from_config(cfg)}
+        rows.append(row)
+        if sink is not None:
+            sink.write(row)
+    return rows

@@ -91,6 +91,14 @@ class AvalancheSimState(NamedTuple):
                                  # faults.  None = the synchronous
                                  # ideal, statically absent from the
                                  # trace (flagship hlo_pin unchanged)
+    fault_params: Optional[inflight.FaultParams] = None
+                                 # realized stochastic fault-event
+                                 # parameters (ops/inflight.
+                                 # draw_fault_params), drawn once from
+                                 # the init key — present iff the
+                                 # script schedules stochastic events;
+                                 # None = statically absent (every
+                                 # archived hlo pin unchanged)
 
 
 class SimTelemetry(NamedTuple):
@@ -132,6 +140,18 @@ def contested_init_pref(seed: int, n_nodes: int, n_txs: int) -> jax.Array:
     The key offsets the sim seed so priors and round draws decorrelate.
     """
     return jax.random.bernoulli(jax.random.key(seed + 1), 0.5,
+                                (n_nodes, n_txs))
+
+
+def contested_init_pref_from_key(key: jax.Array, n_nodes: int,
+                                 n_txs: int) -> jax.Array:
+    """`contested_init_pref` from a PRNG KEY instead of a host seed —
+    the vmap-clean spelling the Monte-Carlo fleet driver needs (the
+    per-trial key is a tracer inside the vmapped init, so
+    `jax.random.key(seed + 1)` is unreachable there).  A distinct
+    stream from the seed spelling by design: fleet trials are their own
+    population, not replays of the seed-based studies."""
+    return jax.random.bernoulli(jax.random.fold_in(key, 0xC0), 0.5,
                                 (n_nodes, n_txs))
 
 
@@ -247,6 +267,7 @@ def init(
         key=key,
         inflight=(inflight.init_ring(cfg, n_nodes, n_txs)
                   if inflight.enabled(cfg) else None),
+        fault_params=inflight.draw_fault_params(cfg, key, n_nodes),
     )
 
 
@@ -364,7 +385,7 @@ def round_step(
             lat = inflight.draw_latency(k_sample, cfg, peers,
                                         state.latency_weight, n)
             lat = inflight.apply_faults(lat, cfg, state.round, 0,
-                                        peers, n)
+                                        peers, n, state.fault_params)
             ring = inflight.enqueue(state.inflight, state.round, peers,
                                     lat, responded, lie, polled)
             records, changed, votes_applied = inflight.deliver_multi_engine(
@@ -402,7 +423,8 @@ def round_step(
     # latency planes plus the issue-time fault cut — all statically
     # zero when the in-flight engine / fault script is off.
     rt = inflight.ring_telemetry(ring, cfg, state.round)
-    cut = (inflight.partition_cut(cfg, state.round, 0, peers, n)
+    cut = (inflight.partition_cut(cfg, state.round, 0, peers, n,
+                                  state.fault_params)
            if inflight.enabled(cfg) else None)
     telemetry = SimTelemetry(
         polls=polled.sum().astype(jnp.int32),
@@ -432,6 +454,7 @@ def round_step(
         round=state.round + 1,
         key=k_next,
         inflight=ring,
+        fault_params=state.fault_params,
     )
     return new_state, telemetry
 

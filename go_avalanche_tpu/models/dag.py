@@ -82,6 +82,8 @@ def init(
     init_pref: Optional[jax.Array] = None,
     scores: Optional[jax.Array] = None,
     track_finality: bool = True,
+    n_sets: Optional[int] = None,
+    set_size: Optional[int] = None,
 ) -> DagSimState:
     """Fresh conflicted network.
 
@@ -89,17 +91,61 @@ def init(
     "every node initially prefers the lowest-index tx of each set" (the
     deterministic first-seen stand-in); pass a bool [T] to model nodes
     seeing double-spends in a different global order.
+
+    `n_sets` / `set_size` override the host-side partition inspection
+    (a `device_get` of `conflict_set.max()` plus a numpy compare) with
+    the caller's static knowledge — the vmap-clean path (PR 7 audit):
+    inside a traced fleet trial those host syncs are only legal because
+    `conflict_set` is a closed-over CONSTANT, and a traced partition
+    must pass the statics explicitly.  `set_size` (with `n_sets`)
+    asserts the ``arange(T) // set_size`` fast-path layout; pass
+    `n_sets` alone for an arbitrary partition.
     """
     conflict_set = jnp.asarray(conflict_set, jnp.int32)
     n_txs = conflict_set.shape[0]
-    n_sets = int(jax.device_get(conflict_set.max())) + 1
-    # Fast-path detection: the standard fixed-capacity contiguous partition.
-    set_size = None
-    if n_txs % n_sets == 0:
-        c = n_txs // n_sets
-        if (np.asarray(jax.device_get(conflict_set))
-                == np.arange(n_txs) // c).all():
-            set_size = c
+    if n_sets is None:
+        if set_size is not None:
+            raise ValueError(
+                "set_size override requires n_sets (pass both, or "
+                "neither for host-side detection)")
+        n_sets = int(jax.device_get(conflict_set.max())) + 1
+        # Fast-path detection: the standard fixed-capacity contiguous
+        # partition.
+        set_size = None
+        if n_txs % n_sets == 0:
+            c = n_txs // n_sets
+            if (np.asarray(jax.device_get(conflict_set))
+                    == np.arange(n_txs) // c).all():
+                set_size = c
+    elif set_size is not None:
+        # The override claims the contiguous fast-path layout; check
+        # the static arithmetic always, and the layout itself whenever
+        # the partition is concrete (it is even under the fleet vmap —
+        # conflict_set is a closed-over constant there; only a truly
+        # traced partition is taken on faith).
+        if n_txs % set_size or n_sets != n_txs // set_size:
+            raise ValueError(
+                f"set_size={set_size} with n_sets={n_sets} does not "
+                f"tile {n_txs} txs")
+        if not isinstance(conflict_set, jax.core.Tracer) and not (
+                np.asarray(jax.device_get(conflict_set))
+                == np.arange(n_txs) // set_size).all():
+            raise ValueError(
+                f"set_size={set_size} claims the contiguous "
+                f"arange(T) // set_size layout, but conflict_set is "
+                f"partitioned differently — pass n_sets alone for an "
+                f"arbitrary partition")
+    elif not isinstance(conflict_set, jax.core.Tracer):
+        # n_sets alone: an undercount would make every segment op
+        # (num_segments=n_sets) silently DROP txs in the high sets —
+        # settled/double-commit stats would miss them.  Overcounting
+        # (empty trailing segments) is harmless padding.
+        max_set = int(jax.device_get(conflict_set.max()))
+        if max_set >= n_sets:
+            raise ValueError(
+                f"n_sets={n_sets} undercounts conflict_set (max set "
+                f"id {max_set}) — txs in sets >= {n_sets} would be "
+                f"silently dropped by every segment reduction")
     if init_pref is None:
         first_of_set = jnp.zeros((n_sets,), jnp.int32).at[
             conflict_set[::-1]].set(jnp.arange(n_txs - 1, -1, -1,
@@ -237,7 +283,8 @@ def round_step(
         # start (the synchronous round's own observation convention).
         lat = inflight.draw_latency(k_sample, cfg, peers,
                                     base.latency_weight, n)
-        lat = inflight.apply_faults(lat, cfg, base.round, 0, peers, n)
+        lat = inflight.apply_faults(lat, cfg, base.round, 0, peers, n,
+                                    base.fault_params)
         ring = inflight.enqueue(base.inflight, base.round, peers, lat,
                                 responded, lie, polled)
         records, changed, votes_applied = inflight.deliver_multi_engine(
@@ -267,7 +314,8 @@ def round_step(
     # (statically zero when the in-flight engine is off); the DAG round
     # has no gossip, so the gossip counters stay zero.
     rt = inflight.ring_telemetry(ring, cfg, base.round)
-    cut = (inflight.partition_cut(cfg, base.round, 0, peers, n)
+    cut = (inflight.partition_cut(cfg, base.round, 0, peers, n,
+                                  base.fault_params)
            if inflight.enabled(cfg) else None)
     telemetry = av.SimTelemetry(
         polls=polled.sum().astype(jnp.int32),
@@ -297,6 +345,7 @@ def round_step(
         round=base.round + 1,
         key=k_next,
         inflight=ring,
+        fault_params=base.fault_params,
     )
     return DagSimState(new_base, state.conflict_set, state.n_sets,
                        state.set_size), telemetry

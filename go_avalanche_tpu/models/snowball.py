@@ -45,6 +45,10 @@ class SnowballState(NamedTuple):
     inflight: Optional[inflight.InflightState] = None
                                   # pending-query ring (ops/inflight.py);
                                   # present iff cfg.async_queries()
+    fault_params: Optional[inflight.FaultParams] = None
+                                  # realized stochastic fault parameters
+                                  # (draw_fault_params); present iff the
+                                  # script schedules stochastic events
 
 
 class RoundTelemetry(NamedTuple):
@@ -86,6 +90,7 @@ def init(
         key=k_next,
         inflight=(inflight.init_ring(cfg, n_nodes)
                   if inflight.enabled(cfg) else None),
+        fault_params=inflight.draw_fault_params(cfg, key, n_nodes),
     )
 
 
@@ -128,7 +133,8 @@ def round_step(
         # uniform weights (all-zero latency).
         lat = inflight.draw_latency(k_sample, cfg, peers,
                                     jnp.ones((n,), jnp.float32), n)
-        lat = inflight.apply_faults(lat, cfg, state.round, 0, peers, n)
+        lat = inflight.apply_faults(lat, cfg, state.round, 0, peers, n,
+                                    state.fault_params)
         ring = inflight.enqueue(state.inflight, state.round, peers, lat,
                                 responded, lie, update_mask)
         records, changed = inflight.deliver_1d_engine(ring, state.records, cfg,
@@ -175,7 +181,8 @@ def round_step(
     alive = inflight.apply_churn_bursts(alive, cfg, state.round, k_churn)
 
     rt = inflight.ring_telemetry(ring, cfg, state.round)
-    cut = (inflight.partition_cut(cfg, state.round, 0, peers, n)
+    cut = (inflight.partition_cut(cfg, state.round, 0, peers, n,
+                                  state.fault_params)
            if inflight.enabled(cfg) else None)
     telemetry = RoundTelemetry(
         flips=(changed & jnp.logical_not(newly_final)).sum().astype(jnp.int32),
@@ -197,6 +204,7 @@ def round_step(
         round=state.round + 1,
         key=k_next,
         inflight=ring,
+        fault_params=state.fault_params,
     )
     return new_state, telemetry
 

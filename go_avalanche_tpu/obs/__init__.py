@@ -33,6 +33,7 @@ from go_avalanche_tpu.obs.recovery import (  # noqa: F401
     RecoveryViolation,
     check_recovery,
     verify_recovery,
+    verify_recovery_fleet,
 )
 from go_avalanche_tpu.obs.tags import tag_from_config  # noqa: F401
 from go_avalanche_tpu.obs.watchdog import (  # noqa: F401

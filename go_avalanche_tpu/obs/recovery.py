@@ -92,24 +92,20 @@ def load_trace(path: Union[str, Path]) -> List[Dict]:
 
 
 def merged_cut_windows(cfg: AvalancheConfig) -> List[tuple]:
-    """The script's cut events collapsed into disjoint ``[start, heal)``
-    outage intervals: overlapping or back-to-back cuts (a cascading
-    multi-region failure) recover as ONE composite window — occupancy
-    cannot return to baseline between two cuts that share rounds."""
-    spans = sorted((e[1], e[2]) for e in cfg.cut_events())
-    merged: List[tuple] = []
-    for start, end in spans:
-        if merged and start <= merged[-1][1]:
-            merged[-1] = (merged[-1][0], max(merged[-1][1], end))
-        else:
-            merged.append((start, end))
-    return merged
+    """The script's STATIC cut events collapsed into disjoint
+    ``[start, heal)`` outage intervals (see `_merge_windows`).
+    Stochastic cuts have no static window — callers verifying a
+    stochastic script pass the trial's REALIZED windows explicitly
+    (`verify_recovery(..., windows=...)`, from
+    `fleet.FleetResult.cut_windows`)."""
+    return _merge_windows((e[1], e[2]) for e in cfg.cut_events())
 
 
 def _max_scheduled_latency(cfg: AvalancheConfig) -> Optional[int]:
     """Worst-case deliverable latency any draw can be stamped with
-    (base mode max + the tallest active spike), or None when the mode
-    is unbounded (geometric)."""
+    (base mode max + the tallest active spike — a stochastic spike
+    counts its range's HI, the worst realization), or None when the
+    mode is unbounded (geometric)."""
     if cfg.latency_mode in ("none",):
         base = 0
     elif cfg.latency_mode in ("fixed", "weighted"):
@@ -119,7 +115,23 @@ def _max_scheduled_latency(cfg: AvalancheConfig) -> Optional[int]:
     else:  # geometric: unbounded tail expires on its own
         return None
     spike = max((e[3] for e in cfg.spike_events()), default=0)
+    spike = max(spike, max((e[3][1] for e in cfg.stochastic_spike_events()),
+                           default=0))
     return base + spike
+
+
+def _merge_windows(spans) -> List[tuple]:
+    """Collapse [start, heal) spans into disjoint intervals —
+    overlapping or back-to-back outages recover as one composite
+    window (occupancy cannot return to baseline between cuts that
+    share rounds)."""
+    merged: List[tuple] = []
+    for start, end in sorted((int(s), int(e)) for s, e in spans):
+        if merged and start <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], end))
+        else:
+            merged.append((start, end))
+    return merged
 
 
 def _series(records: Sequence[Dict], field: str) -> List[int]:
@@ -137,6 +149,7 @@ def verify_recovery(
     cfg: AvalancheConfig,
     records: Sequence[Dict],
     occupancy_slack: int = 2,
+    windows: Optional[Sequence] = None,
 ) -> RecoveryReport:
     """Verify the recovery invariants of `cfg`'s fault script against a
     stride-1 per-round trace; returns a `RecoveryReport` (violations
@@ -145,8 +158,28 @@ def verify_recovery(
     `occupancy_slack` widens the occupancy-recovery bound past the
     structural ``timeout_rounds()`` tail (default 2 rounds: scheduling
     jitter from entries issued in the heal round itself).
+
+    `windows` supplies the REALIZED ``[start, heal)`` spans of the
+    script's stochastic cuts — REQUIRED when the script schedules any
+    (their windows are per-trial; the fleet driver returns them as
+    `FleetResult.cut_windows`).  They are MERGED with the script's
+    static cut windows, not a replacement: a mixed static+stochastic
+    script still checks occupancy recovery after every static heal.
     """
     violations: List[str] = []
+    if windows is None:
+        if cfg.stochastic_cut_events():
+            raise ValueError(
+                "this script schedules stochastic_partition events, "
+                "whose windows are realized per trial — pass the "
+                "trial's realized windows explicitly "
+                "(verify_recovery(..., windows=...); the fleet driver "
+                "returns them as FleetResult.cut_windows)")
+        cut_windows = merged_cut_windows(cfg)
+    else:
+        cut_windows = _merge_windows(
+            [(int(s), int(e)) for s, e in windows]
+            + [(e[1], e[2]) for e in cfg.cut_events()])
     records = sorted(records, key=lambda r: r["round"])
     rounds = [int(r["round"]) for r in records]
     n_rounds = len(records)
@@ -182,7 +215,7 @@ def verify_recovery(
 
     # --- 2. occupancy returns to the pre-fault baseline after each heal.
     windows = []
-    for start, heal in merged_cut_windows(cfg):
+    for start, heal in cut_windows:
         if 1 <= start <= n_rounds:
             baseline = occupancy[start - 1]
         else:
@@ -236,17 +269,88 @@ def verify_recovery(
                           windows=windows, totals=totals)
 
 
+def is_fleet_trace(records: Sequence[Dict]) -> bool:
+    """True when the trace is FLEET-STACKED: counter fields carry
+    per-trial LISTS (a leading trial axis) instead of scalars — the
+    format `fleet.fleet_trace_records` emits and a fleet `--metrics`
+    run writes (docs/observability.md)."""
+    for r in records:
+        for field, v in r.items():
+            if field != "round" and isinstance(v, (list, tuple)):
+                return True
+        return False
+    return False
+
+
+def _trial_records(records: Sequence[Dict], trial: int) -> List[Dict]:
+    """Slice one trial's scalar record stream out of a fleet-stacked
+    trace (non-list fields — `round`, `tag` — pass through)."""
+    return [{k: (v[trial] if isinstance(v, (list, tuple)) else v)
+             for k, v in r.items()} for r in records]
+
+
+def verify_recovery_fleet(
+    cfg: AvalancheConfig,
+    records: Sequence[Dict],
+    occupancy_slack: int = 2,
+    windows: Optional[Sequence] = None,
+) -> List[RecoveryReport]:
+    """Per-trial recovery verdicts for a FLEET-STACKED trace: one
+    `RecoveryReport` per trial, in trial order — the verdict VECTOR a
+    Monte-Carlo sweep reduces to P(recovery) with a Wilson CI
+    (`fleet.wilson_interval`).
+
+    `windows`, when given, is PER-TRIAL: ``windows[i]`` holds trial i's
+    realized ``[start, heal)`` spans (`fleet.FleetResult.cut_windows`
+    is exactly this shape) — required for stochastic scripts, whose
+    realized schedules differ per trial.  Mixed-width records (a trial
+    axis that changes length mid-trace) raise `ValueError`.
+    """
+    records = sorted(records, key=lambda r: r["round"])
+    widths = {len(v) for r in records for v in r.values()
+              if isinstance(v, (list, tuple))}
+    if len(widths) != 1:
+        raise ValueError(
+            f"a fleet-stacked trace carries ONE trial-axis width on "
+            f"every counter field; got widths {sorted(widths)}")
+    fleet = widths.pop()
+    if windows is not None and len(windows) != fleet:
+        raise ValueError(
+            f"per-trial windows ({len(windows)}) must match the "
+            f"trace's trial axis ({fleet})")
+    return [verify_recovery(cfg, _trial_records(records, i),
+                            occupancy_slack=occupancy_slack,
+                            windows=None if windows is None
+                            else windows[i])
+            for i in range(fleet)]
+
+
 def check_recovery(
     cfg: AvalancheConfig,
     trace: Union[str, Path, Sequence[Dict]],
     occupancy_slack: int = 2,
-) -> RecoveryReport:
+    windows: Optional[Sequence] = None,
+) -> Union[RecoveryReport, List[RecoveryReport]]:
     """`verify_recovery` that LOADS a JSONL path (or takes records) and
     RAISES `RecoveryViolation` listing every failed invariant; returns
-    the passing report otherwise."""
+    the passing report otherwise.
+
+    A FLEET-STACKED trace (per-trial list values — `is_fleet_trace`)
+    returns the per-trial verdict VECTOR (`verify_recovery_fleet`)
+    WITHOUT raising: a Monte-Carlo sweep's product is the fraction of
+    trials that recovered, not a first-shape-mismatch exception —
+    callers reduce ``[r.ok for r in reports]`` to P(recovery) ± CI.
+    `windows` follows the selected mode's contract (scalar spans, or
+    per-trial spans for a fleet trace).
+    """
     if isinstance(trace, (str, Path)):
         trace = load_trace(trace)
-    report = verify_recovery(cfg, trace, occupancy_slack=occupancy_slack)
+    if is_fleet_trace(trace):
+        return verify_recovery_fleet(cfg, trace,
+                                     occupancy_slack=occupancy_slack,
+                                     windows=windows)
+    report = verify_recovery(cfg, trace, occupancy_slack=occupancy_slack,
+                             windows=windows)
     if not report.ok:
         raise RecoveryViolation(
             "recovery invariants violated:\n  "

@@ -116,6 +116,101 @@ from go_avalanche_tpu.ops.bitops import (
 # trajectories are pinned bit-exact against the synchronous round).
 _LAT_FOLD = 0x1A7E
 
+# fold_in constant deriving the stochastic fault-parameter stream from
+# the sim's INIT key (`draw_fault_params`): realized schedules must be a
+# pure function of (config, init key) — one draw per sim, constant
+# across rounds, never perturbing the per-round streams.
+_FAULT_PARAM_FOLD = 0x57CA
+
+
+class FaultParams(NamedTuple):
+    """Realized parameters of the config's STOCHASTIC fault events
+    (`cfg.stochastic_events()`), drawn once per sim by
+    `draw_fault_params` from the init key and carried in the sim state
+    (`state.fault_params`; None — statically absent — when the script
+    schedules no stochastic events, so every archived hlo pin is
+    untouched).
+
+    Event STRUCTURE stays jit-static: the arrays below are indexed by
+    the script's stochastic-event order with static lengths, so each
+    realized event still compiles to one mask AND'd with a (now traced)
+    round-range test — a different realization per fleet trial under
+    `vmap`, one compiled program for all of them.
+    """
+
+    cut_start: jax.Array    # int32 [Ec] — stochastic_partition starts
+    cut_end: jax.Array      # int32 [Ec] — end-exclusive heals
+    cut_split: jax.Array    # int32 [Ec] — realized node-split index
+                            #   (cluster-aligned when n_clusters > 1)
+    spike_start: jax.Array  # int32 [Es] — stochastic_spike starts
+    spike_end: jax.Array    # int32 [Es]
+    spike_extra: jax.Array  # int32 [Es] — realized extra rounds
+
+
+def _stochastic_split(cfg: AvalancheConfig, n_global: int,
+                      frac: jax.Array) -> jax.Array:
+    """Traced twin of `_partition_split`: node-split index of a realized
+    partition fraction — same floor(x+0.5) cluster snap, same interior
+    clamps, on a traced `frac` scalar."""
+    if cfg.n_clusters > 1:
+        c = jnp.clip(jnp.floor(frac * cfg.n_clusters + 0.5)
+                     .astype(jnp.int32), 1, cfg.n_clusters - 1)
+        return (c * n_global + cfg.n_clusters - 1) // cfg.n_clusters
+    return jnp.clip(jnp.floor(frac * n_global).astype(jnp.int32),
+                    1, n_global - 1)
+
+
+def draw_fault_params(cfg: AvalancheConfig, key: jax.Array,
+                      n_global: int) -> Optional[FaultParams]:
+    """Realize the config's stochastic fault events from the sim's init
+    key; None (statically) when the script schedules none.
+
+    Per event (the `cfg.stochastic_events()` order), from an
+    independent fold of `key`: start ~ U{lo..hi}, length ~ U{lo..hi}
+    (end = start + length, end-exclusive), and the kind's parameter —
+    frac ~ U(lo, hi) resolved to a cluster-aligned split index
+    (`_stochastic_split`), or extra_rounds ~ U{lo..hi}.  Deterministic:
+    the same (config, key) always realizes the same schedule, dense or
+    sharded (the sharded drivers carry the SAME replicated params the
+    dense init drew).
+    """
+    events = cfg.stochastic_events()
+    if not events:
+        return None
+    key = jax.random.fold_in(key, _FAULT_PARAM_FOLD)
+    cut = {"start": [], "end": [], "split": []}
+    spike = {"start": [], "end": [], "extra": []}
+    for i, ev in enumerate(events):
+        ks, kl, kp = jax.random.split(jax.random.fold_in(key, i), 3)
+        (slo, shi), (llo, lhi) = ev[1], ev[2]
+        start = jax.random.randint(ks, (), int(slo), int(shi) + 1,
+                                   dtype=jnp.int32)
+        length = jax.random.randint(kl, (), int(llo), int(lhi) + 1,
+                                    dtype=jnp.int32)
+        if ev[0] == "stochastic_partition":
+            flo, fhi = ev[3]
+            frac = jax.random.uniform(kp, (), minval=float(flo),
+                                      maxval=float(fhi))
+            cut["start"].append(start)
+            cut["end"].append(start + length)
+            cut["split"].append(_stochastic_split(cfg, n_global, frac))
+        else:                                   # stochastic_spike
+            elo, ehi = ev[3]
+            spike["start"].append(start)
+            spike["end"].append(start + length)
+            spike["extra"].append(jax.random.randint(
+                kp, (), int(elo), int(ehi) + 1, dtype=jnp.int32))
+
+    def stack(xs):
+        return jnp.stack(xs) if xs else jnp.zeros((0,), jnp.int32)
+
+    return FaultParams(cut_start=stack(cut["start"]),
+                       cut_end=stack(cut["end"]),
+                       cut_split=stack(cut["split"]),
+                       spike_start=stack(spike["start"]),
+                       spike_end=stack(spike["end"]),
+                       spike_extra=stack(spike["extra"]))
+
 
 class InflightState(NamedTuple):
     """Ring buffer of pending queries; a pytree of ``[D, rows, ...]``
@@ -334,6 +429,7 @@ def partition_cut(
     row_offset,
     peers: jax.Array,
     n_global: int,
+    fault_params: Optional[FaultParams] = None,
 ) -> Optional[jax.Array]:
     """Bool ``[rows, k]`` — draws severed by any active CUT event this
     round; None (statically) when the merged fault script
@@ -354,12 +450,20 @@ def partition_cut(
         own partition): traffic into or out of the region is severed,
         intra-region and outside traffic unaffected.
 
+    STOCHASTIC partitions (`cfg.stochastic_cut_events()`) compose the
+    same way from the REALIZED `fault_params` the init key drew
+    (`draw_fault_params`): the window test compares `round_` against
+    traced start/end scalars and the split index is the realized one,
+    so the compiled structure is identical to a static event's — one
+    mask per event — while each trial's realization differs.
+
     The mask `apply_faults` stamps with the timeout sentinel, exposed on
     its own so the round's telemetry can count fault-blocked queries
     from the same plane (XLA CSEs the shared computation).
     """
     events = cfg.cut_events()
-    if not events:
+    n_sto = len(cfg.stochastic_cut_events())
+    if not events and not n_sto:
         return None
     rows = peers.shape[0]
     qids = (jnp.arange(rows, dtype=jnp.int32)
@@ -377,6 +481,19 @@ def partition_cut(
             pside = _cluster_of(peers, cfg.n_clusters,
                                 n_global) == region
         cut = cut | (active & (qside[:, None] != pside))
+    if n_sto:
+        if fault_params is None:
+            raise ValueError(
+                "stochastic_partition events need the realized "
+                "FaultParams drawn at init (state.fault_params) — the "
+                "caller must thread it through (every model round "
+                "does)")
+        for i in range(n_sto):
+            active = ((round_ >= fault_params.cut_start[i])
+                      & (round_ < fault_params.cut_end[i]))
+            split = fault_params.cut_split[i]
+            cut = cut | (active & ((qids < split)[:, None]
+                                   != (peers < split)))
     return cut
 
 
@@ -384,10 +501,15 @@ def apply_latency_spikes(
     lat: jax.Array,
     cfg: AvalancheConfig,
     round_: jax.Array,
+    fault_params: Optional[FaultParams] = None,
 ) -> jax.Array:
     """Add every active latency_spike event's extra rounds to this
     round's ISSUE-time latency draws (entries already in flight keep
     their stamped latency — a spike delays queries issued during it).
+
+    Stochastic spikes (`cfg.stochastic_spike_events()`) add their
+    REALIZED extra from `fault_params` under the realized (traced)
+    window test — same additive composition.
 
     Clipped back to ``[0, timeout_rounds()]``: a spiked latency reaching
     the timeout becomes the never-delivers sentinel, so a spike taller
@@ -396,13 +518,25 @@ def apply_latency_spikes(
     with no spike events.
     """
     events = cfg.spike_events()
-    if not events:
+    n_sto = len(cfg.stochastic_spike_events())
+    if not events and not n_sto:
         return lat
     extra = jnp.int32(0)
     for _, start, end, rounds_ in events:
         active = (round_ >= start) & (round_ < end)
         extra = extra + jnp.where(active, jnp.int32(rounds_),
                                   jnp.int32(0))
+    if n_sto:
+        if fault_params is None:
+            raise ValueError(
+                "stochastic_spike events need the realized FaultParams "
+                "drawn at init (state.fault_params) — the caller must "
+                "thread it through (every model round does)")
+        for i in range(n_sto):
+            active = ((round_ >= fault_params.spike_start[i])
+                      & (round_ < fault_params.spike_end[i]))
+            extra = extra + jnp.where(active, fault_params.spike_extra[i],
+                                      jnp.int32(0))
     return jnp.clip(lat + extra, 0, cfg.timeout_rounds())
 
 
@@ -413,9 +547,13 @@ def apply_faults(
     row_offset,
     peers: jax.Array,
     n_global: int,
+    fault_params: Optional[FaultParams] = None,
 ) -> jax.Array:
     """The fault-script engine's issue-time pass: latency spikes, then
-    cut events (partitions / regional outages).
+    cut events (partitions / regional outages) — static events from the
+    script, stochastic ones from the realized `fault_params` the init
+    key drew (`draw_fault_params`; every model carries them as
+    `state.fault_params`).
 
     A draw severed by an active cut never delivers — its latency becomes
     the timeout sentinel, so it EXPIRES unanswered at age
@@ -424,8 +562,9 @@ def apply_faults(
     timeout.  With an empty merged script both passes are statically
     absent and `lat` flows through untouched (pins unchanged).
     """
-    lat = apply_latency_spikes(lat, cfg, round_)
-    cut = partition_cut(cfg, round_, row_offset, peers, n_global)
+    lat = apply_latency_spikes(lat, cfg, round_, fault_params)
+    cut = partition_cut(cfg, round_, row_offset, peers, n_global,
+                        fault_params)
     if cut is None:
         return lat
     return jnp.where(cut, jnp.int32(cfg.timeout_rounds()), lat)
@@ -817,7 +956,7 @@ def _static_single_age(cfg: AvalancheConfig):
     `latency_mode` — which is also the only way production reaches
     such a state (tests/test_inflight.py collision parity).
     """
-    if cfg.cut_events() or cfg.spike_events():
+    if cfg.cut_events() or cfg.spike_events() or cfg.stochastic_events():
         return None
     if cfg.latency_mode == "fixed":
         return min(cfg.latency_rounds, cfg.timeout_rounds())

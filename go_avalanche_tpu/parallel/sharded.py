@@ -59,7 +59,8 @@ from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS, shard_map
 
 
 def state_specs(track_finality: bool = True,
-                with_inflight: bool = False) -> AvalancheSimState:
+                with_inflight: bool = False,
+                with_fault_params: bool = False) -> AvalancheSimState:
     """PartitionSpecs for every leaf of `AvalancheSimState`.
 
     `track_finality=False` mirrors a state whose `finalized_at` leaf is
@@ -68,6 +69,10 @@ def state_specs(track_finality: bool = True,
     adds specs for the async-query ring buffer (`ops/inflight.py`): the
     per-draw planes shard with the node rows (leading ring-depth axis
     replicated), the poll-mask plane with both axes.
+    `with_fault_params=True` mirrors a state carrying realized
+    stochastic fault parameters (`inflight.FaultParams`) — tiny
+    per-event scalars, replicated everywhere so every shard sees the
+    SAME realized schedule the dense init drew.
     """
     inflight_specs = None
     if with_inflight:
@@ -78,6 +83,10 @@ def state_specs(track_finality: bool = True,
             lie=P(None, NODES_AXIS, None),
             polled=P(None, NODES_AXIS, TXS_AXIS),
         )
+    fault_specs = None
+    if with_fault_params:
+        fault_specs = inflight.FaultParams(
+            *([P()] * len(inflight.FaultParams._fields)))
     return AvalancheSimState(
         records=vr.VoteRecordState(
             votes=P(NODES_AXIS, TXS_AXIS),
@@ -96,6 +105,7 @@ def state_specs(track_finality: bool = True,
         round=P(),
         key=P(),
         inflight=inflight_specs,
+        fault_params=fault_specs,
     )
 
 
@@ -116,7 +126,8 @@ def shard_state(state: AvalancheSimState, mesh) -> AvalancheSimState:
     return jax.tree.map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
         state, state_specs(state.finalized_at is not None,
-                           state.inflight is not None))
+                           state.inflight is not None,
+                           state.fault_params is not None))
 
 
 def _global_minority_plane(prefs_local: jax.Array,
@@ -335,7 +346,7 @@ def _local_round(
                                     state.latency_weight, n_global,
                                     row_offset=offset)
         lat = inflight.apply_faults(lat, cfg, state.round, offset,
-                                    peers, n_global)
+                                    peers, n_global, state.fault_params)
         ring = inflight.enqueue(state.inflight, state.round, peers, lat,
                                 responded, lie, polled)
         records, changed, votes_applied = inflight.deliver_multi_engine(
@@ -407,7 +418,7 @@ def _local_round(
         ring_tel = (_nodes_sum(rt.deliveries), _nodes_sum(rt.expiries),
                     _nodes_sum(rt.occupancy))
     cut = (inflight.partition_cut(cfg, state.round, offset, peers,
-                                  n_global)
+                                  n_global, state.fault_params)
            if inflight.enabled(cfg) else None)
     telemetry = SimTelemetry(
         polls=_global_sum(polled.sum()),
@@ -435,6 +446,7 @@ def _local_round(
         round=state.round + 1,
         key=k_next,
         inflight=ring,
+        fault_params=state.fault_params,
     )
     return new_state, telemetry
 
@@ -447,8 +459,9 @@ def _donate(donate: bool) -> tuple:
 
 
 def _shard_mapped(mesh, fn, track_finality: bool = True,
-                  with_inflight: bool = False):
-    specs = state_specs(track_finality, with_inflight)
+                  with_inflight: bool = False,
+                  with_fault_params: bool = False):
+    specs = state_specs(track_finality, with_inflight, with_fault_params)
     tel_specs = SimTelemetry(*([P()] * len(SimTelemetry._fields)))
     return shard_map(fn, mesh=mesh, in_specs=(specs,),
                      out_specs=(specs, tel_specs), check_vma=False)
@@ -469,12 +482,15 @@ def make_sharded_round_step(mesh, cfg: AvalancheConfig = DEFAULT_CONFIG,
         n_global = state.records.votes.shape[0]
         track = state.finalized_at is not None
         asyncq = state.inflight is not None
-        if (n_global, track, asyncq) not in cache:
-            cache[(n_global, track, asyncq)] = jax.jit(_shard_mapped(
-                mesh, lambda s: _local_round(s, cfg, n_global, n_tx),
-                track_finality=track, with_inflight=asyncq),
+        fparams = state.fault_params is not None
+        if (n_global, track, asyncq, fparams) not in cache:
+            cache[(n_global, track, asyncq, fparams)] = jax.jit(
+                _shard_mapped(
+                    mesh, lambda s: _local_round(s, cfg, n_global, n_tx),
+                    track_finality=track, with_inflight=asyncq,
+                    with_fault_params=fparams),
                 donate_argnums=_donate(donate))
-        return cache[(n_global, track, asyncq)](state)
+        return cache[(n_global, track, asyncq, fparams)](state)
 
     return step
 
@@ -499,7 +515,8 @@ def run_scan_sharded(
     return jax.jit(_shard_mapped(
         mesh, local_scan,
         track_finality=state.finalized_at is not None,
-        with_inflight=state.inflight is not None),
+        with_inflight=state.inflight is not None,
+        with_fault_params=state.fault_params is not None),
         donate_argnums=_donate(donate))(state)
 
 
@@ -539,7 +556,8 @@ def run_sharded(
         return final
 
     specs = state_specs(state.finalized_at is not None,
-                        state.inflight is not None)
+                        state.inflight is not None,
+                        state.fault_params is not None)
     fn = shard_map(local_run, mesh=mesh, in_specs=(specs,),
                    out_specs=specs, check_vma=False)
     return jax.jit(fn, donate_argnums=_donate(donate))(state)

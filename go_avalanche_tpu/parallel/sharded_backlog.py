@@ -51,10 +51,12 @@ from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS, shard_map
 
 
 def backlog_state_specs(track_finality: bool = True,
-                        with_inflight: bool = False) -> BacklogSimState:
+                        with_inflight: bool = False,
+                        with_fault_params: bool = False) -> BacklogSimState:
     """PartitionSpecs for every leaf of `BacklogSimState`."""
     return BacklogSimState(
-        sim=sharded.state_specs(track_finality, with_inflight),
+        sim=sharded.state_specs(track_finality, with_inflight,
+                                with_fault_params),
         slot_tx=P(TXS_AXIS),
         slot_admit_round=P(TXS_AXIS),
         backlog=Backlog(score=P(), init_pref=P(), valid=P()),
@@ -73,7 +75,8 @@ def shard_backlog_state(state: BacklogSimState, mesh) -> BacklogSimState:
     return jax.tree.map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
         state, backlog_state_specs(state.sim.finalized_at is not None,
-                                   state.sim.inflight is not None))
+                                   state.sim.inflight is not None,
+                                   state.sim.fault_params is not None))
 
 
 def _merge_write(old, idx, value, b):
@@ -232,8 +235,10 @@ def _local_step(
 
 
 def _shard_mapped(mesh, fn, with_tel=True, track_finality: bool = True,
-                  with_inflight: bool = False):
-    specs = backlog_state_specs(track_finality, with_inflight)
+                  with_inflight: bool = False,
+                  with_fault_params: bool = False):
+    specs = backlog_state_specs(track_finality, with_inflight,
+                                with_fault_params)
     if with_tel:
         tel_specs = BacklogTelemetry(
             round=av.SimTelemetry(
@@ -257,12 +262,15 @@ def make_sharded_backlog_step(mesh, cfg: AvalancheConfig = DEFAULT_CONFIG,
         n_global = state.sim.records.votes.shape[0]
         track = state.sim.finalized_at is not None
         asyncq = state.sim.inflight is not None
-        if (n_global, track, asyncq) not in cache:
-            cache[(n_global, track, asyncq)] = jax.jit(_shard_mapped(
-                mesh, lambda s: _local_step(s, cfg, n_global, n_tx),
-                track_finality=track, with_inflight=asyncq),
+        fparams = state.sim.fault_params is not None
+        if (n_global, track, asyncq, fparams) not in cache:
+            cache[(n_global, track, asyncq, fparams)] = jax.jit(
+                _shard_mapped(
+                    mesh, lambda s: _local_step(s, cfg, n_global, n_tx),
+                    track_finality=track, with_inflight=asyncq,
+                    with_fault_params=fparams),
                 donate_argnums=sharded._donate(donate))
-        return cache[(n_global, track, asyncq)](state)
+        return cache[(n_global, track, asyncq, fparams)](state)
 
     return step
 
@@ -287,7 +295,8 @@ def run_scan_sharded_backlog(
     return jax.jit(_shard_mapped(
         mesh, local_scan,
         track_finality=state.sim.finalized_at is not None,
-        with_inflight=state.sim.inflight is not None),
+        with_inflight=state.sim.inflight is not None,
+        with_fault_params=state.sim.fault_params is not None),
         donate_argnums=sharded._donate(donate))(state)
 
 
@@ -330,5 +339,6 @@ def run_sharded_backlog(
     return jax.jit(_shard_mapped(
         mesh, local_run, with_tel=False,
         track_finality=state.sim.finalized_at is not None,
-        with_inflight=state.sim.inflight is not None),
+        with_inflight=state.sim.inflight is not None,
+        with_fault_params=state.sim.fault_params is not None),
         donate_argnums=sharded._donate(donate))(state)

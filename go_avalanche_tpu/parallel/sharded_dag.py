@@ -51,17 +51,21 @@ from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS, shard_map
 def dag_state_specs(n_sets: int,
                     set_size: Optional[int] = None,
                     track_finality: bool = True,
-                    with_inflight: bool = False) -> DagSimState:
+                    with_inflight: bool = False,
+                    with_fault_params: bool = False) -> DagSimState:
     """PartitionSpecs for every leaf of `DagSimState`.
 
     `n_sets` and `set_size` ride along as the pytree's static aux data so
     the spec tree and the value tree unflatten identically;
     `track_finality=False` mirrors a base state whose `finalized_at` leaf
     is None (`models/avalanche.init`); `with_inflight=True` adds the
-    async-query ring specs (`sharded.state_specs`).
+    async-query ring specs (`sharded.state_specs`);
+    `with_fault_params=True` mirrors realized stochastic fault
+    parameters (replicated scalars).
     """
     return DagSimState(base=sharded.state_specs(track_finality,
-                                                with_inflight),
+                                                with_inflight,
+                                                with_fault_params),
                        conflict_set=P(TXS_AXIS), n_sets=n_sets,
                        set_size=set_size)
 
@@ -96,7 +100,8 @@ def shard_dag_state(state: DagSimState, mesh) -> DagSimState:
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
         state, dag_state_specs(state.n_sets, state.set_size,
                                state.base.finalized_at is not None,
-                               state.base.inflight is not None))
+                               state.base.inflight is not None,
+                               state.base.fault_params is not None))
 
 
 def _local_sets(conflict_set_local: jax.Array) -> jax.Array:
@@ -198,7 +203,7 @@ def _local_round(
                                     base.latency_weight, n_global,
                                     row_offset=offset)
         lat = inflight.apply_faults(lat, cfg, base.round, offset,
-                                    peers, n_global)
+                                    peers, n_global, base.fault_params)
         ring = inflight.enqueue(base.inflight, base.round, peers, lat,
                                 responded, lie, polled)
         records, changed, votes_applied = inflight.deliver_multi_engine(
@@ -250,7 +255,7 @@ def _local_round(
         ring_tel = (_nodes_sum(rt.deliveries), _nodes_sum(rt.expiries),
                     _nodes_sum(rt.occupancy))
     cut = (inflight.partition_cut(cfg, base.round, offset, peers,
-                                  n_global)
+                                  n_global, base.fault_params)
            if inflight.enabled(cfg) else None)
     telemetry = av.SimTelemetry(
         polls=_global_sum(polled.sum()),
@@ -270,7 +275,7 @@ def _local_round(
         poll_order_inv=base.poll_order_inv, byzantine=base.byzantine,
         alive=alive, latency_weight=base.latency_weight,
         finalized_at=finalized_at, round=base.round + 1, key=k_next,
-        inflight=ring)
+        inflight=ring, fault_params=base.fault_params)
     return DagSimState(new_base, state.conflict_set, state.n_sets,
                        state.set_size), telemetry
 
@@ -278,9 +283,10 @@ def _local_round(
 def _shard_mapped(mesh, n_sets: int, fn, tel: bool = True,
                   set_size: Optional[int] = None,
                   track_finality: bool = True,
-                  with_inflight: bool = False):
+                  with_inflight: bool = False,
+                  with_fault_params: bool = False):
     specs = dag_state_specs(n_sets, set_size, track_finality,
-                            with_inflight)
+                            with_inflight, with_fault_params)
     if tel:
         tel_specs = av.SimTelemetry(*([P()] * len(av.SimTelemetry._fields)))
         out_specs = (specs, tel_specs)
@@ -302,14 +308,15 @@ def make_sharded_dag_round_step(mesh, cfg: AvalancheConfig = DEFAULT_CONFIG,
     def step(state: DagSimState):
         key = (state.base.records.votes.shape[0], state.n_sets,
                state.set_size, state.base.finalized_at is not None,
-               state.base.inflight is not None)
+               state.base.inflight is not None,
+               state.base.fault_params is not None)
         if key not in cache:
             n_global = key[0]
             cache[key] = jax.jit(_shard_mapped(
                 mesh, state.n_sets,
                 lambda s: _local_round(s, cfg, n_global, n_tx),
                 set_size=state.set_size, track_finality=key[3],
-                with_inflight=key[4]),
+                with_inflight=key[4], with_fault_params=key[5]),
                 donate_argnums=sharded._donate(donate))
         return cache[key](state)
 
@@ -365,5 +372,7 @@ def run_sharded_dag(
     fn = _shard_mapped(mesh, state.n_sets, local_run, tel=False,
                        set_size=state.set_size,
                        track_finality=state.base.finalized_at is not None,
-                       with_inflight=state.base.inflight is not None)
+                       with_inflight=state.base.inflight is not None,
+                       with_fault_params=(state.base.fault_params
+                                          is not None))
     return jax.jit(fn, donate_argnums=sharded._donate(donate))(state)

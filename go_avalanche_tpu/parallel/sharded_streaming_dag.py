@@ -62,11 +62,12 @@ def streaming_dag_state_specs(n_sets: int,
                               set_size=None,
                               track_finality: bool = True,
                               with_inflight: bool = False,
+                              with_fault_params: bool = False,
                               ) -> StreamingDagState:
     """PartitionSpecs for every leaf of `StreamingDagState`."""
     return StreamingDagState(
         dag=sharded_dag.dag_state_specs(n_sets, set_size, track_finality,
-                                        with_inflight),
+                                        with_inflight, with_fault_params),
         slot_set=P(TXS_AXIS),
         slot_admit_round=P(TXS_AXIS),
         backlog=SetBacklog(score=P(), init_pref=P(), valid=P()),
@@ -102,7 +103,8 @@ def shard_streaming_dag_state(state: StreamingDagState,
         state, streaming_dag_state_specs(
             state.dag.n_sets, state.dag.set_size,
             state.dag.base.finalized_at is not None,
-            state.dag.base.inflight is not None))
+            state.dag.base.inflight is not None,
+            state.dag.base.fault_params is not None))
 
 
 def _merge_rows(old, row_idx, rows, s_b):
@@ -347,9 +349,10 @@ def _local_step(
 
 def _shard_mapped(mesh, n_sets: int, fn, with_tel=True, set_size=None,
                   track_finality: bool = True,
-                  with_inflight: bool = False):
+                  with_inflight: bool = False,
+                  with_fault_params: bool = False):
     specs = streaming_dag_state_specs(n_sets, set_size, track_finality,
-                                      with_inflight)
+                                      with_inflight, with_fault_params)
     if with_tel:
         tel_specs = StreamingDagTelemetry(
             round=av.SimTelemetry(*([P()] * len(av.SimTelemetry._fields))),
@@ -374,14 +377,15 @@ def make_sharded_streaming_dag_step(mesh,
         key = (state.dag.base.records.votes.shape[0], state.dag.n_sets, c,
                state.dag.set_size,
                state.dag.base.finalized_at is not None,
-               state.dag.base.inflight is not None)
+               state.dag.base.inflight is not None,
+               state.dag.base.fault_params is not None)
         if key not in cache:
             n_global = key[0]
             cache[key] = jax.jit(_shard_mapped(
                 mesh, state.dag.n_sets,
                 lambda s: _local_step(s, cfg, c, n_global, n_tx),
                 set_size=state.dag.set_size, track_finality=key[4],
-                with_inflight=key[5]),
+                with_inflight=key[5], with_fault_params=key[6]),
                 donate_argnums=sharded._donate(donate))
         return cache[key](state)
 
@@ -429,7 +433,9 @@ def run_sharded_streaming_dag(
                        set_size=state.dag.set_size,
                        track_finality=state.dag.base.finalized_at
                        is not None,
-                       with_inflight=state.dag.base.inflight is not None)
+                       with_inflight=state.dag.base.inflight is not None,
+                       with_fault_params=(state.dag.base.fault_params
+                                          is not None))
     return jax.jit(fn, donate_argnums=sharded._donate(donate))(state)
 
 
@@ -454,5 +460,6 @@ def run_scan_sharded_streaming_dag(
     return jax.jit(_shard_mapped(
         mesh, state.dag.n_sets, local_scan, set_size=state.dag.set_size,
         track_finality=state.dag.base.finalized_at is not None,
-        with_inflight=state.dag.base.inflight is not None),
+        with_inflight=state.dag.base.inflight is not None,
+        with_fault_params=state.dag.base.fault_params is not None),
         donate_argnums=sharded._donate(donate))(state)

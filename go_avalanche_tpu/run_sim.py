@@ -402,6 +402,35 @@ def run_backlog(args, cfg: AvalancheConfig) -> Dict:
     }
 
 
+def run_fleet_mode(args, cfg: AvalancheConfig) -> Dict:
+    """`--fleet` driver: one vmapped Monte-Carlo fleet per config point
+    (go_avalanche_tpu/fleet.py), Wilson-CI estimates out; with
+    `--phase-grid`, one fleet per cartesian point.  Phase rows stream
+    to the active `--metrics` sink as phase-diagram JSONL
+    (docs/observability.md)."""
+    from go_avalanche_tpu import fleet as fl
+    from go_avalanche_tpu import obs
+    from go_avalanche_tpu.obs.sink import active_sink
+
+    sink = active_sink()
+    common = dict(fleet=args.fleet, n_nodes=args.nodes, n_txs=args.txs,
+                  n_rounds=args.max_rounds, seed=args.seed,
+                  conflict_size=args.conflict_size,
+                  yes_fraction=args.yes_fraction,
+                  contested=args.contested)
+    if args.phase_grid_parsed is not None:
+        rows = fl.run_phase_grid(args.model, cfg,
+                                 args.phase_grid_parsed, sink=sink,
+                                 **common)
+        return {"fleet": args.fleet, "phase_points": len(rows),
+                "grid_rows": rows}
+    res = fl.run_fleet(args.model, cfg, **common)
+    row = res.summary()
+    if sink is not None:
+        sink.write({**row, "point": {}, "tag": obs.tag_from_config(cfg)})
+    return row
+
+
 def main(argv=None) -> Dict:
     parser = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -530,6 +559,33 @@ def main(argv=None) -> Dict:
                              "of a window-shifting neutral (see RESULTS.md "
                              "churn study; linear vs ~a^7 availability "
                              "cost)")
+    parser.add_argument("--fleet", type=int, default=None, metavar="F",
+                        help="Monte-Carlo fleet mode (go_avalanche_tpu/"
+                             "fleet.py): vmap F whole sims — init, "
+                             "--max-rounds rounds, in-graph safety/"
+                             "finality reduction — over a batched seed "
+                             "axis as ONE compiled program, and report "
+                             "P(safety violation) / P(settled) / "
+                             "E(finality round) with Wilson confidence "
+                             "intervals.  Models: snowball, avalanche, "
+                             "dag.  With --metrics, streams phase-"
+                             "diagram JSONL rows (one per config "
+                             "point) instead of per-round telemetry")
+    parser.add_argument("--phase-grid", type=str, default=None,
+                        metavar="JSON",
+                        help="with --fleet: sweep a config-axis grid — "
+                             "inline JSON or a path to a JSON file, "
+                             "e.g. '{\"byzantine_fraction\": [0.0, 0.2, "
+                             "0.4], \"k\": [8, 16]}' — one fleet per "
+                             "cartesian point (re-jit per point), one "
+                             "summary row each.  Sweepable axes: k, "
+                             "quorum, window, alpha, finalization_"
+                             "score, byzantine_fraction, flip_"
+                             "probability, drop_probability, churn_"
+                             "probability, latency_rounds, adversary_"
+                             "strategy.  Malformed grids (non-numeric "
+                             "entries, unknown axes) are rejected HERE "
+                             "at the parser")
     parser.add_argument("--mesh", type=str, default=None, metavar="N,T",
                         help="run the sharded backend over an "
                              "(n node shards, t tx shards) device mesh "
@@ -621,6 +677,51 @@ def main(argv=None) -> Dict:
                              "refilled columns)")
     args = parser.parse_args(argv)
 
+    # Fleet-mode validation: everything parser-level (the PR 5 rule).
+    args.phase_grid_parsed = None
+    if args.fleet is not None:
+        if args.fleet < 1:
+            parser.error(f"--fleet must be >= 1 trials, got {args.fleet}")
+        if args.model not in ("snowball", "avalanche", "dag"):
+            parser.error(f"--fleet supports models snowball/avalanche/"
+                         f"dag, not {args.model}")
+        if args.mesh:
+            parser.error("--fleet batches whole sims in-graph; compose "
+                         "with --mesh is a ROADMAP item (fleet-of-"
+                         "sharded-sims)")
+        if args.check_invariants:
+            parser.error("--check-invariants steps ONE sim on the host; "
+                         "it has no per-trial identity under --fleet")
+        if args.model == "dag" and args.txs % args.conflict_size:
+            parser.error(f"--fleet dag needs --txs ({args.txs}) divisible "
+                         f"by --conflict-size ({args.conflict_size})")
+    if args.phase_grid is not None:
+        import os
+
+        if args.fleet is None:
+            parser.error("--phase-grid requires --fleet (a grid point "
+                         "IS a fleet)")
+        from go_avalanche_tpu.fleet import phase_points
+
+        try:
+            if os.path.exists(args.phase_grid):
+                with open(args.phase_grid) as fh:
+                    grid = json.load(fh)
+            else:
+                grid = json.loads(args.phase_grid)
+        except (OSError, json.JSONDecodeError) as e:
+            parser.error(f"--phase-grid: {e}")
+        try:
+            phase_points(grid)   # full validation; points re-expand later
+        except (ValueError, TypeError) as e:
+            parser.error(f"--phase-grid: {e}")
+        if "latency_rounds" in grid and args.latency_mode == "none":
+            parser.error("--phase-grid sweeps latency_rounds but "
+                         "--latency-mode is 'none', under which the "
+                         "knob is inert — every point would measure "
+                         "the same program")
+        args.phase_grid_parsed = grid
+
     if args.mesh and args.model not in ("avalanche", "dag", "backlog",
                                         "streaming_dag"):
         parser.error(f"--mesh supports models avalanche/dag/backlog/"
@@ -688,17 +789,28 @@ def main(argv=None) -> Dict:
         # validation arithmetic on a non-numeric JSON value (e.g. a
         # null event field) raises TypeError, not ValueError
         parser.error(str(e))
-    runner = {"slush": run_slush, "snowflake": run_snowflake,
-              "snowball": run_snowball, "avalanche": run_avalanche,
-              "dag": run_dag, "backlog": run_backlog,
-              "streaming_dag": run_streaming_dag}[args.model]
+    if args.fleet is not None:
+        # The in-graph tap has no per-trial identity under the fleet
+        # vmap; a --metrics sink receives PHASE ROWS host-side instead
+        # (each row carries its own point tag, so the sink opens
+        # untagged).
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, metrics_every=0)
+        runner = run_fleet_mode
+    else:
+        runner = {"slush": run_slush, "snowflake": run_snowflake,
+                  "snowball": run_snowball, "avalanche": run_avalanche,
+                  "dag": run_dag, "backlog": run_backlog,
+                  "streaming_dag": run_streaming_dag}[args.model]
 
     ctx = tracing.trace(args.trace) if args.trace else contextlib.nullcontext()
     if args.metrics:
         from go_avalanche_tpu import obs
 
-        sink_ctx = obs.metrics_sink(args.metrics,
-                                    tag=obs.tag_from_config(cfg))
+        sink_ctx = obs.metrics_sink(
+            args.metrics,
+            tag="" if args.fleet is not None else obs.tag_from_config(cfg))
     else:
         sink_ctx = contextlib.nullcontext()
     t0 = time.perf_counter()
@@ -712,7 +824,9 @@ def main(argv=None) -> Dict:
             "model": args.model,
             "workload": {"nodes": args.nodes, "txs": args.txs,
                          "max_rounds": args.max_rounds,
-                         "seed": args.seed},
+                         "seed": args.seed,
+                         **({"fleet": args.fleet}
+                            if args.fleet is not None else {})},
             "tag": obs.tag_from_config(cfg),
         })
         extra = {"metrics_records": sink.records_written,
