@@ -51,11 +51,14 @@ def _sync(state) -> None:
 
     `jax.block_until_ready` does not reliably synchronize through the axon
     TPU tunnel (verified: it reports a 8192^3 matmul at 57 PFLOP/s); fetching
-    a device-reduced scalar does.
+    a device-reduced scalar does.  Accepts the flagship state or a
+    streaming-backlog state (the `--arrival` lane) — both expose record
+    confidence planes.
     """
     import jax
     import numpy as np
-    np.asarray(jax.numpy.sum(state.records.confidence.astype(jax.numpy.int32)))
+    sim = getattr(state, "sim", state)
+    np.asarray(jax.numpy.sum(sim.records.confidence.astype(jax.numpy.int32)))
 
 
 def flagship_program(cfg, n_rounds: int):
@@ -111,11 +114,36 @@ def fleet_program(cfg, n_rounds: int, fleet: int):
     return jax.jit(jax.vmap(run_one), donate_argnums=0)
 
 
+def traffic_program(cfg, n_rounds: int):
+    """The `--arrival` variant of `flagship_program`: `n_rounds` of the
+    streaming backlog scheduler's step (arrive -> retire/refill -> one
+    consensus round, `models/backlog.step`) inside one donated jit — the
+    live-traffic service mode's timed program.  Module-level so
+    `benchmarks/hlo_pin.py` hashes THE timed program (`flagship_traffic`),
+    not a reconstruction of it."""
+    import functools
+
+    import jax
+
+    from go_avalanche_tpu.models import backlog as bl
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def run(s):
+        def body(st, _):
+            new_s, _ = bl.step(st, cfg)
+            return new_s, None
+        out, _ = jax.lax.scan(body, s, None, length=n_rounds)
+        return out
+
+    return run
+
+
 def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
           repeats: int = 3, exchange: str = "fused",
           ingest: str = "u8", latency: int = 0,
           latency_mode: str = "fixed", timeout_rounds: int | None = None,
           inflight: str = "walk", fleet: int | None = None,
+          arrival: float | None = None, arrival_window: int = 1024,
           metrics: str | None = None, metrics_every: int = 0,
           profile: bool = False) -> dict:
     import contextlib
@@ -141,7 +169,18 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
         metrics_every = 0
     elif metrics_every == 0:
         metrics_every = 1
-    if fleet is not None:
+    if arrival is not None:
+        # The live-traffic lane: the streaming backlog scheduler under
+        # poisson arrival with closed-loop admission
+        # (benchmarks/workload.traffic_backlog_state); orthogonal to
+        # the flagship A/B axes, so the parser keeps them exclusive.
+        from benchmarks.workload import traffic_backlog_state
+
+        window = min(arrival_window, n_txs)
+        state, cfg = traffic_backlog_state(n_nodes, n_txs, window, k,
+                                           rate=arrival,
+                                           metrics_every=metrics_every)
+    elif fleet is not None:
         # The in-graph tap's io_callback has no per-trial identity
         # under the fleet vmap (same rule as fleet.run_fleet); the CLI
         # rejects the pairing at the parser, the function API here.
@@ -184,8 +223,12 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
     # Donation means each call consumes its input, so the repeats chain
     # the evolved state (shape-invariant workload: nothing finalizes,
     # throughput per round is identical from any round's state).
-    run = (fleet_program(cfg, n_rounds, fleet) if fleet is not None
-           else flagship_program(cfg, n_rounds))
+    if arrival is not None:
+        run = traffic_program(cfg, n_rounds)
+    elif fleet is not None:
+        run = fleet_program(cfg, n_rounds, fleet)
+    else:
+        run = flagship_program(cfg, n_rounds)
 
     with sink_ctx:
         # Warm-up: compile + one executed sweep.
@@ -211,11 +254,18 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
             "tag": engine_tag,
         })
 
-    votes = n_nodes * n_txs * k * n_rounds * (fleet or 1)
+    if arrival is not None:
+        # The window is the polled surface: votes flow over [N, W], the
+        # backlog beyond it is metadata.
+        votes = n_nodes * window * k * n_rounds
+        shape = (f"{n_nodes} nodes x {n_txs} backlog x {window} window, "
+                 f"k={k}, {n_rounds} rounds, ")
+    else:
+        votes = n_nodes * n_txs * k * n_rounds * (fleet or 1)
+        shape = f"{n_nodes} nodes x {n_txs} txs, k={k}, {n_rounds} rounds, "
     votes_per_sec = votes / best_dt
     result = {
-        "metric": f"sustained vote ingest ({n_nodes} nodes x {n_txs} txs, "
-                  f"k={k}, {n_rounds} rounds, "
+        "metric": f"sustained vote ingest ({shape}"
                   f"{jax.devices()[0].platform}{engine_tag})",
         "value": round(votes_per_sec, 1),
         "unit": "votes/sec",
@@ -256,6 +306,8 @@ def _worker_main(args: argparse.Namespace) -> None:
                    latency=args.latency, latency_mode=args.latency_mode,
                    timeout_rounds=args.timeout_rounds,
                    inflight=args.inflight_engine, fleet=args.fleet,
+                   arrival=args.arrival,
+                   arrival_window=args.arrival_window,
                    metrics=args.metrics, metrics_every=args.metrics_every,
                    profile=args.profile)
     if args.nonce:
@@ -437,6 +489,25 @@ def main() -> None:
                              "collapse).  A/B at small shape: fleet=1 "
                              "vs fleet=64 isolates per-dispatch "
                              "overhead (PERF_NOTES PR 7)")
+    parser.add_argument("--arrival", type=float, default=None,
+                        metavar="RATE",
+                        help="live-traffic lane (go_avalanche_tpu/"
+                             "traffic.py): time the streaming backlog "
+                             "scheduler (models/backlog.step) under "
+                             "poisson arrival at RATE tx/round with "
+                             "closed-loop admission (occupancy "
+                             "backpressure 0.7,0.95) — --txs backlog "
+                             "entries through a --arrival-window slot "
+                             "working set.  Votes count the [N, W] "
+                             "window surface; the metric names the "
+                             "window and gains the config's arrival "
+                             "tag, so same-metric deltas never cross "
+                             "lanes.  Pinned as flagship_traffic "
+                             "(benchmarks/hlo_pin.py).  Exclusive with "
+                             "--fleet / --latency / --profile")
+    parser.add_argument("--arrival-window", type=int, default=1024,
+                        help="with --arrival: working-set slots "
+                             "(capped at --txs)")
     parser.add_argument("--metrics", type=str, default=None, metavar="PATH",
                         help="stream per-round telemetry to this JSONL "
                              "file through the in-graph metrics tap "
@@ -483,6 +554,37 @@ def main() -> None:
             parser.error("--profile replays one eager round on the "
                          "timed state; a fleet-stacked state has no "
                          "single-round spelling")
+    if args.arrival is not None:
+        # Parser-level rejection (the PR 5 rule): the arrival lane times
+        # a DIFFERENT program (the backlog scheduler), so the flagship
+        # A/B axes don't compose with it.
+        if not args.arrival > 0:
+            parser.error(f"--arrival must be a positive rate "
+                         f"(tx/round), got {args.arrival}")
+        if args.arrival_window < 1:
+            parser.error(f"--arrival-window must be >= 1 slot, got "
+                         f"{args.arrival_window}")
+        if args.fleet is not None:
+            parser.error("--arrival and --fleet are different timed "
+                         "programs (streaming scheduler vs batched "
+                         "flagship scans) — pick one lane")
+        if args.latency:
+            parser.error("--arrival times the synchronous streaming "
+                         "scheduler; compose the async ring with the "
+                         "traffic plane through run_sim, not the bench "
+                         "lane")
+        if (args.inflight_engine != "walk" or args.latency_mode != "fixed"
+                or args.timeout_rounds is not None):
+            parser.error("--inflight-engine/--latency-mode/"
+                         "--timeout-rounds are flagship async-lane "
+                         "knobs; the --arrival lane's builder "
+                         "(workload.traffic_backlog_state) never reads "
+                         "them — a silently dropped knob would "
+                         "mislabel the A/B")
+        if args.profile:
+            parser.error("--profile replays one eager flagship round; "
+                         "the backlog scheduler state has no such "
+                         "spelling")
     if args.metrics_every < 0:
         # Reject here: the worker subprocess's ValueError would read as
         # an accelerator failure and spin the retry/fallback loop.
@@ -502,6 +604,9 @@ def main() -> None:
              f"--latency-mode={args.latency_mode}",
              f"--inflight-engine={args.inflight_engine}"] \
         + ([f"--fleet={args.fleet}"] if args.fleet is not None else []) \
+        + ([f"--arrival={args.arrival}",
+            f"--arrival-window={args.arrival_window}"]
+           if args.arrival is not None else []) \
         + ([f"--timeout-rounds={args.timeout_rounds}"]
            if args.timeout_rounds is not None else []) \
         + ([f"--metrics={args.metrics}",

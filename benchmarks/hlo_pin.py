@@ -50,9 +50,21 @@ entry):
                      fleet=1 spelling with an explicitly-empty
                      stochastic fault block lowers to the archived
                      `flagship` pin byte-identical;
+  flagship_traffic — the `bench.py --arrival` program: the streaming
+                     backlog scheduler (`models/backlog.step`) under
+                     live-traffic poisson arrival with closed-loop
+                     admission (`bench.traffic_program`, PR 8) — the
+                     live-traffic service mode's timed program.  The
+                     OFF path (arrival disabled == the archived
+                     `streaming_step` pin byte-identical) is covered by
+                     `--verify-off-path`;
   streaming_step   — one `models/streaming_dag.step` at the roofline's
                      streaming shape (the north-star scheduler's inner
-                     program).
+                     program).  `--verify-off-path` re-lowers it with
+                     the arrival plane forced off explicitly
+                     (`arrival="off"`) and checks the archived pin —
+                     the live-traffic layer must be statically absent
+                     from the seed streaming program.
 
 The archive (`benchmarks/hlo_pin.json`) stores one hash per
 (program, platform) — lowering embeds platform-specific custom calls
@@ -93,6 +105,11 @@ STREAMING = dict(nodes=4096, backlog_sets=20000, set_cap=2,
 # flagship-mini sims batched on a leading trial axis inside one jit —
 # the Monte-Carlo fleet driver's workload (go_avalanche_tpu/fleet.py).
 FLEET_SMALL = dict(fleet=8, nodes=256, txs=256, rounds=20, k=8)
+# The live-traffic lane shape (`bench.py --arrival`): a 64k-tx backlog
+# streamed through a 1024-slot window under poisson arrival with
+# closed-loop admission (go_avalanche_tpu/traffic.py).
+TRAFFIC = dict(nodes=4096, txs=65536, window=1024, rounds=32, k=8,
+               rate=24.0)
 
 
 def flagship_stablehlo(nodes: int, txs: int, rounds: int, k: int,
@@ -168,21 +185,50 @@ def fleet_stablehlo(fleet: int, nodes: int, txs: int, rounds: int,
 
 
 def streaming_step_stablehlo(nodes: int, backlog_sets: int, set_cap: int,
-                             window_sets: int) -> str:
+                             window_sets: int, arrival=None) -> str:
     """StableHLO text of one north-star streaming-scheduler step
     (`models/streaming_dag.step`) at the roofline's streaming shape,
-    abstractly lowered like the flagship."""
+    abstractly lowered like the flagship.  `arrival="off"` forces the
+    live-traffic plane EXPLICITLY off (how `--verify-off-path` proves
+    arrival-disabled == the archived pin); None leaves the config
+    untouched (the default-off drift-test lowering, a distinct
+    `program_hash` cache key)."""
     import jax
 
     from benchmarks.workload import northstar_config, northstar_state
     from go_avalanche_tpu.models import streaming_dag as sdg
 
     cfg = northstar_config(window_sets, set_cap)
+    if arrival is not None:
+        if arrival != "off":
+            raise ValueError(f"streaming_step arrival knob is 'off' or "
+                             f"absent, got {arrival!r}")
+        cfg = dataclasses.replace(cfg, arrival_mode="off",
+                                  arrival_rate=0.0,
+                                  arrival_backpressure=None)
     state_abs = jax.eval_shape(lambda: northstar_state(
         nodes=nodes, backlog_sets=backlog_sets, set_cap=set_cap,
         window_sets=window_sets, track_finality=False)[0])
     return jax.jit(lambda s: sdg.step(s, cfg)[0]).lower(
         state_abs).as_text()
+
+
+def traffic_stablehlo(nodes: int, txs: int, window: int, rounds: int,
+                      k: int, rate: float) -> str:
+    """StableHLO text of the `bench.py --arrival` program: `rounds`
+    streaming-backlog steps under live-traffic poisson arrival inside
+    one donated jit (`bench.traffic_program` — the timed program
+    itself, like the flagship entries), abstractly lowered from the
+    shared workload builder."""
+    import jax
+
+    import bench
+    from benchmarks.workload import traffic_backlog_state, traffic_config
+
+    cfg = traffic_config(window, k, rate)
+    state_abs = jax.eval_shape(
+        lambda: traffic_backlog_state(nodes, txs, window, k, rate)[0])
+    return bench.traffic_program(cfg, rounds).lower(state_abs).as_text()
 
 
 # program name -> (workload dict, builder).  Every entry is checked by
@@ -205,6 +251,8 @@ PROGRAMS = {
                         lambda w: flagship_stablehlo(**w)),
     "fleet_small": (dict(FLEET_SMALL),
                     lambda w: fleet_stablehlo(**w)),
+    "flagship_traffic": (dict(TRAFFIC),
+                         lambda w: traffic_stablehlo(**w)),
     "streaming_step": (dict(STREAMING),
                        lambda w: streaming_step_stablehlo(**w)),
 }
@@ -335,6 +383,23 @@ def verify_off_path(platform: str, archive: dict | None = None) -> list:
                 f"fleet=1 empty-stochastic program {current} != the "
                 f"flagship pin {pinned} — the fleet lane's f=1 spelling "
                 f"no longer times the pinned flagship program")
+    # The live-traffic lane's off path (PR 8): the streaming step with
+    # the arrival plane forced off EXPLICITLY must lower to the
+    # archived `streaming_step` pin byte-identical — the traffic layer
+    # (arrival watermark, latency histogram, admission gating) must be
+    # statically absent from the seed streaming program.
+    entry = archive.get("programs", {}).get("streaming_step")
+    if entry and entry.get("hashes", {}).get(platform):
+        workload = dict(entry.get("workload") or STREAMING)
+        workload["arrival"] = "off"
+        current = program_hash("streaming_step", workload)
+        pinned = entry["hashes"][platform]
+        if current != pinned:
+            failures.append(
+                f"streaming_step with arrival forced off hashes to "
+                f"{current} != pinned {pinned} — the live-traffic "
+                f"plane leaks into the arrival-disabled streaming "
+                f"program")
     return failures
 
 

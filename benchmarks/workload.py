@@ -117,6 +117,47 @@ def fleet_flagship_state(fleet: int, nodes: int, txs: int, k: int = 8,
     return state, cfg
 
 
+def traffic_config(window: int, k: int = 8, rate: float = 24.0,
+                   metrics_every: int = 0):
+    """The `bench.py --arrival` lane's config: live-traffic poisson
+    arrivals with closed-loop admission over the streaming backlog
+    scheduler (`models/backlog`).  Unlike the flagship's unreachable
+    finalization score, slots here MUST settle and recycle — the lane
+    measures sustained ingest of a flowing stream, not a frozen window —
+    so the reference finalization score stays; gossip off (admission
+    pre-seeds every node, `models/backlog._retire_and_refill`) and the
+    poll cap covers the window like `northstar_config`."""
+    from go_avalanche_tpu.config import AvalancheConfig
+
+    return AvalancheConfig(k=k, gossip=False,
+                           max_element_poll=max(4096, window),
+                           arrival_mode="poisson",
+                           arrival_rate=float(rate),
+                           arrival_backpressure=(0.7, 0.95),
+                           metrics_every=metrics_every)
+
+
+def traffic_backlog_state(nodes: int, txs: int, window: int, k: int = 8,
+                          rate: float = 24.0, metrics_every: int = 0):
+    """The `bench.py --arrival` workload: (state, cfg) for the streaming
+    backlog under live-traffic arrival — `txs` backlog entries (scores
+    from the pinned score seed, like the north-star builder) streamed
+    through a `window`-slot working set at `rate` offered tx/round.
+    One construction shared by `bench.py` and `benchmarks/hlo_pin.py`
+    (`flagship_traffic`) so the pin hashes the timed program's state
+    shapes."""
+    import jax
+
+    from go_avalanche_tpu.models import backlog as bl
+
+    cfg = traffic_config(window, k, rate, metrics_every)
+    scores = jax.random.randint(jax.random.key(_SCORE_SEED), (txs,), 0,
+                                _SCORE_MAX)
+    backlog = bl.make_backlog(scores)
+    return bl.init(jax.random.key(_SIM_SEED), nodes, window, backlog,
+                   cfg), cfg
+
+
 def northstar_config(window_sets: int, set_cap: int):
     """The AvalancheConfig every north-star surface runs under: gossip off
     (every node pre-seeded, as in the reference example's feed) and a poll

@@ -430,6 +430,77 @@ class AvalancheConfig:
                                       #   = dense rewrite (exact legacy
                                       #   trajectory).  See PERF_NOTES.md.
 
+    # --- live-traffic service mode (go_avalanche_tpu/traffic.py) ---
+    arrival_mode: str = "off"         # streaming schedulers (backlog /
+                                      #   streaming_dag) only: how fresh
+                                      #   work ARRIVES instead of being
+                                      #   fully pre-seeded.  "off" (the
+                                      #   drain-a-fixed-backlog seed
+                                      #   path; the traffic plane is
+                                      #   statically absent and every
+                                      #   archived hlo pin is
+                                      #   byte-identical).  "poisson":
+                                      #   Poisson(arrival_rate) new
+                                      #   admission units (txs for
+                                      #   backlog, conflict SETS for
+                                      #   streaming_dag) per round.
+                                      #   "bursty": Poisson whose rate is
+                                      #   arrival_rate *
+                                      #   arrival_burst_factor during the
+                                      #   first arrival_duty fraction of
+                                      #   every arrival_period-round
+                                      #   cycle, arrival_rate otherwise.
+                                      #   "diurnal": Poisson whose rate
+                                      #   follows arrival_rate * (1 +
+                                      #   arrival_depth *
+                                      #   sin(2*pi*round/arrival_period))
+                                      #   — the day/night load curve.
+                                      #   "external": the schedule draws
+                                      #   NOTHING; arrivals are pushed by
+                                      #   an external load generator
+                                      #   (`traffic.push_arrivals`, the
+                                      #   Connector SIM_SUBMIT message).
+                                      #   The schedule is jit-static; the
+                                      #   per-round draw is realized from
+                                      #   the sim's init key, so dense
+                                      #   and sharded runs (and every
+                                      #   fleet trial) see the same
+                                      #   arrival sequence for the same
+                                      #   key (tests/test_traffic.py).
+    arrival_rate: float = 0.0         # mean admission units per round
+                                      #   (the offered load); > 0 for
+                                      #   every schedule except
+                                      #   off/external
+    arrival_period: int = 0           # bursty/diurnal cycle length in
+                                      #   rounds (>= 2 there, unread
+                                      #   elsewhere)
+    arrival_burst_factor: float = 1.0  # bursty: peak rate multiplier
+                                      #   (> 1) during the duty window
+    arrival_duty: float = 0.5         # bursty: fraction of the period
+                                      #   at the peak, in (0, 1)
+    arrival_depth: float = 0.0        # diurnal: sinusoid modulation
+                                      #   depth in [0, 1]
+    arrival_backpressure: Optional[Tuple[float, float]] = None
+                                      # closed-loop admission control:
+                                      #   (lo, hi) working-set occupancy
+                                      #   fractions.  Below lo the full
+                                      #   scheduled rate is offered;
+                                      #   above hi arrivals are fully
+                                      #   throttled; linear in between —
+                                      #   occupancy is the backpressure
+                                      #   signal that turns the
+                                      #   simulator into a
+                                      #   capacity-planning tool
+                                      #   (examples/capacity_planning.py)
+    arrival_latency_buckets: int = 512
+                                      # finality-latency histogram depth
+                                      #   (rounds): per-tx arrival ->
+                                      #   finalized latencies clamp into
+                                      #   [0, buckets); the in-graph
+                                      #   p50/p99/p999 percentiles are
+                                      #   EXACT (nearest-rank) for
+                                      #   latencies under the cap
+
     # --- fault / adversary model (SURVEY.md section 2.4 item 5) ---
     byzantine_fraction: float = 0.0   # nodes that vote adversarially
     flip_probability: float = 1.0     # P(byzantine node lies, per draw)
@@ -511,6 +582,16 @@ class AvalancheConfig:
         the only event kind that does NOT need the in-flight engine."""
         return tuple(e for e in self.fault_events()
                      if e[0] == "churn_burst")
+
+    def arrivals_enabled(self) -> bool:
+        """True when the live-traffic arrival plane
+        (`go_avalanche_tpu/traffic.py`) is on: the streaming schedulers
+        carry a `TrafficState` (arrival key, arrived watermark, per-unit
+        arrival-round plane, finality-latency histogram) and admission
+        is gated on arrived work.  False = the drain-a-fixed-backlog
+        seed path; the plane is statically absent and every archived
+        hlo pin is untouched."""
+        return self.arrival_mode != "off"
 
     def async_queries(self) -> bool:
         """True when the in-flight query engine (`ops/inflight.py`) is on:
@@ -617,6 +698,7 @@ class AvalancheConfig:
                                  "(0, 1)")
         self._validate_fault_script()
         self._validate_rtt_matrix()
+        self._validate_arrival()
         if self.latency_mode == "rtt":
             if self.rtt_matrix is None:
                 raise ValueError(
@@ -819,6 +901,92 @@ class AvalancheConfig:
                     f"be a [lo, hi] range inside (0, 1), got {ev[3]!r}")
         else:                                                  # spike
             _range(fields[2], ev[3], integer=True, lo_min=1)
+
+    def _validate_arrival(self) -> None:
+        """Live-traffic knobs (`go_avalanche_tpu/traffic.py`): reject
+        inert or out-of-range arrival configs at CONSTRUCTION (the
+        rtt_matrix rule — a silently ignored rate would mislabel the
+        run); run_sim mirrors these at its parser."""
+        modes = ("off", "poisson", "bursty", "diurnal", "external")
+        if self.arrival_mode not in modes:
+            raise ValueError(
+                f"arrival_mode must be one of {', '.join(modes)}, got "
+                f"{self.arrival_mode!r}")
+        if self.arrival_mode == "off":
+            if self.arrival_rate != 0.0:
+                raise ValueError(
+                    f"arrival_rate is only read when arrival_mode is on, "
+                    f"got rate {self.arrival_rate!r} with mode 'off' — a "
+                    f"silently ignored rate would mislabel the run")
+            if self.arrival_backpressure is not None:
+                raise ValueError(
+                    "arrival_backpressure is only read when arrival_mode "
+                    "is on (occupancy throttles the arrival draw); with "
+                    "mode 'off' it would be silently ignored")
+            return
+        if self.arrival_mode == "external":
+            if self.arrival_rate != 0.0:
+                raise ValueError(
+                    f"arrival_mode 'external' draws nothing in-graph "
+                    f"(arrivals are pushed via traffic.push_arrivals / "
+                    f"the Connector SIM_SUBMIT message); got "
+                    f"arrival_rate {self.arrival_rate!r} — use a "
+                    f"schedule mode for in-graph offered load")
+            if self.arrival_backpressure is not None:
+                raise ValueError(
+                    "arrival_backpressure throttles the in-graph "
+                    "arrival DRAW, which arrival_mode 'external' never "
+                    "performs (pushed arrivals are admitted as-is) — "
+                    "a silently inert backpressure band would mislabel "
+                    "the run as closed-loop")
+        elif not (self.arrival_rate > 0.0):
+            raise ValueError(
+                f"arrival_mode {self.arrival_mode!r} needs "
+                f"arrival_rate > 0 (mean admission units per round), "
+                f"got {self.arrival_rate!r}")
+        if self.arrival_mode in ("bursty", "diurnal"):
+            if self.arrival_period < 2:
+                raise ValueError(
+                    f"arrival_mode {self.arrival_mode!r} needs "
+                    f"arrival_period >= 2 rounds (the modulation cycle), "
+                    f"got {self.arrival_period}")
+        if self.arrival_mode == "bursty":
+            if not (self.arrival_burst_factor > 1.0):
+                raise ValueError(
+                    f"bursty arrivals need arrival_burst_factor > 1 "
+                    f"(otherwise the schedule is plain poisson), got "
+                    f"{self.arrival_burst_factor!r}")
+            if not (0.0 < self.arrival_duty < 1.0):
+                raise ValueError(
+                    f"arrival_duty must be in (0, 1) (the burst fraction "
+                    f"of each cycle), got {self.arrival_duty!r}")
+        if self.arrival_mode == "diurnal" and not (
+                0.0 <= self.arrival_depth <= 1.0):
+            raise ValueError(
+                f"arrival_depth must be in [0, 1] (sinusoid modulation "
+                f"depth), got {self.arrival_depth!r}")
+        if self.arrival_backpressure is not None:
+            bp = tuple(self.arrival_backpressure)
+            object.__setattr__(self, "arrival_backpressure", bp)
+            if len(bp) != 2:
+                raise ValueError(
+                    f"arrival_backpressure is (lo, hi) occupancy "
+                    f"fractions, got {bp!r}")
+            lo, hi = bp
+            for v in bp:
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    raise ValueError(
+                        f"arrival_backpressure bounds must be numbers, "
+                        f"got {bp!r}")
+            if not (0.0 <= lo < hi <= 1.0):
+                raise ValueError(
+                    f"arrival_backpressure needs 0 <= lo < hi <= 1 "
+                    f"(full rate below lo, fully throttled above hi), "
+                    f"got {bp!r}")
+        if self.arrival_latency_buckets < 2:
+            raise ValueError(
+                f"arrival_latency_buckets must be >= 2 (latencies clamp "
+                f"into [0, buckets)), got {self.arrival_latency_buckets}")
 
     def _validate_rtt_matrix(self) -> None:
         """The cluster-pair RTT matrix must be square, match the

@@ -25,6 +25,20 @@ class SimStats(NamedTuple):
     finalizations: int
 
 
+class TrafficStats(NamedTuple):
+    """SIM_TRAFFIC_STATS: the live-traffic SLO view — cumulative
+    arrivals/admissions/settlements plus the in-graph finality-latency
+    percentiles (-1 while nothing has settled)."""
+
+    arrived: int
+    admitted: int
+    settled: int
+    lat_count: int
+    lat_p50: int
+    lat_p99: int
+    lat_p999: int
+
+
 class ConnectorClient:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  timeout_s: float = 60.0) -> None:
@@ -131,14 +145,27 @@ class ConnectorClient:
                  churn_probability: float = 0.0,
                  model: str = "avalanche",
                  conflict_size: int = 2,
-                 window_sets: int = 0) -> bool:
+                 window_sets: int = 0,
+                 arrival_mode: str = "off",
+                 arrival_rate: float = 0.0,
+                 arrival_period: int = 0,
+                 arrival_backpressure=None) -> bool:
         """(Re)initialize the server-side batched simulator.
 
         `model` selects the family (v3 tail): "avalanche" (default),
-        "dag" (conflict sets of `conflict_size`), or "streaming_dag"
-        (`window_sets` set-slots; 0 = auto-size to sets/8).
+        "dag" (conflict sets of `conflict_size`), "streaming_dag"
+        (`window_sets` set-slots; 0 = auto-size to sets/8), or
+        "backlog" (`window_sets` tx slots; 0 = auto-size to txs/8).
+
+        The arrival args (v4 tail; streaming models only) turn on the
+        live-traffic plane (go_avalanche_tpu/traffic.py): a schedule
+        mode with `arrival_rate` offered units/round and optional
+        `(lo, hi)` occupancy backpressure, or "external" to feed the
+        stream exclusively through `sim_submit` — this client acting
+        as the live load generator.
         """
         strategies = [s.value for s in AdversaryStrategy]
+        bp = arrival_backpressure or (0.0, 0.0)
         _, r = self._call(
             proto.MsgType.SIM_INIT,
             struct.pack("<IIIIIBdd", n_nodes, n_txs, seed, k,
@@ -147,7 +174,10 @@ class ConnectorClient:
             + struct.pack("<Bdd", strategies.index(adversary_strategy),
                           flip_probability, churn_probability)
             + struct.pack("<BII", proto.SIM_MODELS.index(model), conflict_size,
-                          window_sets),
+                          window_sets)
+            + struct.pack("<BdIdd",
+                          proto.ARRIVAL_MODES.index(arrival_mode),
+                          arrival_rate, arrival_period, bp[0], bp[1]),
             [proto.MsgType.OK])
         return bool(r[0])
 
@@ -156,6 +186,17 @@ class ConnectorClient:
                           struct.pack("<I", n_rounds),
                           [proto.MsgType.SIM_STATS])
         return SimStats(*struct.unpack("<Id4q", r))
+
+    def sim_submit(self, count: int = 0) -> TrafficStats:
+        """Push `count` fresh admission units into the running streaming
+        sim (they arrive at the CURRENT round) and read the traffic
+        stats; `count=0` just reads.  The live-load-generator seam —
+        interleave with `sim_run` to drive a closed loop from outside
+        the graph."""
+        _, r = self._call(proto.MsgType.SIM_SUBMIT,
+                          struct.pack("<I", count),
+                          [proto.MsgType.SIM_TRAFFIC_STATS])
+        return TrafficStats(*struct.unpack("<7q", r))
 
     def shutdown_server(self) -> None:
         self._call(proto.MsgType.SHUTDOWN, b"", [proto.MsgType.OK])

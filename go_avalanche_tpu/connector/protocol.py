@@ -32,8 +32,14 @@ from typing import List, Optional, Sequence, Tuple
 MAX_FRAME = 64 * 1024 * 1024  # sanity bound, not a protocol limit
 
 # SIM_INIT v3 model bytes, in wire order (mirrored by the Go client's
-# Model* constants and native/connector/protocol.h).
-SIM_MODELS = ("avalanche", "dag", "streaming_dag")
+# Model* constants and native/connector/protocol.h).  "backlog" (byte 3,
+# PR 8) is the streaming working-set scheduler — the live-traffic
+# service-mode model; older clients never send it.
+SIM_MODELS = ("avalanche", "dag", "streaming_dag", "backlog")
+
+# SIM_INIT v4 arrival-mode bytes, in wire order (go_avalanche_tpu/
+# traffic.py; "external" = arrivals pushed via SIM_SUBMIT only).
+ARRIVAL_MODES = ("off", "poisson", "bursty", "diurnal", "external")
 
 
 class MsgType(enum.IntEnum):
@@ -54,8 +60,21 @@ class MsgType(enum.IntEnum):
                            #   older clients omit the tail)
                            #  + optional v3 tail {model B, conflict_size I,
                            #  window_sets I} (model: 0=avalanche 1=dag
-                           #  2=streaming_dag; window_sets 0 = auto)
+                           #  2=streaming_dag 3=backlog; window_sets 0 =
+                           #  auto — set-slots for streaming_dag, tx
+                           #  slots for backlog)
+                           #  + optional v4 tail {arrival_mode B,
+                           #  arrival_rate d, arrival_period I,
+                           #  backpressure_lo d, backpressure_hi d}
+                           #  (mode: 0=off 1=poisson 2=bursty 3=diurnal
+                           #  4=external; lo == hi == 0 means no
+                           #  backpressure; streaming models only)
     SIM_RUN = 12           # {rounds I}
+    SIM_SUBMIT = 13        # {count I} — live load generator: `count`
+                           #  fresh admission units arrive NOW
+                           #  (traffic.push_arrivals); count 0 just
+                           #  reads the traffic stats.  Needs a
+                           #  streaming model with an arrival mode.
     SHUTDOWN = 16
     # replies
     PONG = 2
@@ -67,6 +86,11 @@ class MsgType(enum.IntEnum):
     SIM_STATS = 20         # {round I, finalized_frac d, polls q, votes q,
                            #  flips q, finalizations q}
     ERROR = 21             # {len I, utf8 ...}
+    SIM_TRAFFIC_STATS = 22  # {arrived q, admitted q, settled q,
+                           #  lat_count q, lat_p50 q, lat_p99 q,
+                           #  lat_p999 q} — the finality-latency SLO
+                           #  view (percentiles -1 while nothing
+                           #  settled)
 
 
 # ------------------------------------------------------------------- framing

@@ -130,6 +130,11 @@ class _SimBackend:
         import jax
         import jax.numpy as jnp
 
+        if cfg.arrivals_enabled() and model not in ("backlog",
+                                                    "streaming_dag"):
+            raise proto.ProtocolError(
+                f"SIM_INIT: the arrival tail (live traffic) needs a "
+                f"streaming model (backlog/streaming_dag), got {model}")
         with self._lock:
             self._cfg = cfg
             self._model = model
@@ -158,6 +163,12 @@ class _SimBackend:
                     n_txs, dtype=jnp.int32).reshape(n_sets, conflict_size))
                 self._state = sdg.init(jax.random.key(seed), n_nodes,
                                        w_sets, backlog, cfg)
+            elif model == "backlog":
+                from go_avalanche_tpu.models import backlog as bl
+                slots = window_sets or max(1, n_txs // 8)
+                b = bl.make_backlog(jnp.arange(n_txs, dtype=jnp.int32))
+                self._state = bl.init(jax.random.key(seed), n_nodes,
+                                      slots, b, cfg)
             else:
                 raise proto.ProtocolError(f"SIM_INIT: unknown model {model}")
             self._totals = [0, 0, 0, 0]
@@ -192,7 +203,7 @@ class _SimBackend:
                 c = fin_acc.shape[1] // state.n_sets
                 fin_frac = float(
                     (dag.winners_per_set(fin_acc, c) == 1).mean())
-            else:  # streaming_dag
+            elif self._model == "streaming_dag":
                 from go_avalanche_tpu.models import streaming_dag as sdg
                 state, stel = jax.jit(
                     sdg.run_scan, static_argnames=("cfg", "n_rounds"))(
@@ -201,12 +212,63 @@ class _SimBackend:
                 rnd = state.dag.base.round
                 fin_frac = float(np.asarray(jax.device_get(
                     state.outputs.settled)).mean())
+            else:  # backlog
+                from go_avalanche_tpu.models import backlog as bl
+                state, btel = jax.jit(
+                    bl.run_scan, static_argnames=("cfg", "n_rounds"))(
+                        self._state, self._cfg, n_rounds)
+                tel = btel.round
+                rnd = state.sim.round
+                fin_frac = float(np.asarray(jax.device_get(
+                    state.outputs.settled)).mean())
             self._state = state
             sums = [int(np.asarray(jax.device_get(x)).sum())
                     for x in (tel.polls, tel.votes_applied, tel.flips,
                               tel.finalizations)]
             self._totals = [a + b for a, b in zip(self._totals, sums)]
             return int(jax.device_get(rnd)), fin_frac, list(self._totals)
+
+    def submit(self, count: int) -> Tuple[int, ...]:
+        """SIM_SUBMIT: the live-load-generator seam — `count` fresh
+        admission units arrive NOW (`traffic.push_arrivals`); count 0
+        just reads.  Returns the SIM_TRAFFIC_STATS tuple (arrived,
+        admitted, settled, lat_count, p50, p99, p999)."""
+        import jax
+        import numpy as np
+        from go_avalanche_tpu import traffic as tf
+
+        with self._lock:
+            state = self._state
+            if state is None or self._cfg is None:
+                raise proto.ProtocolError(
+                    "SIM_INIT required before SIM_SUBMIT")
+            traffic = getattr(state, "traffic", None)
+            if traffic is None:
+                raise proto.ProtocolError(
+                    "SIM_SUBMIT needs a streaming model with the "
+                    "arrival tail (SIM_INIT v4; arrival_mode "
+                    "'external' for a pure push-driven stream)")
+            round_ = (state.sim.round if self._model == "backlog"
+                      else state.dag.base.round)
+            if count > 0:
+                state = state._replace(
+                    traffic=tf.push_arrivals(traffic, count, round_))
+                self._state = state
+            stats = tf.latency_percentiles(state.traffic)
+            # Same units as arrived/admitted: txs for backlog, SETS for
+            # streaming_dag (whose outputs.settled is a per-member
+            # plane — invalid padding lanes included — scattered row-
+            # at-a-time; lat_count already counts valid members only).
+            settled_plane = np.asarray(
+                jax.device_get(state.outputs.settled))
+            settled = int(settled_plane.sum() if self._model == "backlog"
+                          else settled_plane.any(axis=1).sum())
+            admitted = int(jax.device_get(state.next_idx))
+            return (stats["arrived_total"], admitted, settled,
+                    stats["finality_latency_count"],
+                    stats["finality_latency_p50"],
+                    stats["finality_latency_p99"],
+                    stats["finality_latency_p999"])
 
 
 class ConnectorServer:
@@ -400,7 +462,8 @@ class ConnectorServer:
             # v3 optional extension: model byte + conflict_size + window
             # set-slots (streaming only; 0 = auto).
             model, conflict_size, window_sets = "avalanche", 2, 0
-            if len(payload) >= base_len + v2_len + struct.calcsize("<BII"):
+            v3_len = struct.calcsize("<BII")
+            if len(payload) >= base_len + v2_len + v3_len:
                 model_b, conflict_size, window_sets = struct.unpack_from(
                     "<BII", payload, base_len + v2_len)
                 if model_b >= len(SIM_MODELS):
@@ -410,9 +473,33 @@ class ConnectorServer:
                         + ", ".join(f"{i}={m}"
                                     for i, m in enumerate(SIM_MODELS)) + ")")
                 model = SIM_MODELS[model_b]
+            # v4 optional extension: live-traffic arrival tail
+            # (streaming models; lo == hi == 0 means no backpressure).
+            arrival = {}
+            v4_off = base_len + v2_len + v3_len
+            if len(payload) >= v4_off + struct.calcsize("<BdIdd"):
+                mode_b, rate, period, bp_lo, bp_hi = struct.unpack_from(
+                    "<BdIdd", payload, v4_off)
+                if mode_b >= len(proto.ARRIVAL_MODES):
+                    raise proto.ProtocolError(
+                        f"SIM_INIT arrival mode byte {mode_b} out of "
+                        f"range (valid: 0.."
+                        f"{len(proto.ARRIVAL_MODES) - 1}: "
+                        + ", ".join(f"{i}={m}" for i, m in
+                                    enumerate(proto.ARRIVAL_MODES))
+                        + ")")
+                mode = proto.ARRIVAL_MODES[mode_b]
+                if mode != "off":
+                    arrival = dict(
+                        arrival_mode=mode, arrival_rate=rate,
+                        arrival_period=period,
+                        arrival_backpressure=((bp_lo, bp_hi)
+                                              if bp_lo or bp_hi
+                                              else None))
             cfg = AvalancheConfig(
                 k=k, finalization_score=fin, gossip=bool(gossip),
-                byzantine_fraction=byz, drop_probability=drop, **extra)
+                byzantine_fraction=byz, drop_probability=drop, **extra,
+                **arrival)
             self._sim.init(n_nodes, n_txs, seed, cfg, model=model,
                            conflict_size=conflict_size,
                            window_sets=window_sets)
@@ -422,6 +509,11 @@ class ConnectorServer:
             (rounds,) = struct.unpack_from("<I", payload, 0)
             rnd, fin_frac, totals = self._sim.run(rounds)
             return M.SIM_STATS, struct.pack("<Id4q", rnd, fin_frac, *totals)
+
+        if msg_type == M.SIM_SUBMIT:
+            (count,) = struct.unpack_from("<I", payload, 0)
+            return (M.SIM_TRAFFIC_STATS,
+                    struct.pack("<7q", *self._sim.submit(count)))
 
         if msg_type == M.SHUTDOWN:
             return M.OK, struct.pack("<B", 1)

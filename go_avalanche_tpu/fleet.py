@@ -78,7 +78,7 @@ from jax import lax
 from go_avalanche_tpu.config import AdversaryStrategy, AvalancheConfig
 from go_avalanche_tpu.ops import voterecord as vr
 
-FLEET_MODELS = ("snowball", "avalanche", "dag")
+FLEET_MODELS = ("snowball", "avalanche", "dag", "backlog")
 
 
 # --------------------------------------------------------------------------
@@ -171,6 +171,33 @@ class TrialOutcome(NamedTuple):
     finalized_fraction: jax.Array  # float32 — honest records finalized
     cut_start: Optional[jax.Array] = None  # int32 [Ec] realized windows
     cut_end: Optional[jax.Array] = None    # (None: no stochastic cuts)
+    cut_split: Optional[jax.Array] = None  # int32 [Ec] realized node
+                                  #   split of each stochastic cut
+    spike_start: Optional[jax.Array] = None  # int32 [Es] realized
+    spike_end: Optional[jax.Array] = None    #   stochastic_spike windows
+    spike_extra: Optional[jax.Array] = None  #   + extra rounds (None: no
+                                  #   stochastic spikes scheduled)
+    lat_p50: Optional[jax.Array] = None   # int32 — finality-latency
+    lat_p99: Optional[jax.Array] = None   #   percentiles of the traffic
+    lat_p999: Optional[jax.Array] = None  #   plane (backlog model with
+                                  #   arrivals on; None otherwise)
+    arrived: Optional[jax.Array] = None   # int32 — units arrived
+
+
+def _fault_realizations(fault_params) -> Dict:
+    """TrialOutcome kwargs capturing the trial's REALIZED stochastic
+    fault schedule (`ops/inflight.draw_fault_params`) — cut windows WITH
+    their node splits and spike windows with their extra rounds, so a
+    phase-diagram row can record exactly what each trial experienced
+    (ROADMAP PR-7 follow-up; rendered by `FleetResult.realizations`)."""
+    if fault_params is None:
+        return {}
+    return dict(cut_start=fault_params.cut_start,
+                cut_end=fault_params.cut_end,
+                cut_split=fault_params.cut_split,
+                spike_start=fault_params.spike_start,
+                spike_end=fault_params.spike_end,
+                spike_extra=fault_params.spike_extra)
 
 
 def _outcome_snowball(state, cfg: AvalancheConfig) -> TrialOutcome:
@@ -183,10 +210,7 @@ def _outcome_snowball(state, cfg: AvalancheConfig) -> TrialOutcome:
         settled=settled,
         finality_round=jnp.where(settled, stamped.max(), jnp.int32(-1)),
         finalized_fraction=(fin & honest).sum() / honest.sum(),
-        cut_start=(None if state.fault_params is None
-                   else state.fault_params.cut_start),
-        cut_end=(None if state.fault_params is None
-                 else state.fault_params.cut_end))
+        **_fault_realizations(state.fault_params))
 
 
 def _outcome_avalanche(state, cfg: AvalancheConfig) -> TrialOutcome:
@@ -200,10 +224,7 @@ def _outcome_avalanche(state, cfg: AvalancheConfig) -> TrialOutcome:
         finality_round=jnp.where(settled, stamped.max(), jnp.int32(-1)),
         finalized_fraction=((fin & honest).sum()
                             / honest.sum() / fin.shape[1]),
-        cut_start=(None if state.fault_params is None
-                   else state.fault_params.cut_start),
-        cut_end=(None if state.fault_params is None
-                 else state.fault_params.cut_end))
+        **_fault_realizations(state.fault_params))
 
 
 def _outcome_dag(state, cfg: AvalancheConfig) -> TrialOutcome:
@@ -241,10 +262,37 @@ def _outcome_dag(state, cfg: AvalancheConfig) -> TrialOutcome:
         settled=settled,
         finality_round=jnp.where(settled, stamped.max(), jnp.int32(-1)),
         finalized_fraction=frac,
-        cut_start=(None if base.fault_params is None
-                   else base.fault_params.cut_start),
-        cut_end=(None if base.fault_params is None
-                 else base.fault_params.cut_end))
+        **_fault_realizations(base.fault_params))
+
+
+def _outcome_backlog(state, cfg: AvalancheConfig) -> TrialOutcome:
+    """Streaming-backlog trial reduction: did the whole backlog drain
+    within the horizon, when did the last tx settle, and — with the
+    live-traffic plane on — what finality-latency percentiles did the
+    offered load produce (the capacity-planning outcome,
+    `examples/capacity_planning.py`).  Safety is the avalanche per-tx
+    divergence detector on the live window."""
+    from go_avalanche_tpu import traffic as tf
+
+    out = state.outputs
+    settled = out.settled.all()
+    lat = {}
+    if state.traffic is not None:
+        (p50n, p50d), (p99n, p99d), (p999n, p999d) = tf.PERCENTILES
+        hist = state.traffic.lat_hist
+        lat = dict(
+            lat_p50=tf.percentile_from_hist(hist, p50n, p50d),
+            lat_p99=tf.percentile_from_hist(hist, p99n, p99d),
+            lat_p999=tf.percentile_from_hist(hist, p999n, p999d),
+            arrived=state.traffic.arrived_idx)
+    return TrialOutcome(
+        violation=avalanche_safety_violated(state.sim, cfg),
+        settled=settled,
+        finality_round=jnp.where(settled, out.settle_round.max(),
+                                 jnp.int32(-1)),
+        finalized_fraction=out.settled.mean().astype(jnp.float32),
+        **_fault_realizations(state.sim.fault_params),
+        **lat)
 
 
 # --------------------------------------------------------------------------
@@ -254,7 +302,7 @@ def _outcome_dag(state, cfg: AvalancheConfig) -> TrialOutcome:
 @functools.lru_cache(maxsize=16)  # bounded, like models/avalanche's jits
 def _compiled_fleet(model: str, cfg: AvalancheConfig, n_nodes: int,
                     n_txs: int, n_rounds: int, conflict_size: int,
-                    yes_fraction: float, contested: bool):
+                    yes_fraction: float, contested: bool, window: int):
     """One jitted ``keys [F] -> (TrialOutcome [F], telemetry [F, R])``
     program — the whole sim (init included) lives inside the vmap, so a
     fleet is one compile and one dispatch per config point."""
@@ -274,6 +322,25 @@ def _compiled_fleet(model: str, cfg: AvalancheConfig, n_nodes: int,
             state = av.init(key, n_nodes, n_txs, cfg,
                             init_pref=init_pref)
             step, outcome = av.round_step, _outcome_avalanche
+        elif model == "backlog":
+            from go_avalanche_tpu.models import backlog as bl
+
+            # The backlog (arrival-stream order) is shared across
+            # trials; only the sim/traffic key varies per trial.  A
+            # final harvest pass records the last window's outcomes —
+            # and their finality latencies — like `bl.run` does.
+            state = bl.init(key, n_nodes, window,
+                            bl.make_backlog(
+                                jnp.arange(n_txs, dtype=jnp.int32)), cfg)
+
+            def bl_step(s, c):
+                return bl.step(s, c)
+
+            def bl_outcome(final, c):
+                final, _ = bl._retire_and_refill(final, c, refill=False)
+                return _outcome_backlog(final, c)
+
+            step, outcome = bl_step, bl_outcome
         else:
             from go_avalanche_tpu.models import dag as dag_model
 
@@ -308,6 +375,17 @@ class FleetResult:
     telemetry: object               # stacked telemetry pytree [F, R]
     cut_windows: Optional[np.ndarray]  # int32 [F, Ec, 2] realized
                                     #   stochastic [start, end) windows
+    cut_split: Optional[np.ndarray] = None  # int32 [F, Ec] realized
+                                    #   node split per cut
+    spike_windows: Optional[np.ndarray] = None
+                                    # int32 [F, Es, 3] realized
+                                    #   stochastic_spike (start, end,
+                                    #   extra) triples
+    lat_percentiles: Optional[np.ndarray] = None
+                                    # int32 [F, 3] per-trial finality-
+                                    #   latency (p50, p99, p999); the
+                                    #   backlog model's traffic plane
+    arrived: Optional[np.ndarray] = None  # int32 [F] units arrived
     p_violation: float = 0.0
     violation_ci: Tuple[float, float] = (0.0, 0.0)
     p_settled: float = 0.0
@@ -317,7 +395,7 @@ class FleetResult:
 
     def summary(self) -> Dict:
         """The phase-diagram JSONL row body (docs/observability.md)."""
-        return {
+        row = {
             "model": self.model,
             "fleet": self.fleet,
             "rounds": self.rounds,
@@ -333,6 +411,47 @@ class FleetResult:
             "finalized_fraction_mean": round(
                 float(self.finalized_fraction.mean()), 6),
         }
+        if self.lat_percentiles is not None:
+            # Capacity-planning view (backlog model, traffic plane on):
+            # per-trial nearest-rank percentiles reduced across the
+            # fleet — the SLO claim is usually about lat_p99_max (the
+            # worst trial must still meet the SLO).  Trials that
+            # settled NOTHING within the horizon carry the -1 empty-
+            # histogram sentinel; they are excluded from the latency
+            # reduction (lat_trials records how many counted — an
+            # overload point with lat_trials < fleet is itself an SLO
+            # failure signal, never a deflated mean).
+            lp = self.lat_percentiles
+            valid = lp[:, 0] >= 0
+            row["lat_trials"] = int(valid.sum())
+            if valid.any():
+                lv = lp[valid]
+                row.update({
+                    "lat_p50_mean": round(float(lv[:, 0].mean()), 3),
+                    "lat_p99_mean": round(float(lv[:, 1].mean()), 3),
+                    "lat_p999_mean": round(float(lv[:, 2].mean()), 3),
+                    "lat_p99_max": int(lv[:, 1].max()),
+                })
+            else:
+                row.update({"lat_p50_mean": None, "lat_p99_mean": None,
+                            "lat_p999_mean": None, "lat_p99_max": None})
+            row["arrived_mean"] = round(float(self.arrived.mean()), 3)
+        return row
+
+    def realizations(self) -> Dict:
+        """JSON-ready per-trial stochastic fault realizations for the
+        phase-diagram row: ``{"cut": [[[start, end, split], ...] per
+        trial], "spike": [[[start, end, extra], ...] per trial]}``;
+        {} when the script schedules no stochastic events."""
+        out: Dict = {}
+        if self.cut_windows is not None and self.cut_windows.shape[1]:
+            cuts = np.concatenate(
+                [self.cut_windows,
+                 self.cut_split[:, :, None]], axis=2)
+            out["cut"] = cuts.astype(int).tolist()
+        if self.spike_windows is not None and self.spike_windows.shape[1]:
+            out["spike"] = self.spike_windows.astype(int).tolist()
+        return out
 
 
 def run_fleet(
@@ -346,6 +465,7 @@ def run_fleet(
     conflict_size: int = 2,
     yes_fraction: float = 0.5,
     contested: bool = True,
+    window: int = 64,
 ) -> FleetResult:
     """Run `fleet` independent trials of one config point as ONE
     vmapped program; reduce to Wilson-CI estimates.
@@ -354,11 +474,28 @@ def run_fleet(
     is deterministic in (config, seed, fleet) and trials never share a
     stream.  `contested` (avalanche only) seeds per-node 50/50 priors
     from each trial's key — the convergence workload; `yes_fraction`
-    is the snowball prior.
+    is the snowball prior; `window` (backlog only) is the streaming
+    working-set slot count — with `cfg.arrivals_enabled()` each trial
+    realizes its own arrival stream and reports finality-latency
+    percentiles, which is what lets a phase grid sweep OFFERED LOAD
+    (`arrival_rate`) into a capacity diagram.
     """
     if model not in FLEET_MODELS:
         raise ValueError(f"fleet models are {', '.join(FLEET_MODELS)}, "
                          f"got {model!r}")
+    if cfg.arrivals_enabled() and model != "backlog":
+        raise ValueError(
+            f"the live-traffic arrival plane only threads through the "
+            f"backlog fleet model; with model {model!r} the arrival "
+            f"config is inert and every trial would be mislabeled "
+            f"'{cfg.arrival_mode}-arrival'")
+    if cfg.arrival_mode == "external":
+        raise ValueError(
+            "arrival_mode 'external' has no push path inside the "
+            "vmapped fleet program (arrivals come only from "
+            "traffic.push_arrivals) — every trial would run an empty "
+            "stream and report nothing settled; use a schedule mode "
+            "for fleet offered-load sweeps")
     if fleet < 1:
         raise ValueError(f"fleet must be >= 1, got {fleet}")
     if cfg.metrics_every > 0:
@@ -373,22 +510,37 @@ def run_fleet(
     keys = jax.random.split(jax.random.key(seed), fleet)
     outcome, telemetry = _compiled_fleet(
         model, cfg, int(n_nodes), int(n_txs), int(n_rounds),
-        int(conflict_size), float(yes_fraction), bool(contested))(keys)
+        int(conflict_size), float(yes_fraction), bool(contested),
+        int(window))(keys)
     violations = np.asarray(jax.device_get(outcome.violation))
     settled = np.asarray(jax.device_get(outcome.settled))
     finality = np.asarray(jax.device_get(outcome.finality_round))
     frac = np.asarray(jax.device_get(outcome.finalized_fraction))
-    cut_windows = None
+    cut_windows = cut_split = spike_windows = None
     if outcome.cut_start is not None:
         cut_windows = np.stack(
             [np.asarray(jax.device_get(outcome.cut_start)),
              np.asarray(jax.device_get(outcome.cut_end))], axis=-1)
+        cut_split = np.asarray(jax.device_get(outcome.cut_split))
+        spike_windows = np.stack(
+            [np.asarray(jax.device_get(outcome.spike_start)),
+             np.asarray(jax.device_get(outcome.spike_end)),
+             np.asarray(jax.device_get(outcome.spike_extra))], axis=-1)
+    lat_percentiles = arrived = None
+    if outcome.lat_p50 is not None:
+        lat_percentiles = np.stack(
+            [np.asarray(jax.device_get(outcome.lat_p50)),
+             np.asarray(jax.device_get(outcome.lat_p99)),
+             np.asarray(jax.device_get(outcome.lat_p999))], axis=-1)
+        arrived = np.asarray(jax.device_get(outcome.arrived))
 
     res = FleetResult(
         model=model, fleet=fleet, rounds=n_rounds,
         violations=violations, settled=settled, finality_round=finality,
         finalized_fraction=frac, telemetry=jax.device_get(telemetry),
-        cut_windows=cut_windows,
+        cut_windows=cut_windows, cut_split=cut_split,
+        spike_windows=spike_windows,
+        lat_percentiles=lat_percentiles, arrived=arrived,
         p_violation=float(violations.mean()),
         violation_ci=wilson_interval(int(violations.sum()), fleet),
         p_settled=float(settled.mean()),
@@ -438,6 +590,7 @@ _GRID_AXES = {
     "churn_probability": float,
     "latency_rounds": int,
     "adversary_strategy": str,
+    "arrival_rate": float,
 }
 
 
@@ -514,13 +667,16 @@ def run_phase_grid(
     conflict_size: int = 2,
     yes_fraction: float = 0.5,
     contested: bool = True,
+    window: int = 64,
     sink=None,
 ) -> List[Dict]:
     """Sweep a phase grid: one `run_fleet` per cartesian point (re-jit
     per point — the config is jit-static), returning one summary row
     per point and streaming each to `sink` (an `obs.MetricsSink`) as it
     lands — the phase-diagram JSONL, each row carrying its `point`,
-    the fleet estimates, and the point config's `tag_from_config` tag.
+    the fleet estimates, the per-trial REALIZED stochastic fault
+    schedules (`FleetResult.realizations`; absent without stochastic
+    events), and the point config's `tag_from_config` tag.
     """
     from go_avalanche_tpu.obs import tag_from_config
 
@@ -535,15 +691,34 @@ def run_phase_grid(
             "a latency_rounds phase axis needs the base config's "
             "latency_mode set (it is 'none', under which the knob is "
             "inert — every point would measure the same program)")
+    if any("arrival_rate" in p for p in points):
+        # Same inert-knob class as latency_rounds: fail with the
+        # sweep-level message before the first point compiles.
+        if not base_cfg.arrivals_enabled():
+            raise ValueError(
+                "an arrival_rate phase axis needs the base config's "
+                "arrival_mode set (it is 'off', under which the knob is "
+                "inert — offered-load sweeps need a live-traffic "
+                "schedule)")
+        if model != "backlog":
+            raise ValueError(
+                f"an arrival_rate phase axis needs the backlog fleet "
+                f"model (the traffic plane is not threaded through "
+                f"{model!r} — every point would measure the same "
+                f"program)")
     rows = []
     for point in points:
         cfg = point_config(base_cfg, point)
         res = run_fleet(model, cfg, fleet, n_nodes, n_txs=n_txs,
                         n_rounds=n_rounds, seed=seed,
                         conflict_size=conflict_size,
-                        yes_fraction=yes_fraction, contested=contested)
+                        yes_fraction=yes_fraction, contested=contested,
+                        window=window)
         row = {"point": point, **res.summary(),
                "tag": tag_from_config(cfg)}
+        realized = res.realizations()
+        if realized:
+            row["realizations"] = realized
         rows.append(row)
         if sink is not None:
             sink.write(row)

@@ -28,14 +28,17 @@ Design notes (TPU-first):
 
 from __future__ import annotations
 
+import dataclasses
 from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from go_avalanche_tpu import traffic as tf
 from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
 from go_avalanche_tpu.models import avalanche as av
+from go_avalanche_tpu.obs import sink as obs_sink
 from go_avalanche_tpu.ops import inflight
 from go_avalanche_tpu.ops import voterecord as vr
 
@@ -73,6 +76,16 @@ class BacklogSimState(NamedTuple):
     backlog: Backlog           # [B]
     outputs: BacklogOutputs    # [B]
     next_idx: jax.Array        # int32 — next unadmitted backlog position
+    traffic: Optional[tf.TrafficState] = None
+                               # live-traffic plane (go_avalanche_tpu/
+                               #   traffic.py) — present iff
+                               #   cfg.arrivals_enabled(): admission is
+                               #   gated on the arrived watermark and
+                               #   retiring slots record arrival ->
+                               #   settle latency.  None = the
+                               #   drain-a-fixed-backlog seed path,
+                               #   statically absent from every
+                               #   compiled program
 
 
 def make_backlog(
@@ -125,6 +138,7 @@ def init(
             admit_round=jnp.full((b,), -1, jnp.int32),
         ),
         next_idx=jnp.int32(0),
+        traffic=tf.init_traffic(cfg, key, b),
     )
 
 
@@ -188,11 +202,22 @@ def _retire_and_refill(
                                                 mode="drop"),
     )
 
+    # --- live traffic: retiring slots record arrival -> settle latency
+    # into the fixed-depth histogram; admission below is gated on the
+    # arrived watermark (a tx cannot be admitted before it arrives).
+    traffic = state.traffic
+    if traffic is not None:
+        arr = traffic.arrival_round[jnp.clip(state.slot_tx, 0, b - 1)]
+        traffic = traffic._replace(lat_hist=traffic.lat_hist + tf.latency_delta(
+            cfg, sim.round - arr, settled.astype(jnp.int32)))
+
     # --- refill: free slots take the next backlog txs in admission order.
     free = settled | (state.slot_tx == NO_TX)
     rank = jnp.cumsum(free.astype(jnp.int32)) - 1        # rank among free
     cand = state.next_idx + rank                          # backlog position
-    take = free & (cand < b)
+    avail = b if traffic is None else jnp.minimum(jnp.int32(b),
+                                                  traffic.arrived_idx)
+    take = free & (cand < avail)
     if not refill:
         take = jnp.zeros_like(take)
     new_tx = jnp.where(take, cand, jnp.where(settled, NO_TX, state.slot_tx))
@@ -246,6 +271,7 @@ def _retire_and_refill(
         backlog=state.backlog,
         outputs=out,
         next_idx=state.next_idx + n_taken,
+        traffic=traffic,
     ), settled.sum().astype(jnp.int32)
 
 
@@ -256,22 +282,48 @@ class BacklogTelemetry(NamedTuple):
     retired: jax.Array    # int32 — slots retired this step
     occupied: jax.Array   # int32 — occupied slots after refill
     backlog_left: jax.Array  # int32 — txs not yet admitted
+    traffic: Optional[tf.TrafficTelemetry] = None
+                          # arrival counters + finality-latency
+                          #   percentiles; None (absent from the JSONL
+                          #   schema) when arrivals are off
 
 
 def step(
     state: BacklogSimState,
     cfg: AvalancheConfig = DEFAULT_CONFIG,
 ) -> Tuple[BacklogSimState, BacklogTelemetry]:
-    """Retire/refill, then one consensus round on the window. Pure; scans."""
+    """Arrive (traffic mode), retire/refill, then one consensus round on
+    the window. Pure; scans.
+
+    With the in-graph metrics tap on (`cfg.metrics_every > 0`) the
+    SCHEDULER emits the full `BacklogTelemetry` record — inner round
+    counters, retire/occupancy stats, and the traffic plane's
+    finality-latency percentiles — and suppresses the inner round's own
+    emission so each round writes exactly one JSONL line
+    (docs/observability.md).
+    """
+    round_val = state.sim.round
+    arrivals = jnp.int32(0)
+    if state.traffic is not None:
+        new_traffic, arrivals = tf.arrive(
+            state.traffic, cfg, round_val,
+            (state.slot_tx != NO_TX).sum().astype(jnp.int32),
+            state.slot_tx.shape[0])
+        state = state._replace(traffic=new_traffic)
     state, retired = _retire_and_refill(state, cfg)
-    new_sim, round_tel = av.round_step(state.sim, cfg)
+    inner_cfg = (cfg if cfg.metrics_every == 0
+                 else dataclasses.replace(cfg, metrics_every=0))
+    new_sim, round_tel = av.round_step(state.sim, inner_cfg)
     new_state = state._replace(sim=new_sim)
     tel = BacklogTelemetry(
         round=round_tel,
         retired=retired,
         occupied=(state.slot_tx != NO_TX).sum().astype(jnp.int32),
         backlog_left=state.backlog.score.shape[0] - state.next_idx,
+        traffic=(None if state.traffic is None
+                 else tf.traffic_telemetry(state.traffic, arrivals)),
     )
+    obs_sink.emit_round(cfg, round_val, tel)
     return new_state, tel
 
 

@@ -41,16 +41,19 @@ outcomes record the network-majority winner per set.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from go_avalanche_tpu import traffic as tf
 from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
 from go_avalanche_tpu.models import avalanche as av
 from go_avalanche_tpu.models import dag as dag_model
 from go_avalanche_tpu.models.backlog import NO_TX
+from go_avalanche_tpu.obs import sink as obs_sink
 from go_avalanche_tpu.ops import inflight
 from go_avalanche_tpu.ops import voterecord as vr
 
@@ -89,6 +92,15 @@ class StreamingDagState(NamedTuple):
     backlog: SetBacklog         # [S_b, c]
     outputs: SetOutputs         # [S_b, c]
     next_idx: jax.Array         # int32 — next unadmitted backlog set
+    traffic: Optional[tf.TrafficState] = None
+                                # live-traffic plane (go_avalanche_tpu/
+                                #   traffic.py) at SET granularity —
+                                #   present iff cfg.arrivals_enabled():
+                                #   admission gated on the arrived
+                                #   watermark; a retiring set records
+                                #   one latency sample per VALID member
+                                #   tx.  None = the seed drain path,
+                                #   statically absent
 
 
 def set_capacity(state: StreamingDagState) -> int:
@@ -163,6 +175,7 @@ def init(
             admit_round=zeros - 1,
         ),
         next_idx=jnp.int32(0),
+        traffic=tf.init_traffic(cfg, key, s_b),
     )
 
 
@@ -234,6 +247,19 @@ def _retire_and_refill(
     else:
         free = settled | empty
 
+    # --- live traffic: a retiring set records one latency sample per
+    # VALID member tx at the set's arrival -> settle latency; admission
+    # below is gated on the arrived watermark.
+    traffic = state.traffic
+    if traffic is not None:
+        rows_safe = jnp.clip(state.slot_set, 0, s_b - 1)
+        lat = base.round - traffic.arrival_round[rows_safe]
+        members = state.backlog.valid[rows_safe].sum(axis=1).astype(
+            jnp.int32)
+        traffic = traffic._replace(
+            lat_hist=traffic.lat_hist + tf.latency_delta(
+                cfg, lat, jnp.where(settled, members, 0)))
+
     # --- retire: member outcomes at the retiring sets' backlog rows.
     conf = base.records.confidence
     fin_acc = vr.has_finalized(conf, cfg) & vr.is_accepted(conf)
@@ -261,7 +287,9 @@ def _retire_and_refill(
     # --- refill: free set-slots take the next backlog sets in order.
     rank = jnp.cumsum(free.astype(jnp.int32)) - 1
     cand = state.next_idx + rank
-    take = free & (cand < s_b)
+    avail = s_b if traffic is None else jnp.minimum(jnp.int32(s_b),
+                                                    traffic.arrived_idx)
+    take = free & (cand < avail)
     if not refill:   # end-of-run harvest: record outcomes, admit nothing
         take = jnp.zeros_like(take)
     new_set = jnp.where(take, cand, jnp.where(settled, NO_SET,
@@ -371,6 +399,7 @@ def _retire_and_refill(
         backlog=state.backlog,
         outputs=out,
         next_idx=state.next_idx + n_taken,
+        traffic=traffic,
     ), settled.sum().astype(jnp.int32)
 
 
@@ -381,21 +410,45 @@ class StreamingDagTelemetry(NamedTuple):
     retired_sets: jax.Array   # int32 — set-slots retired this step
     occupied_sets: jax.Array  # int32 — occupied set-slots after refill
     backlog_left: jax.Array   # int32 — sets not yet admitted
+    traffic: Optional[tf.TrafficTelemetry] = None
+                              # arrival counters + finality-latency
+                              #   percentiles; None (absent from the
+                              #   JSONL schema) when arrivals are off
 
 
 def step(
     state: StreamingDagState,
     cfg: AvalancheConfig = DEFAULT_CONFIG,
 ) -> Tuple[StreamingDagState, StreamingDagTelemetry]:
-    """Retire/refill at set granularity, then one conflict round."""
+    """Arrive (traffic mode), retire/refill at set granularity, then one
+    conflict round.
+
+    With the in-graph metrics tap on the SCHEDULER emits the full
+    `StreamingDagTelemetry` record and suppresses the inner round's own
+    emission, so each round writes exactly one JSONL line
+    (docs/observability.md) — same contract as `models/backlog.step`.
+    """
+    round_val = state.dag.base.round
+    arrivals = jnp.int32(0)
+    if state.traffic is not None:
+        new_traffic, arrivals = tf.arrive(
+            state.traffic, cfg, round_val,
+            (state.slot_set != NO_SET).sum().astype(jnp.int32),
+            state.slot_set.shape[0])
+        state = state._replace(traffic=new_traffic)
     state, retired = _retire_and_refill(state, cfg)
-    new_dag, round_tel = dag_model.round_step(state.dag, cfg)
+    inner_cfg = (cfg if cfg.metrics_every == 0
+                 else dataclasses.replace(cfg, metrics_every=0))
+    new_dag, round_tel = dag_model.round_step(state.dag, inner_cfg)
     tel = StreamingDagTelemetry(
         round=round_tel,
         retired_sets=retired,
         occupied_sets=(state.slot_set != NO_SET).sum().astype(jnp.int32),
         backlog_left=state.backlog.score.shape[0] - state.next_idx,
+        traffic=(None if state.traffic is None
+                 else tf.traffic_telemetry(state.traffic, arrivals)),
     )
+    obs_sink.emit_round(cfg, round_val, tel)
     return state._replace(dag=new_dag), tel
 
 

@@ -46,9 +46,14 @@ _ACTIVE: list["MetricsSink"] = []   # stack; innermost (last) receives
 def _flatten_telemetry(tel, out: dict) -> dict:
     """Flatten (possibly nested) telemetry NamedTuples into one flat
     dict by leaf field name — `BacklogTelemetry.round` (a SimTelemetry)
-    contributes its own field names, not a 'round' key."""
+    contributes its own field names, not a 'round' key.  None fields
+    (statically absent planes, e.g. `BacklogTelemetry.traffic` with
+    arrivals off) are skipped, so the JSONL schema only ever carries
+    fields the run computed."""
     for name in tel._fields:
         v = getattr(tel, name)
+        if v is None:
+            continue
         if hasattr(v, "_fields"):
             _flatten_telemetry(v, out)
         else:

@@ -29,6 +29,11 @@ fixed order:
                                untagged (no archived chain used one)
     ", {engine}-inflight"      cfg.inflight_engine != "walk"
     ", partition"              cfg.partition_spec scheduled
+    ", {mode}-arrival{R}"      cfg.arrivals_enabled() (the live-traffic
+                               plane changes the timed program; R =
+                               arrival_rate, %g-formatted)
+    ", backpressure"           cfg.arrival_backpressure set (closed-loop
+                               admission throttles the offered rate)
     ", metrics{N}"             cfg.metrics_every > 0 (the in-graph tap
                                changes the timed program)
 """
@@ -73,6 +78,10 @@ def tag_from_config(cfg: AvalancheConfig) -> str:
             tag += f", {cfg.inflight_engine}-inflight"
         if cfg.partition_spec is not None:
             tag += ", partition"
+    if cfg.arrivals_enabled():
+        tag += f", {cfg.arrival_mode}-arrival{cfg.arrival_rate:g}"
+        if cfg.arrival_backpressure is not None:
+            tag += ", backpressure"
     if cfg.metrics_every > 0:
         tag += f", metrics{cfg.metrics_every}"
     return tag

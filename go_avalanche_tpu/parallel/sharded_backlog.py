@@ -35,6 +35,7 @@ from jax import lax
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from go_avalanche_tpu import traffic as tf
 from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
 from go_avalanche_tpu.models import avalanche as av
 from go_avalanche_tpu.models.backlog import (
@@ -50,9 +51,19 @@ from go_avalanche_tpu.parallel import sharded
 from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS, shard_map
 
 
+def _traffic_specs(with_traffic: bool):
+    """Replicated (`P()`) specs for the live-traffic plane — the draw is
+    identical on every shard, like the backlog metadata it gates."""
+    if not with_traffic:
+        return None
+    return tf.TrafficState(key=P(), arrived_idx=P(), arrival_round=P(),
+                           lat_hist=P())
+
+
 def backlog_state_specs(track_finality: bool = True,
                         with_inflight: bool = False,
-                        with_fault_params: bool = False) -> BacklogSimState:
+                        with_fault_params: bool = False,
+                        with_traffic: bool = False) -> BacklogSimState:
     """PartitionSpecs for every leaf of `BacklogSimState`."""
     return BacklogSimState(
         sim=sharded.state_specs(track_finality, with_inflight,
@@ -63,6 +74,7 @@ def backlog_state_specs(track_finality: bool = True,
         outputs=BacklogOutputs(settled=P(), accepted=P(), accept_votes=P(),
                                settle_round=P(), admit_round=P()),
         next_idx=P(),
+        traffic=_traffic_specs(with_traffic),
     )
 
 
@@ -76,7 +88,8 @@ def shard_backlog_state(state: BacklogSimState, mesh) -> BacklogSimState:
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
         state, backlog_state_specs(state.sim.finalized_at is not None,
                                    state.sim.inflight is not None,
-                                   state.sim.fault_params is not None))
+                                   state.sim.fault_params is not None,
+                                   state.traffic is not None))
 
 
 def _merge_write(old, idx, value, b):
@@ -148,6 +161,18 @@ def _local_retire_and_refill(
                                  state.slot_admit_round, b),
     )
 
+    # --- live traffic: per-shard latency deltas psum'd over the txs
+    # axis (each slot lives in exactly one tx shard; integer adds, so
+    # the replicated histogram matches the dense one bit-for-bit), and
+    # admission gated on the replicated arrived watermark.
+    traffic = state.traffic
+    if traffic is not None:
+        arr = traffic.arrival_round[jnp.clip(state.slot_tx, 0, b - 1)]
+        delta = tf.latency_delta(cfg, sim.round - arr,
+                                 settled.astype(jnp.int32))
+        traffic = traffic._replace(
+            lat_hist=traffic.lat_hist + lax.psum(delta, TXS_AXIS))
+
     # --- refill: global admission rank = exclusive prefix over tx shards.
     free = settled | (state.slot_tx == NO_TX)
     count_local = free.sum().astype(jnp.int32)
@@ -157,7 +182,9 @@ def _local_retire_and_refill(
                        counts, 0).sum()
     rank = prefix + jnp.cumsum(free.astype(jnp.int32)) - 1
     cand = state.next_idx + rank
-    take = free & (cand < b)
+    avail = b if traffic is None else jnp.minimum(jnp.int32(b),
+                                                  traffic.arrived_idx)
+    take = free & (cand < avail)
     if not refill:   # end-of-run harvest: record outcomes, admit nothing
         take = jnp.zeros_like(take)
     new_tx = jnp.where(take, cand, jnp.where(settled, NO_TX, state.slot_tx))
@@ -211,6 +238,7 @@ def _local_retire_and_refill(
         backlog=state.backlog,
         outputs=out,
         next_idx=state.next_idx + n_taken,
+        traffic=traffic,
     ), retired
 
 
@@ -220,6 +248,18 @@ def _local_step(
     n_global: int,
     n_tx_shards: int,
 ) -> Tuple[BacklogSimState, BacklogTelemetry]:
+    arrivals = jnp.int32(0)
+    if state.traffic is not None:
+        # The draw is on replicated state with the GLOBAL occupancy
+        # (psum over tx shards), so every shard realizes the dense
+        # arrival sequence bit-for-bit (tests/test_traffic.py).
+        w_local = state.slot_tx.shape[0]
+        occ = lax.psum((state.slot_tx != NO_TX).sum().astype(jnp.int32),
+                       TXS_AXIS)
+        new_traffic, arrivals = tf.arrive(state.traffic, cfg,
+                                          state.sim.round, occ,
+                                          w_local * n_tx_shards)
+        state = state._replace(traffic=new_traffic)
     state, retired = _local_retire_and_refill(state, cfg)
     new_sim, round_tel = sharded._local_round(state.sim, cfg, n_global,
                                               n_tx_shards)
@@ -230,20 +270,26 @@ def _local_step(
         retired=retired,
         occupied=occupied,
         backlog_left=state.backlog.score.shape[0] - state.next_idx,
+        traffic=(None if state.traffic is None
+                 else tf.traffic_telemetry(state.traffic, arrivals)),
     )
     return state._replace(sim=new_sim), tel
 
 
 def _shard_mapped(mesh, fn, with_tel=True, track_finality: bool = True,
                   with_inflight: bool = False,
-                  with_fault_params: bool = False):
+                  with_fault_params: bool = False,
+                  with_traffic: bool = False):
     specs = backlog_state_specs(track_finality, with_inflight,
-                                with_fault_params)
+                                with_fault_params, with_traffic)
     if with_tel:
         tel_specs = BacklogTelemetry(
             round=av.SimTelemetry(
                 *([P()] * len(av.SimTelemetry._fields))),
-            retired=P(), occupied=P(), backlog_left=P())
+            retired=P(), occupied=P(), backlog_left=P(),
+            traffic=(tf.TrafficTelemetry(
+                *([P()] * len(tf.TrafficTelemetry._fields)))
+                if with_traffic else None))
         out_specs = (specs, tel_specs)
     else:
         out_specs = specs
@@ -263,14 +309,16 @@ def make_sharded_backlog_step(mesh, cfg: AvalancheConfig = DEFAULT_CONFIG,
         track = state.sim.finalized_at is not None
         asyncq = state.sim.inflight is not None
         fparams = state.sim.fault_params is not None
-        if (n_global, track, asyncq, fparams) not in cache:
-            cache[(n_global, track, asyncq, fparams)] = jax.jit(
+        arriv = state.traffic is not None
+        key = (n_global, track, asyncq, fparams, arriv)
+        if key not in cache:
+            cache[key] = jax.jit(
                 _shard_mapped(
                     mesh, lambda s: _local_step(s, cfg, n_global, n_tx),
                     track_finality=track, with_inflight=asyncq,
-                    with_fault_params=fparams),
+                    with_fault_params=fparams, with_traffic=arriv),
                 donate_argnums=sharded._donate(donate))
-        return cache[(n_global, track, asyncq, fparams)](state)
+        return cache[key](state)
 
     return step
 
@@ -296,7 +344,8 @@ def run_scan_sharded_backlog(
         mesh, local_scan,
         track_finality=state.sim.finalized_at is not None,
         with_inflight=state.sim.inflight is not None,
-        with_fault_params=state.sim.fault_params is not None),
+        with_fault_params=state.sim.fault_params is not None,
+        with_traffic=state.traffic is not None),
         donate_argnums=sharded._donate(donate))(state)
 
 
@@ -340,5 +389,6 @@ def run_sharded_backlog(
         mesh, local_run, with_tel=False,
         track_finality=state.sim.finalized_at is not None,
         with_inflight=state.sim.inflight is not None,
-        with_fault_params=state.sim.fault_params is not None),
+        with_fault_params=state.sim.fault_params is not None,
+        with_traffic=state.traffic is not None),
         donate_argnums=sharded._donate(donate))(state)

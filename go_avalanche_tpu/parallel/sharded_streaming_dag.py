@@ -42,6 +42,7 @@ from jax import lax
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from go_avalanche_tpu import traffic as tf
 from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
 from go_avalanche_tpu.models import avalanche as av
 from go_avalanche_tpu.models import dag as dag_model
@@ -56,6 +57,7 @@ from go_avalanche_tpu.ops import inflight
 from go_avalanche_tpu.ops import voterecord as vr
 from go_avalanche_tpu.parallel import sharded, sharded_dag
 from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS, shard_map
+from go_avalanche_tpu.parallel.sharded_backlog import _traffic_specs
 
 
 def streaming_dag_state_specs(n_sets: int,
@@ -63,6 +65,7 @@ def streaming_dag_state_specs(n_sets: int,
                               track_finality: bool = True,
                               with_inflight: bool = False,
                               with_fault_params: bool = False,
+                              with_traffic: bool = False,
                               ) -> StreamingDagState:
     """PartitionSpecs for every leaf of `StreamingDagState`."""
     return StreamingDagState(
@@ -74,6 +77,7 @@ def streaming_dag_state_specs(n_sets: int,
         outputs=SetOutputs(settled=P(), accepted=P(), accept_votes=P(),
                            settle_round=P(), admit_round=P()),
         next_idx=P(),
+        traffic=_traffic_specs(with_traffic),
     )
 
 
@@ -104,7 +108,8 @@ def shard_streaming_dag_state(state: StreamingDagState,
             state.dag.n_sets, state.dag.set_size,
             state.dag.base.finalized_at is not None,
             state.dag.base.inflight is not None,
-            state.dag.base.fault_params is not None))
+            state.dag.base.fault_params is not None,
+            state.traffic is not None))
 
 
 def _merge_rows(old, row_idx, rows, s_b):
@@ -187,6 +192,20 @@ def _local_retire_and_refill(
     else:
         free = settled | empty
 
+    # --- live traffic: per-shard member-weighted latency deltas psum'd
+    # over the txs axis (each set lives in exactly one tx shard;
+    # integer adds, so the replicated histogram matches the dense one
+    # bit-for-bit); admission gated on the replicated watermark.
+    traffic = state.traffic
+    if traffic is not None:
+        rows_safe = jnp.clip(state.slot_set, 0, s_b - 1)
+        lat = base.round - traffic.arrival_round[rows_safe]
+        members = state.backlog.valid[rows_safe].sum(axis=1).astype(
+            jnp.int32)
+        delta = tf.latency_delta(cfg, lat, jnp.where(settled, members, 0))
+        traffic = traffic._replace(
+            lat_hist=traffic.lat_hist + lax.psum(delta, TXS_AXIS))
+
     # --- retire: member outcomes; node-axis sums via psum so every node
     # shard computes identical [w_local] planes.
     conf = base.records.confidence
@@ -222,7 +241,9 @@ def _local_retire_and_refill(
                        counts, 0).sum()
     rank = prefix + jnp.cumsum(free.astype(jnp.int32)) - 1
     cand = state.next_idx + rank
-    take = free & (cand < s_b)
+    avail = s_b if traffic is None else jnp.minimum(jnp.int32(s_b),
+                                                    traffic.arrived_idx)
+    take = free & (cand < avail)
     if not refill:   # end-of-run harvest
         take = jnp.zeros_like(take)
     new_set = jnp.where(take, cand, jnp.where(settled, NO_SET,
@@ -323,6 +344,7 @@ def _local_retire_and_refill(
         backlog=state.backlog,
         outputs=out,
         next_idx=state.next_idx + n_taken,
+        traffic=traffic,
     ), retired
 
 
@@ -333,6 +355,17 @@ def _local_step(
     n_global: int,
     n_tx_shards: int,
 ) -> Tuple[StreamingDagState, StreamingDagTelemetry]:
+    arrivals = jnp.int32(0)
+    if state.traffic is not None:
+        # Replicated draw with the GLOBAL set-slot occupancy — every
+        # shard realizes the dense arrival sequence bit-for-bit.
+        s_w_local = state.slot_set.shape[0]
+        occ = lax.psum((state.slot_set != NO_SET).sum().astype(jnp.int32),
+                       TXS_AXIS)
+        new_traffic, arrivals = tf.arrive(state.traffic, cfg,
+                                          state.dag.base.round, occ,
+                                          s_w_local * n_tx_shards)
+        state = state._replace(traffic=new_traffic)
     state, retired = _local_retire_and_refill(state, cfg, c)
     new_dag, round_tel = sharded_dag._local_round(state.dag, cfg, n_global,
                                                   n_tx_shards)
@@ -343,6 +376,8 @@ def _local_step(
         retired_sets=retired,
         occupied_sets=occupied,
         backlog_left=state.backlog.score.shape[0] - state.next_idx,
+        traffic=(None if state.traffic is None
+                 else tf.traffic_telemetry(state.traffic, arrivals)),
     )
     return state._replace(dag=new_dag), tel
 
@@ -350,13 +385,18 @@ def _local_step(
 def _shard_mapped(mesh, n_sets: int, fn, with_tel=True, set_size=None,
                   track_finality: bool = True,
                   with_inflight: bool = False,
-                  with_fault_params: bool = False):
+                  with_fault_params: bool = False,
+                  with_traffic: bool = False):
     specs = streaming_dag_state_specs(n_sets, set_size, track_finality,
-                                      with_inflight, with_fault_params)
+                                      with_inflight, with_fault_params,
+                                      with_traffic)
     if with_tel:
         tel_specs = StreamingDagTelemetry(
             round=av.SimTelemetry(*([P()] * len(av.SimTelemetry._fields))),
-            retired_sets=P(), occupied_sets=P(), backlog_left=P())
+            retired_sets=P(), occupied_sets=P(), backlog_left=P(),
+            traffic=(tf.TrafficTelemetry(
+                *([P()] * len(tf.TrafficTelemetry._fields)))
+                if with_traffic else None))
         out_specs = (specs, tel_specs)
     else:
         out_specs = specs
@@ -378,14 +418,16 @@ def make_sharded_streaming_dag_step(mesh,
                state.dag.set_size,
                state.dag.base.finalized_at is not None,
                state.dag.base.inflight is not None,
-               state.dag.base.fault_params is not None)
+               state.dag.base.fault_params is not None,
+               state.traffic is not None)
         if key not in cache:
             n_global = key[0]
             cache[key] = jax.jit(_shard_mapped(
                 mesh, state.dag.n_sets,
                 lambda s: _local_step(s, cfg, c, n_global, n_tx),
                 set_size=state.dag.set_size, track_finality=key[4],
-                with_inflight=key[5], with_fault_params=key[6]),
+                with_inflight=key[5], with_fault_params=key[6],
+                with_traffic=key[7]),
                 donate_argnums=sharded._donate(donate))
         return cache[key](state)
 
@@ -435,7 +477,8 @@ def run_sharded_streaming_dag(
                        is not None,
                        with_inflight=state.dag.base.inflight is not None,
                        with_fault_params=(state.dag.base.fault_params
-                                          is not None))
+                                          is not None),
+                       with_traffic=state.traffic is not None)
     return jax.jit(fn, donate_argnums=sharded._donate(donate))(state)
 
 
@@ -461,5 +504,6 @@ def run_scan_sharded_streaming_dag(
         mesh, state.dag.n_sets, local_scan, set_size=state.dag.set_size,
         track_finality=state.dag.base.finalized_at is not None,
         with_inflight=state.dag.base.inflight is not None,
-        with_fault_params=state.dag.base.fault_params is not None),
+        with_fault_params=state.dag.base.fault_params is not None,
+        with_traffic=state.traffic is not None),
         donate_argnums=sharded._donate(donate))(state)

@@ -99,6 +99,14 @@ def build_config(args: argparse.Namespace) -> AvalancheConfig:
     return AvalancheConfig(
         finalization_score=args.finalization_score,
         max_element_poll=args.max_element_poll,
+        arrival_mode=getattr(args, "arrival_mode", "off"),
+        arrival_rate=getattr(args, "arrival_rate", 0.0),
+        arrival_period=getattr(args, "arrival_period", 0),
+        arrival_burst_factor=getattr(args, "arrival_burst_factor", 1.0),
+        arrival_duty=getattr(args, "arrival_duty", 0.5),
+        arrival_depth=getattr(args, "arrival_depth", 0.0),
+        arrival_backpressure=getattr(args, "arrival_backpressure_parsed",
+                                     None),
         latency_mode=args.latency_mode,
         latency_rounds=args.latency_rounds,
         partition_spec=partition,
@@ -359,11 +367,14 @@ def run_streaming_dag(args, cfg: AvalancheConfig) -> Dict:
     else:
         final = jax.jit(sdg.run, static_argnames=("cfg", "max_rounds"))(
             state, cfg, args.max_rounds)
+    from go_avalanche_tpu import traffic as tf
+
     out = {
         "rounds": int(jax.device_get(final.dag.base.round)),
         "window_sets": args.slots,
         "conflict_sets": n_sets,
         **sdg.resolution_summary(final),
+        **tf.latency_percentiles(final.traffic),
     }
     return out
 
@@ -387,6 +398,8 @@ def run_backlog(args, cfg: AvalancheConfig) -> Dict:
     else:
         final = jax.jit(bl.run, static_argnames=("cfg", "max_rounds"))(
             state, cfg, args.max_rounds)
+    from go_avalanche_tpu import traffic as tf
+
     out = jax.device_get(final.outputs)
     settled = np.asarray(out.settled)
     latency = (np.asarray(out.settle_round)
@@ -399,6 +412,7 @@ def run_backlog(args, cfg: AvalancheConfig) -> Dict:
         if settled.any() else None,
         "settle_latency_median": float(np.median(latency))
         if settled.any() else None,
+        **tf.latency_percentiles(final.traffic),
     }
 
 
@@ -417,7 +431,8 @@ def run_fleet_mode(args, cfg: AvalancheConfig) -> Dict:
                   n_rounds=args.max_rounds, seed=args.seed,
                   conflict_size=args.conflict_size,
                   yes_fraction=args.yes_fraction,
-                  contested=args.contested)
+                  contested=args.contested,
+                  window=args.slots)
     if args.phase_grid_parsed is not None:
         rows = fl.run_phase_grid(args.model, cfg,
                                  args.phase_grid_parsed, sink=sink,
@@ -426,6 +441,9 @@ def run_fleet_mode(args, cfg: AvalancheConfig) -> Dict:
                 "grid_rows": rows}
     res = fl.run_fleet(args.model, cfg, **common)
     row = res.summary()
+    realized = res.realizations()
+    if realized:
+        row["realizations"] = realized
     if sink is not None:
         sink.write({**row, "point": {}, "tag": obs.tag_from_config(cfg)})
     return row
@@ -479,6 +497,53 @@ def main(argv=None) -> Dict:
     parser.add_argument("--slots", type=int, default=64,
                         help="backlog: active working-set slots; "
                              "streaming_dag: active working-set SETS")
+    # live-traffic service mode (go_avalanche_tpu/traffic.py)
+    parser.add_argument("--arrival-mode",
+                        choices=["off", "poisson", "bursty", "diurnal",
+                                 "external"],
+                        default="off",
+                        help="live-traffic arrival schedule (streaming "
+                             "models backlog/streaming_dag, dense, "
+                             "--mesh, or --fleet backlog): instead of "
+                             "draining a fully pre-seeded backlog, "
+                             "admission units (txs / conflict sets) "
+                             "ARRIVE per round — 'poisson' at "
+                             "--arrival-rate, 'bursty' with a "
+                             "--arrival-burst-factor peak for the "
+                             "first --arrival-duty of every "
+                             "--arrival-period rounds, 'diurnal' on a "
+                             "--arrival-depth sinusoid, 'external' "
+                             "(arrivals pushed via the Connector "
+                             "SIM_SUBMIT message only).  Finality "
+                             "latency (arrival round -> settle round) "
+                             "is recorded in-graph with p50/p99/p999 "
+                             "percentiles (docs/observability.md).  "
+                             "'off' = the seed drain path, statically "
+                             "absent from every compiled program")
+    parser.add_argument("--arrival-rate", type=float, default=0.0,
+                        help="mean admission units per round (the "
+                             "offered load); > 0 for every schedule "
+                             "except off/external")
+    parser.add_argument("--arrival-period", type=int, default=0,
+                        help="bursty/diurnal: modulation cycle length "
+                             "in rounds (>= 2)")
+    parser.add_argument("--arrival-burst-factor", type=float, default=1.0,
+                        help="bursty: peak rate multiplier (> 1) during "
+                             "the duty window")
+    parser.add_argument("--arrival-duty", type=float, default=0.5,
+                        help="bursty: fraction of each period at the "
+                             "peak, in (0, 1)")
+    parser.add_argument("--arrival-depth", type=float, default=0.0,
+                        help="diurnal: sinusoid modulation depth in "
+                             "[0, 1]")
+    parser.add_argument("--arrival-backpressure", type=str, default=None,
+                        metavar="LO,HI",
+                        help="closed-loop admission control: working-set "
+                             "occupancy fractions — full scheduled rate "
+                             "below LO, fully throttled above HI, "
+                             "linear in between (0 <= LO < HI <= 1); "
+                             "occupancy is the backpressure signal "
+                             "(examples/capacity_planning.py)")
     # fault model
     parser.add_argument("--byzantine", type=float, default=0.0)
     parser.add_argument("--flip-probability", type=float, default=1.0)
@@ -568,9 +633,14 @@ def main(argv=None) -> Dict:
                              "P(safety violation) / P(settled) / "
                              "E(finality round) with Wilson confidence "
                              "intervals.  Models: snowball, avalanche, "
-                             "dag.  With --metrics, streams phase-"
-                             "diagram JSONL rows (one per config "
-                             "point) instead of per-round telemetry")
+                             "dag, backlog (backlog streams --txs "
+                             "through a --slots window per trial and, "
+                             "with --arrival-*, reports per-trial "
+                             "finality-latency percentiles — the "
+                             "offered-load capacity diagram).  With "
+                             "--metrics, streams phase-diagram JSONL "
+                             "rows (one per config point) instead of "
+                             "per-round telemetry")
     parser.add_argument("--phase-grid", type=str, default=None,
                         metavar="JSON",
                         help="with --fleet: sweep a config-axis grid — "
@@ -652,8 +722,10 @@ def main(argv=None) -> Dict:
                              "(PATH.manifest.json).  Models whose round "
                              "body carries the tap: snowball, avalanche, "
                              "dag, backlog, streaming_dag (the streaming "
-                             "schedulers inherit it from the inner "
-                             "round).  Sharded runs stream host-side "
+                             "schedulers emit their FULL scheduler "
+                             "record — inner round + retire/occupancy + "
+                             "traffic fields — one line per round).  "
+                             "Sharded runs stream host-side "
                              "instead (obs.MetricsSink.write_stacked — "
                              "see examples/fault_scenarios.py), so "
                              "--metrics excludes --mesh")
@@ -682,13 +754,17 @@ def main(argv=None) -> Dict:
     if args.fleet is not None:
         if args.fleet < 1:
             parser.error(f"--fleet must be >= 1 trials, got {args.fleet}")
-        if args.model not in ("snowball", "avalanche", "dag"):
+        if args.model not in ("snowball", "avalanche", "dag", "backlog"):
             parser.error(f"--fleet supports models snowball/avalanche/"
-                         f"dag, not {args.model}")
+                         f"dag/backlog, not {args.model}")
         if args.mesh:
-            parser.error("--fleet batches whole sims in-graph; compose "
-                         "with --mesh is a ROADMAP item (fleet-of-"
-                         "sharded-sims)")
+            parser.error(
+                "--fleet x --mesh is not implemented: the fleet vmaps "
+                "WHOLE sims in-graph, and composing that batching with "
+                "the shard_map drivers (a fleet of sharded sims) is the "
+                "open 'fleet-of-sharded-sims' ROADMAP item (Monte-Carlo "
+                "fleet, next steps).  Run the fleet dense, or drop "
+                "--fleet to shard a single sim")
         if args.check_invariants:
             parser.error("--check-invariants steps ONE sim on the host; "
                          "it has no per-trial identity under --fleet")
@@ -720,7 +796,40 @@ def main(argv=None) -> Dict:
                          "--latency-mode is 'none', under which the "
                          "knob is inert — every point would measure "
                          "the same program")
+        if "arrival_rate" in grid:
+            if args.arrival_mode == "off":
+                parser.error("--phase-grid sweeps arrival_rate but "
+                             "--arrival-mode is 'off', under which the "
+                             "knob is inert — offered-load sweeps need "
+                             "a live-traffic schedule")
+            if args.model != "backlog":
+                parser.error("an arrival_rate phase axis needs "
+                             "--model backlog (the fleet's streaming "
+                             "model with the traffic plane)")
         args.phase_grid_parsed = grid
+
+    if args.arrival_mode != "off" and args.model not in ("backlog",
+                                                         "streaming_dag"):
+        parser.error(f"--arrival-* is a streaming-scheduler axis "
+                     f"(models backlog/streaming_dag — they admit from "
+                     f"a backlog as slots retire), not {args.model}")
+    if args.arrival_mode == "external":
+        parser.error("--arrival-mode external has no push path in "
+                     "run_sim (arrivals come only from "
+                     "traffic.push_arrivals — the Connector SIM_SUBMIT "
+                     "message): the stream would stay empty for "
+                     "--max-rounds.  Use a schedule mode here, or "
+                     "drive an external stream through "
+                     "connector.client.sim_submit")
+    args.arrival_backpressure_parsed = None
+    if args.arrival_backpressure is not None:
+        try:
+            lo_s, hi_s = args.arrival_backpressure.split(",")
+            args.arrival_backpressure_parsed = (float(lo_s), float(hi_s))
+        except ValueError:
+            parser.error(f"--arrival-backpressure must be LO,HI "
+                         f"occupancy fractions (e.g. 0.7,0.9), got "
+                         f"{args.arrival_backpressure!r}")
 
     if args.mesh and args.model not in ("avalanche", "dag", "backlog",
                                         "streaming_dag"):

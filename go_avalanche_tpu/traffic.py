@@ -1,0 +1,291 @@
+"""Live-traffic service mode: streaming tx arrival + finality-latency SLOs.
+
+Everything the repo simulated before this module drained a fixed
+pre-seeded backlog; a production pre-consensus layer ingests a *stream*
+of transactions with user-facing latency SLOs (TangleSim, PAPERS.md
+arXiv 2305.01232, frames exactly this confirmation-latency-under-load
+question for DAG ledgers).  This module adds the three planes the
+streaming schedulers (`models/backlog.py`, `models/streaming_dag.py`)
+thread through their state when `cfg.arrivals_enabled()`:
+
+  * **arrival process** — jit-static rate schedules (Poisson / bursty /
+    diurnal, `schedule_rate`), realized per round from a PRNG key folded
+    off the sim's init key (`init_traffic`).  The backlog array order IS
+    the arrival stream order: a per-round Poisson draw advances an
+    `arrived_idx` watermark, and admission (`_retire_and_refill`'s
+    `take`) is gated on it — fresh txs enter the working set as
+    finalized columns retire, never before they arrive.  The draw is a
+    pure function of (config, key, round, occupancy), so dense and
+    sharded runs — and every Monte-Carlo fleet trial — realize the SAME
+    arrival sequence for the same key (`tests/test_traffic.py`).
+  * **per-tx arrival-round plane** — `arrival_round` ``[B]`` stamps the
+    round each unit arrived, making finality latency (arrival round →
+    settle round) computable in-graph: retiring slots scatter-add their
+    latencies into a fixed-depth histogram (`latency_delta`), from which
+    the flight recorder emits EXACT nearest-rank p50/p99/p999
+    percentiles per round (`percentile_from_hist`; host twin
+    `latency_percentiles_host` recomputes them bit-for-bit from the
+    per-tx outputs — the acceptance check of
+    `examples/capacity_planning.py`).
+  * **closed-loop admission** — `backpressure_factor` throttles the
+    scheduled rate by working-set occupancy ((lo, hi) fractions, linear
+    ramp), turning the simulator into a capacity-planning tool: "what
+    sustained tx/s does an N-node network absorb at p99 finality < X
+    rounds?".
+
+`arrival_mode="external"` allocates the same planes but draws nothing:
+arrivals are pushed from outside the graph (`push_arrivals`), which is
+how the Connector service (`connector/server.py` SIM_SUBMIT) lets an
+external harness act as a live load generator.
+
+Everything here is statically absent when `cfg.arrival_mode == "off"`
+(`init_traffic` returns None, the schedulers skip every call at the
+Python level), so every archived hlo pin stays byte-identical —
+machine-checked by `benchmarks/hlo_pin.py --verify-off-path`.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from go_avalanche_tpu.config import AvalancheConfig
+
+# Key-derivation fold for the arrival stream: the traffic key is
+# fold_in(sim init key, this), so arrivals never perturb the consensus
+# PRNG stream — an arrival-on run with everything arrived at round 0 is
+# bit-identical to the arrival-off run (tests/test_traffic.py).
+_TRAFFIC_FOLD = 0x7AF1C
+
+# The nearest-rank percentile fractions the recorder emits, as exact
+# integer (num, den) pairs — p50 / p99 / p999.
+PERCENTILES = ((1, 2), (99, 100), (999, 1000))
+
+
+class TrafficState(NamedTuple):
+    """The live-traffic plane carried in a streaming scheduler's state.
+
+    ``B`` is the scheduler's admission-unit count (txs for
+    `models/backlog`, conflict SETS for `models/streaming_dag`); ``L``
+    is `cfg.arrival_latency_buckets`.  Replicated (`P()`) across every
+    mesh axis in the sharded drivers — the draw is identical on every
+    shard, like the backlog metadata it gates.
+    """
+
+    key: jax.Array            # PRNG key — the arrival stream's own fold
+    arrived_idx: jax.Array    # int32 — units arrived so far (admission
+                              #   watermark into the backlog order)
+    arrival_round: jax.Array  # int32 [B] — round each unit arrived;
+                              #   -1 while still in the future
+    lat_hist: jax.Array       # int32 [L] — settled finality-latency
+                              #   histogram (arrival -> settle rounds,
+                              #   clamped into [0, L))
+
+
+class TrafficTelemetry(NamedTuple):
+    """Per-round traffic scalars (flattened into the JSONL schema,
+    docs/observability.md): the arrival counters plus the cumulative
+    finality-latency percentiles."""
+
+    arrivals: jax.Array       # int32 — units arrived this round
+    arrived_total: jax.Array  # int32 — cumulative arrivals
+    lat_count: jax.Array      # int32 — settled units in the histogram
+    lat_p50: jax.Array        # int32 — nearest-rank percentiles over
+    lat_p99: jax.Array        #   every settled unit so far; -1 while
+    lat_p999: jax.Array       #   nothing has settled
+
+
+def init_traffic(cfg: AvalancheConfig, key: jax.Array,
+                 n_units: int) -> Optional[TrafficState]:
+    """The scheduler-side constructor: None (statically absent) when
+    arrivals are off, else a fresh plane over `n_units` backlog units.
+    `key` is the sim's init key — the traffic stream folds its own
+    subkey off it, so consensus draws are untouched."""
+    if not cfg.arrivals_enabled():
+        return None
+    return TrafficState(
+        key=jax.random.fold_in(key, _TRAFFIC_FOLD),
+        arrived_idx=jnp.int32(0),
+        arrival_round=jnp.full((n_units,), -1, jnp.int32),
+        lat_hist=jnp.zeros((cfg.arrival_latency_buckets,), jnp.int32),
+    )
+
+
+def schedule_rate(cfg: AvalancheConfig, round_: jax.Array) -> jax.Array:
+    """float32 scalar: the jit-static schedule's offered rate at
+    `round_` (before admission control).  The schedule SHAPE is static
+    config; only the round is traced."""
+    rate = jnp.float32(cfg.arrival_rate)
+    if cfg.arrival_mode == "poisson":
+        return rate
+    if cfg.arrival_mode == "bursty":
+        burst_rounds = max(1, int(round(cfg.arrival_duty
+                                        * cfg.arrival_period)))
+        in_burst = jnp.mod(round_, cfg.arrival_period) < burst_rounds
+        return jnp.where(in_burst,
+                         rate * jnp.float32(cfg.arrival_burst_factor),
+                         rate)
+    if cfg.arrival_mode == "diurnal":
+        phase = (2.0 * np.pi / cfg.arrival_period) * round_.astype(
+            jnp.float32)
+        return rate * (1.0 + jnp.float32(cfg.arrival_depth)
+                       * jnp.sin(phase))
+    # "external": the schedule draws nothing (push_arrivals feeds it).
+    return jnp.float32(0.0)
+
+
+def backpressure_factor(cfg: AvalancheConfig,
+                        occupancy_frac: jax.Array) -> jax.Array:
+    """float32 in [0, 1]: the closed-loop admission multiplier — 1 below
+    the lo occupancy fraction, 0 above hi, linear ramp in between.
+    Statically 1.0 (no traced op) without `cfg.arrival_backpressure`."""
+    if cfg.arrival_backpressure is None:
+        return jnp.float32(1.0)
+    lo, hi = cfg.arrival_backpressure
+    return jnp.clip((jnp.float32(hi) - occupancy_frac.astype(jnp.float32))
+                    / jnp.float32(hi - lo), 0.0, 1.0)
+
+
+def arrive(traffic: TrafficState, cfg: AvalancheConfig,
+           round_: jax.Array, occupied: jax.Array,
+           capacity: int) -> Tuple[TrafficState, jax.Array]:
+    """One round of the arrival process: draw `Poisson(schedule *
+    backpressure)` new units, advance the watermark, stamp their
+    arrival rounds.  Returns (new_traffic, arrivals this round).
+
+    `occupied` is the working set's occupied-slot count at step entry
+    (an int32 scalar, identical dense and sharded — the sharded drivers
+    psum it over the txs axis), `capacity` the static slot count; their
+    ratio is the backpressure signal.
+    """
+    b = traffic.arrival_round.shape[0]
+    if cfg.arrival_mode == "external":
+        # Pushed arrivals only: no draw, no key consumption — the plane
+        # advances exclusively through `push_arrivals`.
+        return traffic, jnp.int32(0)
+    lam = (schedule_rate(cfg, round_)
+           * backpressure_factor(
+               cfg, occupied.astype(jnp.float32) / jnp.float32(capacity)))
+    key, sub = jax.random.split(traffic.key)
+    n_new = jnp.minimum(
+        jax.random.poisson(sub, lam).astype(jnp.int32),
+        jnp.int32(b) - traffic.arrived_idx)
+    new_idx = traffic.arrived_idx + n_new
+    pos = jnp.arange(b, dtype=jnp.int32)
+    arrival_round = jnp.where(
+        (pos >= traffic.arrived_idx) & (pos < new_idx),
+        round_.astype(jnp.int32), traffic.arrival_round)
+    return traffic._replace(key=key, arrived_idx=new_idx,
+                            arrival_round=arrival_round), n_new
+
+
+def push_arrivals(traffic: TrafficState, count, round_) -> TrafficState:
+    """Advance the arrival watermark by `count` units arriving NOW —
+    the external-load-generator path (`arrival_mode="external"`; the
+    Connector SIM_SUBMIT message).  Composes with any mode: pushed
+    units stamp like drawn ones."""
+    b = traffic.arrival_round.shape[0]
+    count = jnp.asarray(count, jnp.int32)
+    new_idx = jnp.minimum(traffic.arrived_idx + jnp.maximum(count, 0),
+                          jnp.int32(b))
+    pos = jnp.arange(b, dtype=jnp.int32)
+    arrival_round = jnp.where(
+        (pos >= traffic.arrived_idx) & (pos < new_idx),
+        jnp.asarray(round_, jnp.int32), traffic.arrival_round)
+    return traffic._replace(arrived_idx=new_idx,
+                            arrival_round=arrival_round)
+
+
+def latency_delta(cfg: AvalancheConfig, latency: jax.Array,
+                  count: jax.Array) -> jax.Array:
+    """int32 [L] histogram increment: `count[i]` samples at bucket
+    `clamp(latency[i], 0, L-1)` wherever `count[i] > 0`.
+
+    Returned as a DELTA (scatter-add into zeros) rather than an updated
+    histogram so the sharded drivers can psum per-shard deltas over the
+    txs axis before adding — integer adds, so sharded == dense
+    bit-for-bit.
+    """
+    buckets = cfg.arrival_latency_buckets
+    idx = jnp.clip(latency, 0, buckets - 1)
+    idx = jnp.where(count > 0, idx, buckets)          # buckets = dropped
+    return (jnp.zeros((buckets,), jnp.int32)
+            .at[idx].add(jnp.maximum(count, 0), mode="drop"))
+
+
+def percentile_from_hist(hist: jax.Array, q_num: int,
+                         q_den: int) -> jax.Array:
+    """int32 scalar: the exact nearest-rank q-th percentile of the
+    integer samples in `hist` — the smallest bucket v with
+    ``cumsum(hist)[v] >= ceil(q * total)``; -1 while the histogram is
+    empty.  Integer arithmetic throughout so the host twin
+    (`latency_percentiles_host`) reproduces it bit-for-bit."""
+    total = hist.sum().astype(jnp.int32)
+    target = (total * q_num + (q_den - 1)) // q_den
+    cum = jnp.cumsum(hist)
+    idx = jnp.argmax(cum >= target).astype(jnp.int32)
+    return jnp.where(total > 0, idx, jnp.int32(-1))
+
+
+def traffic_telemetry(traffic: TrafficState,
+                      arrivals: jax.Array) -> TrafficTelemetry:
+    """Assemble the per-round traffic scalars (percentiles are over
+    every unit settled SO FAR — the cumulative SLO view)."""
+    (p50n, p50d), (p99n, p99d), (p999n, p999d) = PERCENTILES
+    return TrafficTelemetry(
+        arrivals=arrivals,
+        arrived_total=traffic.arrived_idx,
+        lat_count=traffic.lat_hist.sum().astype(jnp.int32),
+        lat_p50=percentile_from_hist(traffic.lat_hist, p50n, p50d),
+        lat_p99=percentile_from_hist(traffic.lat_hist, p99n, p99d),
+        lat_p999=percentile_from_hist(traffic.lat_hist, p999n, p999d),
+    )
+
+
+def latency_percentiles(traffic: Optional[TrafficState]) -> dict:
+    """Host-side digest of a final state's traffic plane: arrived
+    total, settled sample count, and the p50/p99/p999 the recorder
+    would emit (one device_get).  {} when the plane is absent."""
+    if traffic is None:
+        return {}
+    tel = jax.device_get(traffic_telemetry(traffic, jnp.int32(0)))
+    return {
+        "arrived_total": int(tel.arrived_total),
+        "finality_latency_count": int(tel.lat_count),
+        "finality_latency_p50": int(tel.lat_p50),
+        "finality_latency_p99": int(tel.lat_p99),
+        "finality_latency_p999": int(tel.lat_p999),
+    }
+
+
+def latency_percentiles_host(arrival_round, settle_round, weights,
+                             buckets: int) -> dict:
+    """The HOST twin of the in-graph percentiles: rebuild the clamped
+    histogram from per-unit outputs (numpy) and apply the same integer
+    nearest-rank formula — must match `latency_percentiles` bit-for-bit
+    on the same run (the capacity-planning acceptance check).
+
+    `weights[i]` is unit i's sample count (0 = not settled; a conflict
+    set contributes one sample per valid member).
+    """
+    arrival = np.asarray(arrival_round).reshape(-1)
+    settle = np.asarray(settle_round).reshape(-1)
+    w = np.asarray(weights).astype(np.int64).reshape(-1)
+    mask = w > 0
+    lat = np.clip(settle[mask] - arrival[mask], 0, buckets - 1)
+    hist = np.zeros((buckets,), np.int64)
+    np.add.at(hist, lat.astype(np.int64), w[mask])
+    total = int(hist.sum())
+    cum = np.cumsum(hist)
+    out = {"finality_latency_count": total}
+    for name, (num, den) in zip(("p50", "p99", "p999"), PERCENTILES):
+        if total == 0:
+            out[f"finality_latency_{name}"] = -1
+            continue
+        target = (total * num + (den - 1)) // den
+        out[f"finality_latency_{name}"] = int(
+            np.argmax(cum >= target))
+    return out

@@ -1,0 +1,565 @@
+"""Live-traffic service mode (`go_avalanche_tpu/traffic.py`).
+
+The contracts under test (PR 8):
+
+  * determinism — same key => identical arrival sequence dense vs
+    sharded (the draw is replicated, never per-shard), and a run whose
+    whole backlog arrives at round 0 is BIT-IDENTICAL to the
+    arrival-disabled seed run (the traffic key is folded off the sim
+    key, so consensus PRNG streams never move);
+  * statically absent — arrival off leaves the traffic plane and its
+    telemetry None (the hlo-pin drift test plus
+    `hlo_pin.py --verify-off-path` carry the compiled-program half);
+  * the SLO plane — in-graph nearest-rank percentiles from the clamped
+    histogram match a host recomputation from the per-tx outputs
+    bit-for-bit, on both streaming schedulers;
+  * closed-loop admission — occupancy backpressure throttles arrivals;
+  * composition — the Monte-Carlo fleet's backlog model (vmapped whole
+    streaming sims, offered-load phase axes), the fleet phase rows'
+    per-trial stochastic realizations, the run_sim/bench parser
+    surfaces, and the Connector's SIM_SUBMIT load-generator seam.
+"""
+
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_avalanche_tpu import fleet as fl
+from go_avalanche_tpu import traffic as tf
+from go_avalanche_tpu.config import AvalancheConfig
+from go_avalanche_tpu.models import backlog as bl
+from go_avalanche_tpu.models import streaming_dag as sdg
+
+
+def _cfg(**kw):
+    kw.setdefault("arrival_rate", 2.0)
+    return AvalancheConfig(arrival_mode="poisson", **kw)
+
+
+def _backlog_state(cfg, n_txs=48, n_nodes=16, slots=8, seed=0):
+    b = bl.make_backlog(jnp.arange(n_txs, dtype=jnp.int32))
+    return bl.init(jax.random.key(seed), n_nodes, slots, b, cfg)
+
+
+# ---------------------------------------------------------------------------
+# Config validation: inert knobs rejected at construction.
+
+
+def test_arrival_config_validation():
+    with pytest.raises(ValueError, match="arrival_mode"):
+        AvalancheConfig(arrival_mode="bogus")
+    with pytest.raises(ValueError, match="silently ignored"):
+        AvalancheConfig(arrival_rate=3.0)          # rate without a mode
+    with pytest.raises(ValueError, match="backpressure"):
+        AvalancheConfig(arrival_backpressure=(0.5, 0.9))
+    with pytest.raises(ValueError, match="arrival_rate > 0"):
+        AvalancheConfig(arrival_mode="poisson")
+    with pytest.raises(ValueError, match="external"):
+        AvalancheConfig(arrival_mode="external", arrival_rate=1.0)
+    with pytest.raises(ValueError, match="arrival_period"):
+        AvalancheConfig(arrival_mode="bursty", arrival_rate=1.0,
+                        arrival_burst_factor=2.0)
+    with pytest.raises(ValueError, match="burst_factor"):
+        AvalancheConfig(arrival_mode="bursty", arrival_rate=1.0,
+                        arrival_period=8)
+    with pytest.raises(ValueError, match="arrival_duty"):
+        AvalancheConfig(arrival_mode="bursty", arrival_rate=1.0,
+                        arrival_period=8, arrival_burst_factor=2.0,
+                        arrival_duty=1.5)
+    with pytest.raises(ValueError, match="arrival_depth"):
+        AvalancheConfig(arrival_mode="diurnal", arrival_rate=1.0,
+                        arrival_period=8, arrival_depth=1.5)
+    with pytest.raises(ValueError, match="lo < hi"):
+        _cfg(arrival_backpressure=(0.9, 0.5))
+    with pytest.raises(ValueError, match="latency_buckets"):
+        _cfg(arrival_latency_buckets=1)
+    with pytest.raises(ValueError, match="external"):
+        # backpressure throttles the DRAW, which external never performs
+        AvalancheConfig(arrival_mode="external",
+                        arrival_backpressure=(0.5, 0.9))
+    # external mode is valid with rate 0 (pure push-driven stream)
+    assert AvalancheConfig(arrival_mode="external").arrivals_enabled()
+
+
+def test_fleet_rejects_inert_arrival_on_non_backlog_models():
+    with pytest.raises(ValueError, match="backlog fleet model"):
+        fl.run_fleet("snowball", _cfg(), fleet=2, n_nodes=8)
+    with pytest.raises(ValueError, match="backlog fleet model"):
+        fl.run_phase_grid("snowball", _cfg(), {"arrival_rate": [1.0]},
+                          fleet=2, n_nodes=8)
+
+
+def test_schedule_rate_shapes():
+    base = AvalancheConfig(arrival_mode="bursty", arrival_rate=4.0,
+                           arrival_period=8, arrival_burst_factor=3.0,
+                           arrival_duty=0.25)
+    # duty 0.25 of 8 rounds => rounds 0,1 of each cycle at 3x.
+    rates = [float(tf.schedule_rate(base, jnp.int32(r))) for r in range(8)]
+    assert rates[0] == rates[1] == pytest.approx(12.0)
+    assert rates[2:] == pytest.approx([4.0] * 6)
+
+    diurnal = AvalancheConfig(arrival_mode="diurnal", arrival_rate=4.0,
+                              arrival_period=8, arrival_depth=0.5)
+    peak = float(tf.schedule_rate(diurnal, jnp.int32(2)))    # sin == 1
+    trough = float(tf.schedule_rate(diurnal, jnp.int32(6)))  # sin == -1
+    assert peak == pytest.approx(6.0, abs=1e-4)
+    assert trough == pytest.approx(2.0, abs=1e-4)
+
+    ext = AvalancheConfig(arrival_mode="external")
+    assert float(tf.schedule_rate(ext, jnp.int32(3))) == 0.0
+
+
+def test_backpressure_factor_ramp():
+    cfg = _cfg(arrival_backpressure=(0.5, 0.75))
+    f = lambda occ: float(tf.backpressure_factor(cfg, jnp.float32(occ)))
+    assert f(0.25) == 1.0
+    assert f(0.5) == 1.0
+    assert f(0.625) == pytest.approx(0.5)
+    assert f(0.9) == 0.0
+    # no backpressure => statically 1
+    assert float(tf.backpressure_factor(_cfg(), jnp.float32(0.99))) == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Statically absent when off; bit-identical when everything arrives at 0.
+
+
+def test_arrival_off_plane_absent():
+    # Tiny-shape single steps: pin the statically-absent contract; the
+    # compiled-program half is the hlo-pin drift test +
+    # `hlo_pin.py --verify-off-path`.
+    cfg = AvalancheConfig()
+    state = _backlog_state(cfg, n_txs=12, n_nodes=4, slots=4)
+    assert state.traffic is None
+    _, tel = jax.jit(bl.step, static_argnames=("cfg",))(state, cfg)
+    assert tel.traffic is None
+
+    sd_backlog = sdg.make_set_backlog(
+        jnp.arange(8, dtype=jnp.int32).reshape(4, 2))
+    sd = sdg.init(jax.random.key(0), 4, 2, sd_backlog, cfg)
+    assert sd.traffic is None
+    # (the streaming_dag telemetry-None twin rides the slow lane below —
+    # an sdg.step compile is heavy even at toy shapes)
+
+
+@pytest.mark.slow
+def test_connector_submit_streaming_dag_counts_sets():
+    """SIM_TRAFFIC_STATS units: arrived/admitted/settled all count SETS
+    for the streaming_dag model (outputs.settled is a per-member plane
+    including invalid padding; the reply must not mix units)."""
+    from go_avalanche_tpu.connector.client import ConnectorClient
+    from go_avalanche_tpu.connector.server import ConnectorServer
+
+    with ConnectorServer(backend="python") as srv:
+        host, port = srv.address
+        with ConnectorClient(host, port) as c:
+            assert c.sim_init(8, 24, model="streaming_dag",
+                              conflict_size=2, window_sets=4,
+                              finalization_score=16, gossip=False,
+                              arrival_mode="external")
+            st = c.sim_submit(6)          # 6 SETS (12 member txs)
+            assert (st.arrived, st.admitted, st.settled) == (6, 0, 0)
+            c.sim_run(100)
+            st2 = c.sim_submit(0)
+            assert st2.arrived == 6 and st2.admitted == 6
+            assert st2.settled == 6       # sets, not member lanes
+            assert st2.lat_count == 12    # one sample per valid member
+
+
+@pytest.mark.slow
+def test_arrival_off_streaming_dag_telemetry_absent():
+    cfg = AvalancheConfig()
+    sd_backlog = sdg.make_set_backlog(
+        jnp.arange(8, dtype=jnp.int32).reshape(4, 2))
+    sd = sdg.init(jax.random.key(0), 4, 2, sd_backlog, cfg)
+    _, stel = jax.jit(sdg.step, static_argnames=("cfg",))(sd, cfg)
+    assert stel.traffic is None
+
+
+def test_everything_arrived_matches_disabled_run():
+    """A flood (rate >> backlog) arrives everything in round 0, so the
+    consensus trajectory must be BIT-IDENTICAL to the arrival-off seed
+    run: the traffic key is folded off the sim key, never split from
+    the consensus stream."""
+    n_txs = 32
+    off = AvalancheConfig()
+    on = AvalancheConfig(arrival_mode="poisson",
+                         arrival_rate=float(n_txs * 20))
+    run = jax.jit(bl.run_scan, static_argnames=("cfg", "n_rounds"))
+    f_off, t_off = run(_backlog_state(off, n_txs=n_txs), off, 40)
+    f_on, t_on = run(_backlog_state(on, n_txs=n_txs), on, 40)
+    assert int(f_on.traffic.arrived_idx) == n_txs  # the flood landed
+    for name in ("settled", "accepted", "accept_votes", "settle_round",
+                 "admit_round"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(f_off.outputs, name)),
+            np.asarray(getattr(f_on.outputs, name)), err_msg=name)
+    np.testing.assert_array_equal(
+        np.asarray(f_off.sim.records.confidence),
+        np.asarray(f_on.sim.records.confidence))
+    np.testing.assert_array_equal(np.asarray(t_off.round.polls),
+                                  np.asarray(t_on.round.polls))
+
+
+# ---------------------------------------------------------------------------
+# The SLO plane: in-graph percentiles == host recomputation, bit-for-bit
+# (plus the admission gate, asserted on the same drained run).
+
+
+def test_backlog_percentiles_match_host_and_admission_gated():
+    cfg = _cfg(arrival_backpressure=(0.5, 0.9))
+    final = jax.jit(bl.run, static_argnames=("cfg", "max_rounds"))(
+        _backlog_state(cfg), cfg, 5000)
+    out = jax.device_get(final.outputs)
+    arr = np.asarray(jax.device_get(final.traffic.arrival_round))
+    settled = np.asarray(out.settled)
+    assert settled.all()
+    admit = np.asarray(out.admit_round)
+    assert (admit >= arr).all()          # never admitted before arrival
+    assert len(np.unique(admit)) > 3     # a stream, not a flood
+    ig = tf.latency_percentiles(final.traffic)
+    host = tf.latency_percentiles_host(
+        arr, np.asarray(out.settle_round), settled.astype(np.int64),
+        cfg.arrival_latency_buckets)
+    assert ig["finality_latency_count"] == host["finality_latency_count"]
+    for k in ("p50", "p99", "p999"):
+        assert (ig[f"finality_latency_{k}"]
+                == host[f"finality_latency_{k}"]), k
+    assert ig["finality_latency_p50"] >= 1   # arrival -> settle takes rounds
+
+
+@pytest.mark.slow
+def test_streaming_dag_percentiles_match_host():
+    """Set granularity: each VALID member contributes one sample at the
+    set's latency — padded invalid lanes never count."""
+    cfg = _cfg()
+    n_sets, c = 16, 3
+    valid = jnp.arange(n_sets * c).reshape(n_sets, c) % 3 != 2
+    backlog = sdg.make_set_backlog(
+        jnp.arange(n_sets * c, dtype=jnp.int32).reshape(n_sets, c),
+        valid=valid)
+    state = sdg.init(jax.random.key(0), 16, 4, backlog, cfg)
+    final = jax.jit(sdg.run, static_argnames=("cfg", "max_rounds"))(
+        state, cfg, 5000)
+    out = jax.device_get(final.outputs)
+    ig = tf.latency_percentiles(final.traffic)
+    arr = np.broadcast_to(
+        np.asarray(jax.device_get(final.traffic.arrival_round))[:, None],
+        out.settle_round.shape)
+    weights = (np.asarray(out.settled)
+               & np.asarray(jax.device_get(final.backlog.valid)))
+    host = tf.latency_percentiles_host(arr, np.asarray(out.settle_round),
+                                       weights.astype(np.int64),
+                                       cfg.arrival_latency_buckets)
+    for k in ("count", "p50", "p99", "p999"):
+        assert (ig[f"finality_latency_{k}"]
+                == host[f"finality_latency_{k}"]), k
+    # exactly the valid members were counted
+    assert ig["finality_latency_count"] == int(weights.sum())
+
+
+@pytest.mark.slow
+def test_backpressure_throttles_arrivals():
+    """Closed-loop admission: with a tight occupancy band the arrival
+    stream is strictly slower than the open-loop one under the same
+    schedule and key."""
+    open_cfg = AvalancheConfig(arrival_mode="poisson", arrival_rate=6.0,
+                               finalization_score=192)
+    closed_cfg = dataclasses.replace(open_cfg,
+                                     arrival_backpressure=(0.1, 0.4))
+    run = jax.jit(bl.run_scan, static_argnames=("cfg", "n_rounds"))
+    _, t_open = run(_backlog_state(open_cfg, n_txs=256, slots=16),
+                    open_cfg, 40)
+    _, t_closed = run(_backlog_state(closed_cfg, n_txs=256, slots=16),
+                      closed_cfg, 40)
+    arrived_open = int(np.asarray(t_open.traffic.arrived_total)[-1])
+    arrived_closed = int(np.asarray(t_closed.traffic.arrived_total)[-1])
+    assert arrived_closed < arrived_open
+
+
+def test_push_arrivals_external_mode():
+    """External mode: the schedule draws nothing; pushes stamp the
+    current round and clamp at the backlog size.  (The pushed-units-
+    settle end-to-end path rides the Connector loop test.)"""
+    cfg = AvalancheConfig(arrival_mode="external")
+    state = _backlog_state(cfg, n_txs=24, n_nodes=4, slots=4)
+    assert state.traffic is not None
+    # nothing arrives on its own
+    state2, tel = jax.jit(bl.step, static_argnames=("cfg",))(state, cfg)
+    assert int(state2.traffic.arrived_idx) == 0
+    assert int(tel.traffic.arrivals) == 0
+    pushed = tf.push_arrivals(state2.traffic, 10, state2.sim.round)
+    assert int(pushed.arrived_idx) == 10
+    arr = np.asarray(jax.device_get(pushed.arrival_round))
+    assert (arr[:10] == int(state2.sim.round)).all()
+    assert (arr[10:] == -1).all()
+    # push clamps at the backlog size
+    over = tf.push_arrivals(pushed, 1000, jnp.int32(5))
+    assert int(over.arrived_idx) == 24
+
+
+# ---------------------------------------------------------------------------
+# Determinism: same key => identical arrival sequence dense vs sharded.
+
+
+def test_arrival_sequence_dense_vs_sharded_backlog():
+    from go_avalanche_tpu.parallel import sharded_backlog as sbl
+    from go_avalanche_tpu.parallel.mesh import make_mesh
+
+    cfg = _cfg(arrival_rate=3.0)
+    dense_tel = jax.jit(bl.run_scan, static_argnames=("cfg", "n_rounds"))(
+        _backlog_state(cfg, n_txs=64, slots=16), cfg, 24)[1]
+    mesh = make_mesh(n_node_shards=4, n_tx_shards=2)
+    sh = sbl.shard_backlog_state(
+        _backlog_state(cfg, n_txs=64, slots=16), mesh)
+    sh_final, sh_tel = sbl.run_scan_sharded_backlog(mesh, sh, cfg,
+                                                    n_rounds=24)
+    np.testing.assert_array_equal(np.asarray(dense_tel.traffic.arrivals),
+                                  np.asarray(sh_tel.traffic.arrivals))
+    np.testing.assert_array_equal(
+        np.asarray(dense_tel.traffic.arrived_total),
+        np.asarray(sh_tel.traffic.arrived_total))
+    # The psum-merged histogram is self-consistent with the SHARDED
+    # run's own per-tx outputs (a double-count across the nodes axis or
+    # a dropped shard delta breaks this; the dense-vs-sharded latency
+    # VALUES legitimately differ — per-shard consensus PRNG streams).
+    out = jax.device_get(sh_final.outputs)
+    ig = tf.latency_percentiles(sh_final.traffic)
+    host = tf.latency_percentiles_host(
+        np.asarray(jax.device_get(sh_final.traffic.arrival_round)),
+        np.asarray(out.settle_round),
+        np.asarray(out.settled).astype(np.int64),
+        cfg.arrival_latency_buckets)
+    for k in ("count", "p50", "p99", "p999"):
+        assert (ig[f"finality_latency_{k}"]
+                == host[f"finality_latency_{k}"]), k
+    assert ig["finality_latency_count"] > 0   # something actually settled
+
+
+@pytest.mark.slow
+def test_arrival_sequence_dense_vs_sharded_streaming_dag():
+    from go_avalanche_tpu.parallel import sharded_streaming_dag as ssd
+    from go_avalanche_tpu.parallel.mesh import make_mesh
+
+    cfg = _cfg(arrival_rate=3.0)
+    n_sets, c = 32, 2
+    backlog = sdg.make_set_backlog(
+        jnp.arange(n_sets * c, dtype=jnp.int32).reshape(n_sets, c))
+    dense_tel = jax.jit(sdg.run_scan, static_argnames=("cfg", "n_rounds"))(
+        sdg.init(jax.random.key(0), 16, 8, backlog, cfg), cfg, 40)[1]
+    mesh = make_mesh(n_node_shards=4, n_tx_shards=2)
+    sh = ssd.shard_streaming_dag_state(
+        sdg.init(jax.random.key(0), 16, 8, backlog, cfg), mesh)
+    _, sh_tel = ssd.run_scan_sharded_streaming_dag(mesh, sh, cfg,
+                                                   n_rounds=40)
+    np.testing.assert_array_equal(np.asarray(dense_tel.traffic.arrivals),
+                                  np.asarray(sh_tel.traffic.arrivals))
+
+
+# ---------------------------------------------------------------------------
+# Fleet composition: backlog model, offered-load axes, realizations.
+
+
+def test_fleet_backlog_reports_latency_percentiles():
+    cfg = _cfg()
+    res = fl.run_fleet("backlog", cfg, fleet=2, n_nodes=16, n_txs=32,
+                       n_rounds=160, window=8)
+    assert res.p_settled == 1.0
+    assert res.lat_percentiles.shape == (2, 3)
+    assert (res.arrived == 32).all()
+    row = res.summary()
+    for k in ("lat_p50_mean", "lat_p99_mean", "lat_p999_mean",
+              "lat_p99_max", "arrived_mean"):
+        assert k in row, k
+    assert row["lat_p99_max"] >= row["lat_p50_mean"] >= 1
+
+
+def test_fleet_summary_excludes_empty_histogram_sentinels():
+    """Trials that settled nothing carry (-1,-1,-1); the latency
+    reduction must exclude them (lat_trials records the count) instead
+    of deflating the means — an overload point must never read as
+    meeting the SLO because empty trials averaged in."""
+    base = fl.run_fleet("backlog", _cfg(), fleet=2, n_nodes=8, n_txs=16,
+                        n_rounds=8, window=4)  # horizon too short: empty
+    assert (base.lat_percentiles == -1).all()
+    row = base.summary()
+    assert row["lat_trials"] == 0
+    assert row["lat_p99_max"] is None and row["lat_p99_mean"] is None
+    # mixed case: one real trial + one sentinel
+    import dataclasses as dc
+
+    mixed = dc.replace(base, lat_percentiles=np.asarray(
+        [[10, 20, 30], [-1, -1, -1]], np.int32))
+    row = mixed.summary()
+    assert row["lat_trials"] == 1
+    assert row["lat_p99_mean"] == 20.0 and row["lat_p99_max"] == 20
+
+
+def test_fleet_arrival_rate_axis_inert_without_mode():
+    with pytest.raises(ValueError, match="arrival_rate phase axis"):
+        fl.run_phase_grid("backlog", AvalancheConfig(),
+                          {"arrival_rate": [1.0]}, fleet=2, n_nodes=8)
+
+
+@pytest.mark.slow
+def test_fleet_backlog_vmap_matches_single_run():
+    cfg = _cfg()
+    res = fl.run_fleet("backlog", cfg, fleet=3, n_nodes=16, n_txs=32,
+                       n_rounds=200, window=8)
+    assert res.p_settled == 1.0
+    # vmap-cleanliness: trial 0 == a manual single run with keys[0]
+    keys = jax.random.split(jax.random.key(0), 3)
+    state = bl.init(keys[0], 16, 8,
+                    bl.make_backlog(jnp.arange(32, dtype=jnp.int32)), cfg)
+    final, _ = jax.jit(bl.run_scan, static_argnames=("cfg", "n_rounds"))(
+        state, cfg, 200)
+    final, _ = bl._retire_and_refill(final, cfg, refill=False)
+    out = fl._outcome_backlog(final, cfg)
+    assert int(out.lat_p99) == int(res.lat_percentiles[0, 1])
+    assert int(out.lat_p50) == int(res.lat_percentiles[0, 0])
+    assert int(out.arrived) == int(res.arrived[0])
+    assert bool(out.settled) == bool(res.settled[0])
+
+
+@pytest.mark.slow
+def test_fleet_arrival_rate_axis_sweeps_offered_load():
+    cfg = _cfg()
+    rows = fl.run_phase_grid("backlog", cfg, {"arrival_rate": [1.0, 8.0]},
+                             fleet=2, n_nodes=16, n_txs=32, n_rounds=160,
+                             window=8)
+    assert [r["point"]["arrival_rate"] for r in rows] == [1.0, 8.0]
+    # higher offered load => no lower p99 (queueing only adds latency)
+    assert rows[1]["lat_p99_mean"] >= rows[0]["lat_p99_mean"]
+
+
+_STOCHASTIC_CFG = dict(
+    fault_script=(("stochastic_partition", (2, 5), (3, 6), (0.3, 0.6)),
+                  ("stochastic_spike", (1, 4), (2, 3), (1, 2))),
+    time_step_s=1.0, request_timeout_s=5.0)
+
+
+def test_fleet_phase_rows_carry_realizations():
+    cfg = AvalancheConfig(**_STOCHASTIC_CFG)
+    rows = fl.run_phase_grid("snowball", cfg, {"k": [4]}, fleet=3,
+                             n_nodes=16, n_rounds=12)
+    real = rows[0]["realizations"]
+    assert len(real["cut"]) == 3 and len(real["spike"]) == 3
+    for trial_cuts, trial_spikes in zip(real["cut"], real["spike"]):
+        (start, end, split), = trial_cuts
+        assert 2 <= start <= 5 and start + 3 <= end <= start + 6
+        assert 0 < split < 16
+        (s_start, s_end, extra), = trial_spikes
+        assert 1 <= s_start <= 4 and extra in (1, 2)
+    # deterministic in (config, seed) — the re-run hits the compiled
+    # fleet cache, so this costs one dispatch, not a compile
+    rows2 = fl.run_phase_grid("snowball", cfg, {"k": [4]}, fleet=3,
+                              n_nodes=16, n_rounds=12)
+    assert rows2[0]["realizations"] == real
+
+
+@pytest.mark.slow
+def test_fleet_rows_without_stochastic_events_omit_realizations():
+    rows = fl.run_phase_grid("snowball", AvalancheConfig(), {"k": [4]},
+                             fleet=2, n_nodes=8, n_rounds=8)
+    assert "realizations" not in rows[0]
+    res = fl.run_fleet("snowball", AvalancheConfig(), fleet=2, n_nodes=8,
+                       n_rounds=8)
+    assert res.realizations() == {}
+
+
+# ---------------------------------------------------------------------------
+# CLI surfaces: parser rejections + the fleet x mesh wording pin.
+
+
+def test_run_sim_fleet_mesh_rejection_names_roadmap_item(capsys):
+    from go_avalanche_tpu.run_sim import main
+
+    with pytest.raises(SystemExit):
+        main(["--model", "avalanche", "--fleet", "4", "--mesh", "2,2"])
+    err = capsys.readouterr().err
+    assert "fleet-of-sharded-sims" in err      # the ROADMAP item, by name
+    assert "ROADMAP" in err
+
+
+def test_run_sim_arrival_parser_rejections():
+    from go_avalanche_tpu.run_sim import main
+
+    for argv in (
+        # arrival on a non-streaming model
+        ["--model", "avalanche", "--arrival-mode", "poisson",
+         "--arrival-rate", "2"],
+        # malformed backpressure
+        ["--model", "backlog", "--arrival-mode", "poisson",
+         "--arrival-rate", "2", "--arrival-backpressure", "nope"],
+        # rate without a mode (config-level inert-knob rejection)
+        ["--model", "backlog", "--arrival-rate", "2"],
+        # bursty without a period (config validation at the parser)
+        ["--model", "backlog", "--arrival-mode", "bursty",
+         "--arrival-rate", "2"],
+        # offered-load phase axis with arrival off
+        ["--model", "backlog", "--fleet", "2", "--phase-grid",
+         '{"arrival_rate": [1.0]}'],
+        # external mode has no push path in run_sim (Connector-only)
+        ["--model", "backlog", "--arrival-mode", "external"],
+        # offered-load phase axis on a non-streaming fleet model
+        ["--model", "snowball", "--fleet", "2", "--arrival-mode",
+         "poisson", "--arrival-rate", "1", "--phase-grid",
+         '{"arrival_rate": [1.0]}'],
+    ):
+        with pytest.raises(SystemExit):
+            main(argv)
+
+
+def test_run_sim_backlog_arrival_cli(capsys):
+    from go_avalanche_tpu.run_sim import main
+
+    res = main(["--model", "backlog", "--nodes", "16", "--txs", "32",
+                "--slots", "8", "--arrival-mode", "poisson",
+                "--arrival-rate", "2", "--max-rounds", "3000", "--json"])
+    assert res["settled_fraction"] == 1.0
+    assert res["arrived_total"] == 32
+    assert res["finality_latency_p99"] >= res["finality_latency_p50"] >= 1
+
+
+def test_tag_carries_arrival_fragment():
+    from go_avalanche_tpu.obs import tag_from_config
+
+    assert tag_from_config(AvalancheConfig()) == ""
+    cfg = _cfg(arrival_backpressure=(0.7, 0.95))
+    assert ", poisson-arrival2" in tag_from_config(cfg)
+    assert ", backpressure" in tag_from_config(cfg)
+
+
+# ---------------------------------------------------------------------------
+# Connector: the external-load-generator seam.
+
+
+def test_connector_submit_load_generator_loop():
+    from go_avalanche_tpu.connector.client import ConnectorClient
+    from go_avalanche_tpu.connector.server import ConnectorServer
+
+    with ConnectorServer(backend="python") as srv:
+        host, port = srv.address
+        with ConnectorClient(host, port) as c:
+            assert c.sim_init(8, 24, model="backlog", window_sets=4,
+                              finalization_score=16, gossip=False,
+                              arrival_mode="external")
+            st = c.sim_submit(12)
+            assert (st.arrived, st.admitted, st.settled) == (12, 0, 0)
+            assert st.lat_p99 == -1          # nothing settled yet
+            c.sim_run(80)
+            st2 = c.sim_submit(0)
+            assert st2.arrived == 12 and st2.settled == 12
+            assert 1 <= st2.lat_p50 <= st2.lat_p99
+            # count clamps at the backlog size
+            st3 = c.sim_submit(1000)
+            assert st3.arrived == 24
+            # avalanche + arrival tail is rejected as an ERROR frame
+            from go_avalanche_tpu.connector.protocol import ProtocolError
+            with pytest.raises(ProtocolError, match="streaming model"):
+                c.sim_init(16, 48, model="avalanche",
+                           arrival_mode="poisson", arrival_rate=2.0)
