@@ -144,6 +144,7 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
           latency_mode: str = "fixed", timeout_rounds: int | None = None,
           inflight: str = "walk", fleet: int | None = None,
           arrival: float | None = None, arrival_window: int = 1024,
+          stake: str = "off", stake_clusters: int = 1,
           metrics: str | None = None, metrics_every: int = 0,
           profile: bool = False) -> dict:
     import contextlib
@@ -176,6 +177,10 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
         # the flagship A/B axes, so the parser keeps them exclusive.
         from benchmarks.workload import traffic_backlog_state
 
+        if stake != "off":
+            raise ValueError("--arrival times the streaming scheduler; "
+                             "the --stake lane times the flagship scan "
+                             "— pick one lane")
         window = min(arrival_window, n_txs)
         state, cfg = traffic_backlog_state(n_nodes, n_txs, window, k,
                                            rate=arrival,
@@ -193,13 +198,20 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
         state, cfg = fleet_flagship_state(
             fleet, n_nodes, n_txs, k, latency,
             latency_mode=latency_mode, timeout_rounds=timeout_rounds,
-            inflight_engine=inflight)
+            inflight_engine=inflight, stake=stake,
+            clusters=stake_clusters)
     else:
+        # `stake`/`stake_clusters` ride the flagship lane: the same
+        # timed scan under the stake-weighted committee draw
+        # (hierarchical two-level engine when clusters > 1) — pinned
+        # as flagship_stake; stake "off" IS the flagship program.
         state, cfg = flagship_state(n_nodes, n_txs, k, latency,
                                     latency_mode=latency_mode,
                                     timeout_rounds=timeout_rounds,
                                     inflight_engine=inflight,
-                                    metrics_every=metrics_every)
+                                    metrics_every=metrics_every,
+                                    stake=stake,
+                                    clusters=stake_clusters)
     if exchange != "fused":
         cfg = dataclasses.replace(cfg, fused_exchange=False)
     if ingest != "u8":
@@ -308,6 +320,7 @@ def _worker_main(args: argparse.Namespace) -> None:
                    inflight=args.inflight_engine, fleet=args.fleet,
                    arrival=args.arrival,
                    arrival_window=args.arrival_window,
+                   stake=args.stake, stake_clusters=args.stake_clusters,
                    metrics=args.metrics, metrics_every=args.metrics_every,
                    profile=args.profile)
     if args.nonce:
@@ -508,6 +521,29 @@ def main() -> None:
     parser.add_argument("--arrival-window", type=int, default=1024,
                         help="with --arrival: working-set slots "
                              "(capped at --txs)")
+    parser.add_argument("--stake", choices=("off", "uniform", "zipf",
+                                            "explicit"),
+                        default="off",
+                        help="stake lane (go_avalanche_tpu/stake.py): "
+                             "time the flagship scan under "
+                             "stake-weighted COMMITTEE peer draws "
+                             "(cfg.stake_mode) — 'zipf' is the "
+                             "concentrated-stake distribution; with "
+                             "--stake-clusters > 1 the draw runs the "
+                             "two-level hierarchical sampling engine "
+                             "(bit-identical distribution, pinned as "
+                             "flagship_stake).  'off' times THE "
+                             "flagship program (hlo_pin "
+                             "--verify-off-path checks the collapse); "
+                             "non-off tags the metric so same-metric "
+                             "deltas never cross engines.  'explicit' "
+                             "needs a per-node vector, which the bench "
+                             "lane has no flag for — rejected here")
+    parser.add_argument("--stake-clusters", type=int, default=1,
+                        help="with --stake: decompose the stake CDF "
+                             "over this many contiguous clusters (the "
+                             "hierarchical two-level engine; 1 = flat "
+                             "CDF)")
     parser.add_argument("--metrics", type=str, default=None, metavar="PATH",
                         help="stream per-round telemetry to this JSONL "
                              "file through the in-graph metrics tap "
@@ -585,6 +621,35 @@ def main() -> None:
             parser.error("--profile replays one eager flagship round; "
                          "the backlog scheduler state has no such "
                          "spelling")
+    if args.stake == "explicit":
+        # Parser-level rejection (the PR 5 rule): the lane has no
+        # per-node vector flag, so 'explicit' would die in the worker.
+        parser.error("--stake explicit needs a per-node stake vector; "
+                     "the bench lane times the built-in distributions "
+                     "(uniform/zipf) — drive explicit vectors through "
+                     "run_sim --stake-weights")
+    if args.stake_clusters < 1:
+        parser.error(f"--stake-clusters must be >= 1, got "
+                     f"{args.stake_clusters}")
+    if args.stake_clusters > min(args.nodes, 2048):
+        # Parser-level (the PR 5 rule): the worker's ValueError would
+        # read as an accelerator failure and spin the retry/fallback
+        # loop.  2048 is the CPU fallback's node cap — a cluster count
+        # only the full-shape run could satisfy would still crash the
+        # reduced-shape fallback.
+        parser.error(f"--stake-clusters ({args.stake_clusters}) must "
+                     f"not exceed the node count, including the CPU "
+                     f"fallback's (min(--nodes, 2048) = "
+                     f"{min(args.nodes, 2048)})")
+    if args.stake_clusters > 1 and args.stake == "off":
+        parser.error("--stake-clusters selects the hierarchical "
+                     "engine of the STAKE draw; without --stake it "
+                     "would silently switch the flagship to the "
+                     "clustered-locality sampler and mislabel the A/B")
+    if args.stake != "off" and args.arrival is not None:
+        parser.error("--arrival times the streaming scheduler; the "
+                     "--stake lane times the flagship scan — pick one "
+                     "lane")
     if args.metrics_every < 0:
         # Reject here: the worker subprocess's ValueError would read as
         # an accelerator failure and spin the retry/fallback loop.
@@ -603,6 +668,9 @@ def main() -> None:
              f"--latency={args.latency}",
              f"--latency-mode={args.latency_mode}",
              f"--inflight-engine={args.inflight_engine}"] \
+        + ([f"--stake={args.stake}",
+            f"--stake-clusters={args.stake_clusters}"]
+           if args.stake != "off" else []) \
         + ([f"--fleet={args.fleet}"] if args.fleet is not None else []) \
         + ([f"--arrival={args.arrival}",
             f"--arrival-window={args.arrival_window}"]

@@ -118,7 +118,9 @@ def flagship_stablehlo(nodes: int, txs: int, rounds: int, k: int,
                        latency: int = 0,
                        inflight: str = "walk",
                        metrics_every: int = 0,
-                       faults=None) -> str:
+                       faults=None,
+                       stake: str = "off",
+                       clusters: int = 1) -> str:
     """StableHLO text of the flagship bench program at the given shape.
 
     Abstract lowering: `jax.eval_shape` turns the state builder into
@@ -139,7 +141,8 @@ def flagship_stablehlo(nodes: int, txs: int, rounds: int, k: int,
     from benchmarks.workload import flagship_config, flagship_state
 
     cfg = flagship_config(txs, k, latency, inflight_engine=inflight,
-                          metrics_every=metrics_every)
+                          metrics_every=metrics_every, stake=stake,
+                          clusters=clusters)
     if exchange != "fused":
         cfg = dataclasses.replace(cfg, fused_exchange=False)
     if ingest != "u8":
@@ -185,7 +188,8 @@ def fleet_stablehlo(fleet: int, nodes: int, txs: int, rounds: int,
 
 
 def streaming_step_stablehlo(nodes: int, backlog_sets: int, set_cap: int,
-                             window_sets: int, arrival=None) -> str:
+                             window_sets: int, arrival=None,
+                             stake=None) -> str:
     """StableHLO text of one north-star streaming-scheduler step
     (`models/streaming_dag.step`) at the roofline's streaming shape,
     abstractly lowered like the flagship.  `arrival="off"` forces the
@@ -206,6 +210,17 @@ def streaming_step_stablehlo(nodes: int, backlog_sets: int, set_cap: int,
         cfg = dataclasses.replace(cfg, arrival_mode="off",
                                   arrival_rate=0.0,
                                   arrival_backpressure=None)
+    if stake is not None:
+        # `stake="off"` forces the stake subsystem AND the node
+        # registry explicitly off (how `--verify-off-path` proves
+        # stake-off + a flat registry == the archived streaming pin).
+        if stake != "off":
+            raise ValueError(f"streaming_step stake knob is 'off' or "
+                             f"absent, got {stake!r}")
+        cfg = dataclasses.replace(cfg, stake_mode="off",
+                                  stake_zipf_s=1.0, stake_weights=None,
+                                  registry_nodes=0, active_nodes=0,
+                                  node_churn_rate=0.0)
     state_abs = jax.eval_shape(lambda: northstar_state(
         nodes=nodes, backlog_sets=backlog_sets, set_cap=set_cap,
         window_sets=window_sets, track_finality=False)[0])
@@ -251,11 +266,53 @@ PROGRAMS = {
                         lambda w: flagship_stablehlo(**w)),
     "fleet_small": (dict(FLEET_SMALL),
                     lambda w: fleet_stablehlo(**w)),
+    "flagship_stake": (dict(FLAGSHIP, stake="zipf", clusters=4),
+                       lambda w: flagship_stablehlo(**w)),
     "flagship_traffic": (dict(TRAFFIC),
                          lambda w: traffic_stablehlo(**w)),
     "streaming_step": (dict(STREAMING),
                        lambda w: streaming_step_stablehlo(**w)),
 }
+
+# program name -> the `benchmarks.workload` builders it lowers through.
+# `--stale` checks each archived pin's builders still exist, so pin rot
+# (a renamed/removed workload builder leaving a stale archive entry) is
+# caught at the tier-1 gate instead of on a TPU window
+# (tests/test_bench.py).
+PROGRAM_BUILDERS = {
+    "flagship": ("flagship_config", "flagship_state"),
+    "flagship_swar32": ("flagship_config", "flagship_state"),
+    "flagship_async": ("flagship_config", "flagship_state"),
+    "flagship_async_coalesced": ("flagship_config", "flagship_state"),
+    "flagship_metrics": ("flagship_config", "flagship_state"),
+    "flagship_faults": ("flagship_config", "flagship_state"),
+    "flagship_stake": ("flagship_config", "flagship_state"),
+    "fleet_small": ("flagship_config", "fleet_flagship_state"),
+    "flagship_traffic": ("traffic_config", "traffic_backlog_state"),
+    "streaming_step": ("northstar_config", "northstar_state"),
+}
+
+
+def stale_pins(archive: dict) -> list:
+    """Archived pins whose lowering path no longer exists: programs
+    unknown to `PROGRAMS`, or whose `benchmarks.workload` builders
+    (`PROGRAM_BUILDERS`) have been renamed/removed.  Pure metadata —
+    no jax import, no lowering — so the check is gate-cheap."""
+    from benchmarks import workload
+
+    stale = []
+    for name in sorted(archive.get("programs", {})):
+        if name not in PROGRAMS:
+            stale.append(f"{name}: archived but unknown to "
+                         f"hlo_pin.PROGRAMS (builder removed?)")
+            continue
+        for builder in PROGRAM_BUILDERS.get(name, ()):
+            if not hasattr(workload, builder):
+                stale.append(
+                    f"{name}: workload builder {builder!r} no longer "
+                    f"exists in benchmarks/workload.py — the pin can "
+                    f"no longer lower")
+    return stale
 
 # The off-path flagship programs: with cfg.metrics_every == 0 and an
 # empty fault script (the defaults) the obs tap AND the fault-script
@@ -344,30 +401,37 @@ def verify_off_path(platform: str, archive: dict | None = None) -> list:
         workload = dict(entry.get("workload") or PROGRAMS[name][0])
         workload["metrics_every"] = 0
         workload["faults"] = []
+        workload["stake"] = "off"
         current = program_hash(name, workload)
         if current != pinned:
             failures.append(
-                f"{name}: metrics-off empty-script program {current} != "
-                f"pinned {pinned} — the obs tap or the fault-script "
-                f"engine leaks into the off path")
-    for tapped, base, knob, what in (
-            ("flagship_metrics", "flagship", "metrics_every",
+                f"{name}: metrics-off empty-script stake-off program "
+                f"{current} != pinned {pinned} — the obs tap, the "
+                f"fault-script engine or the stake subsystem leaks "
+                f"into the off path")
+    for tapped, base, overrides, what in (
+            ("flagship_metrics", "flagship", {"metrics_every": 0},
              "the tapped program differs from the untapped one by more "
              "than the tap"),
-            ("flagship_faults", "flagship_async", "faults",
+            ("flagship_faults", "flagship_async", {"faults": []},
              "the faulted program differs from the fault-free async one "
-             "by more than the scheduled events")):
+             "by more than the scheduled events"),
+            ("flagship_stake", "flagship",
+             {"stake": "off", "clusters": 1},
+             "the staked program differs from the weightless flagship "
+             "by more than the committee-draw engine")):
         on = archive.get("programs", {}).get(tapped)
         off = archive.get("programs", {}).get(base)
         if not (on and off and off.get("hashes", {}).get(platform)):
             continue
         workload = dict(on.get("workload") or PROGRAMS[tapped][0])
-        workload[knob] = 0 if knob == "metrics_every" else []
+        workload.update(overrides)
         current = program_hash(tapped, workload)
         pinned = off["hashes"][platform]
+        knobs = "/".join(sorted(overrides))
         if current != pinned:
             failures.append(
-                f"{tapped} with {knob} forced off hashes to {current} "
+                f"{tapped} with {knobs} forced off hashes to {current} "
                 f"!= the {base} pin {pinned} — {what}")
     # The fleet lane's f=1 off path (PR 7): `bench --fleet 1` with an
     # EXPLICITLY empty fault script (stochastic block included) must
@@ -392,14 +456,15 @@ def verify_off_path(platform: str, archive: dict | None = None) -> list:
     if entry and entry.get("hashes", {}).get(platform):
         workload = dict(entry.get("workload") or STREAMING)
         workload["arrival"] = "off"
+        workload["stake"] = "off"
         current = program_hash("streaming_step", workload)
         pinned = entry["hashes"][platform]
         if current != pinned:
             failures.append(
-                f"streaming_step with arrival forced off hashes to "
-                f"{current} != pinned {pinned} — the live-traffic "
-                f"plane leaks into the arrival-disabled streaming "
-                f"program")
+                f"streaming_step with arrival and stake forced off "
+                f"hashes to {current} != pinned {pinned} — the "
+                f"live-traffic plane or the stake subsystem leaks "
+                f"into the disabled streaming program")
     return failures
 
 
@@ -426,6 +491,16 @@ def main() -> None:
                              "--update re-pins every known program")
     parser.add_argument("--list", action="store_true",
                         help="list pinned programs and their hashes")
+    parser.add_argument("--stale", action="store_true",
+                        help="flag archived pins whose program builders "
+                             "no longer exist (unknown to "
+                             "hlo_pin.PROGRAMS, or whose "
+                             "benchmarks/workload.py builders were "
+                             "renamed/removed) — pin rot is caught at "
+                             "the gate (tests/test_bench.py), not on a "
+                             "TPU window.  Composes with --list "
+                             "(annotates the listing); alone, exits 1 "
+                             "on any stale pin")
     parser.add_argument("--verify-off-path", action="store_true",
                         help="check the off-path flagship programs "
                              "(cfg.metrics_every=0 AND an empty "
@@ -435,19 +510,40 @@ def main() -> None:
                              "engine must both be statically absent on "
                              "the default path")
     args = parser.parse_args()
+    if args.stale and (args.update is not None or args.verify_off_path):
+        # --stale short-circuits before any lowering; silently skipping
+        # --update / --verify-off-path under it would green-light a CI
+        # step that never ran its real check.
+        parser.error("--stale composes with --list only; run --update "
+                     "/ --verify-off-path as their own invocations")
 
     archive = _load_archive()
 
     if args.list:
+        stale = set()
+        if args.stale:
+            stale = {s.split(":", 1)[0] for s in stale_pins(archive)}
         for name, entry in sorted(archive.get("programs", {}).items()):
             known = "" if name in PROGRAMS else "  [UNKNOWN PROGRAM]"
-            print(f"{name}{known}")
+            rot = "  [STALE]" if name in stale else ""
+            print(f"{name}{known}{rot}")
             workload = json.dumps(entry.get("workload", {}),
                                   sort_keys=True)
             print(f"  workload: {workload}")
             for platform, digest in sorted(entry.get("hashes",
                                                      {}).items()):
                 print(f"  {platform}: {digest}")
+        if args.stale and stale:
+            sys.exit(1)
+        return
+
+    if args.stale:
+        stale = stale_pins(archive)
+        if stale:
+            print("STALE PINS:\n  " + "\n  ".join(stale), file=sys.stderr)
+            sys.exit(1)
+        print(f"ok: all {len(archive.get('programs', {}))} archived "
+              f"pins have live builders")
         return
 
     import jax

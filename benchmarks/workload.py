@@ -30,7 +30,9 @@ def flagship_config(txs: int, k: int = 8, latency: int = 0,
                     latency_mode: str = "fixed",
                     timeout_rounds: int | None = None,
                     inflight_engine: str = "walk",
-                    metrics_every: int = 0):
+                    metrics_every: int = 0,
+                    stake: str = "off",
+                    clusters: int = 1):
     """The flagship bench config alone — buildable without materializing
     state (how `benchmarks/hlo_pin.py` lowers the full-shape program
     abstractly): finalization unreachable within the timed window
@@ -50,7 +52,13 @@ def flagship_config(txs: int, k: int = 8, latency: int = 0,
     async variant — the latency-0 flagship program is untouched (its
     `hlo_pin` hash never moves).  `metrics_every > 0` turns on the
     in-graph metrics tap (`bench.py --metrics`; the tapped program is
-    pinned as `flagship_metrics`)."""
+    pinned as `flagship_metrics`).  `stake` != "off" selects the
+    stake-weighted committee-draw variant (`bench.py --stake`,
+    `go_avalanche_tpu/stake.py`): peer draws run the weighted CDF,
+    and with `clusters > 1` the two-level HIERARCHICAL engine
+    (`ops/sampling.sample_peers_hierarchical`) — the program pinned
+    as `flagship_stake`; stake off leaves every archived flagship
+    pin byte-identical (`hlo_pin.py --verify-off-path`)."""
     from go_avalanche_tpu.config import AvalancheConfig
 
     async_kw = {}
@@ -70,7 +78,9 @@ def flagship_config(txs: int, k: int = 8, latency: int = 0,
                         inflight_engine=inflight_engine)
     return AvalancheConfig(finalization_score=0x7FFE, k=k, gossip=False,
                            max_element_poll=max(4096, txs),
-                           metrics_every=metrics_every, **async_kw)
+                           metrics_every=metrics_every,
+                           stake_mode=stake, n_clusters=clusters,
+                           **async_kw)
 
 
 def flagship_state(nodes: int, txs: int, k: int = 8, latency: int = 0,

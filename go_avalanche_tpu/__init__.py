@@ -11,7 +11,9 @@ as batched array computation.  Layers:
   net        L3 — Connman peer registry
   models/    L4 — batched network simulators (slush, snowflake, snowball,
              avalanche, conflict DAG, streaming backlog, streaming
-             conflict-DAG — the north-star composition)
+             conflict-DAG — the north-star composition, node-axis
+             streaming over a stake registry)
+  stake      stake distributions + registry working-set draws
   parallel/  mesh + shard_map sharding of the simulators
   utils/     golden oracle, checkpointing, metrics
 """

@@ -78,13 +78,19 @@ _FAULT_EVENT_FIELDS = {
     # start.
     "stochastic_partition": ("start", "length", "frac"),
     "stochastic_spike": ("start", "length", "extra_rounds"),
+    # PR 10 (the ROADMAP "more stochastic kinds" follow-up): a regional
+    # outage whose CLUSTER is drawn per trial — `cluster` is a [lo, hi]
+    # integer range inside [0, n_clusters), realized per sim alongside
+    # start/length by `ops/inflight.draw_fault_params`.
+    "stochastic_regional_outage": ("start", "length", "cluster"),
 }
 
 # The event kinds whose parameters are drawn at init rather than fixed
 # in the script; their realized windows are per-trial, so they are
 # exempt from the static overlap check (realized cut masks OR and spike
 # extras ADD, so overlapping realizations compose deterministically).
-_STOCHASTIC_KINDS = ("stochastic_partition", "stochastic_spike")
+_STOCHASTIC_KINDS = ("stochastic_partition", "stochastic_spike",
+                     "stochastic_regional_outage")
 
 
 def fault_script_from_json(data) -> Tuple[Tuple, ...]:
@@ -492,6 +498,32 @@ class AvalancheConfig:
                                       #   simulator into a
                                       #   capacity-planning tool
                                       #   (examples/capacity_planning.py)
+    arrival_cluster_weights: Optional[Tuple[float, ...]] = None
+                                      # per-cluster arrival skew (hot
+                                      #   regions — the ROADMAP
+                                      #   live-traffic follow-up): a [C]
+                                      #   tuple of positive rate
+                                      #   multipliers, C == n_clusters.
+                                      #   Each admission unit's home
+                                      #   region derives from its
+                                      #   position in the admission
+                                      #   order via the one cluster_of
+                                      #   spelling (contiguous blocks
+                                      #   over the backlog, exactly as
+                                      #   nodes partition), and the
+                                      #   in-graph arrival draw's rate
+                                      #   is scaled by the stream
+                                      #   head's region weight — a hot
+                                      #   region's units arrive
+                                      #   proportionally faster.
+                                      #   Requires n_clusters > 1 (the
+                                      #   region structure) and an
+                                      #   in-graph schedule mode
+                                      #   (external draws nothing);
+                                      #   inert combinations are
+                                      #   rejected.  None = statically
+                                      #   absent (flagship_traffic pin
+                                      #   unchanged)
     arrival_latency_buckets: int = 512
                                       # finality-latency histogram depth
                                       #   (rounds): per-tx arrival ->
@@ -500,6 +532,100 @@ class AvalancheConfig:
                                       #   p50/p99/p999 percentiles are
                                       #   EXACT (nearest-rank) for
                                       #   latencies under the cap
+
+    # --- stake subsystem (go_avalanche_tpu/stake.py) ---
+    stake_mode: str = "off"           # per-node stake distribution.  "off"
+                                      #   (default): every node is
+                                      #   weightless — the exact pre-stake
+                                      #   code path, every archived hlo
+                                      #   pin byte-identical (machine-
+                                      #   checked by hlo_pin.py
+                                      #   --verify-off-path).  Any other
+                                      #   mode realizes a jit-static
+                                      #   per-node stake vector
+                                      #   (stake.node_stake) that is
+                                      #   FOLDED INTO the latency_weight
+                                      #   sampling-propensity plane at
+                                      #   init, so peer draws become
+                                      #   stake-weighted committee draws
+                                      #   ("Committee Selection is More
+                                      #   Similar Than You Think",
+                                      #   PAPERS.md arXiv 1904.09839):
+                                      #   "uniform" — equal stake (the
+                                      #   weighted machinery with a flat
+                                      #   distribution); "zipf" — node i
+                                      #   holds stake 1/(i+1)^s with
+                                      #   s = stake_zipf_s (id 0
+                                      #   richest; with
+                                      #   byzantine_fraction > 0 the
+                                      #   adversary holds the TOP stake
+                                      #   — the worst case); "explicit"
+                                      #   — the stake_weights vector.
+                                      #   With n_clusters > 1 the draw
+                                      #   runs through the two-level
+                                      #   HIERARCHICAL sampler
+                                      #   (ops/sampling.
+                                      #   sample_peers_hierarchical):
+                                      #   cluster from the [C]
+                                      #   stake-mass boundaries, then
+                                      #   peer within the cluster —
+                                      #   bit-identical to the flat CDF
+                                      #   (tests/test_stake.py), and
+                                      #   SOURCE-INDEPENDENT:
+                                      #   cluster_locality is a
+                                      #   clustered-sampler knob the
+                                      #   stake family never reads
+                                      #   (committee draws are global).
+    stake_zipf_s: float = 1.0         # zipf exponent (stake_mode "zipf"
+                                      #   only; s > 0, larger = more
+                                      #   concentrated).  Rejected at any
+                                      #   non-default value under other
+                                      #   modes — a silently ignored
+                                      #   exponent would mislabel the run
+    stake_weights: Optional[Tuple[float, ...]] = None
+                                      # stake_mode "explicit": the
+                                      #   per-node stake vector (positive
+                                      #   finite numbers; length must
+                                      #   match the node count at
+                                      #   realization — and
+                                      #   registry_nodes when the node
+                                      #   registry is on, validated
+                                      #   here).  Required there,
+                                      #   rejected elsewhere
+    registry_nodes: int = 0           # node-axis streaming scheduler
+                                      #   (models/node_stream.py): the
+                                      #   REGISTRY size R — the full node
+                                      #   population, of which only
+                                      #   active_nodes rows are resident
+                                      #   in the dense [W, T] window at a
+                                      #   time (the DAG-Sword
+                                      #   active-working-set regime,
+                                      #   PAPERS.md arXiv 2311.04638:
+                                      #   nodes >> devices*VMEM as a
+                                      #   supported regime instead of an
+                                      #   OOM).  0 (default) = off; > 0
+                                      #   requires active_nodes in
+                                      #   (0, registry_nodes) and a
+                                      #   stake_mode (the working set is
+                                      #   drawn STAKE-proportionally —
+                                      #   "uniform" gives uniform
+                                      #   residency)
+    active_nodes: int = 0             # node_stream working-set rows W
+                                      #   (see registry_nodes); the dense
+                                      #   window the consensus round
+                                      #   runs on.  Both-or-neither with
+                                      #   registry_nodes
+    node_churn_rate: float = 0.0      # node_stream: P(an active row
+                                      #   rotates out, per step).
+                                      #   Departing rows' vote records
+                                      #   retire; arriving rows are drawn
+                                      #   stake-proportionally from the
+                                      #   non-resident registry (exact
+                                      #   weighted-without-replacement
+                                      #   Gumbel top-k) and initialize
+                                      #   from the registry prior.  In
+                                      #   [0, 1]; > 0 requires the
+                                      #   registry (inert otherwise)
 
     # --- fault / adversary model (SURVEY.md section 2.4 item 5) ---
     byzantine_fraction: float = 0.0   # nodes that vote adversarially
@@ -567,6 +693,14 @@ class AvalancheConfig:
         key."""
         return tuple(e for e in self.fault_events()
                      if e[0] == "stochastic_spike")
+
+    def stochastic_region_events(self) -> Tuple[Tuple, ...]:
+        """stochastic_regional_outage events — regional outages whose
+        realized (start, length, cluster) is drawn per sim from the init
+        key; every field here is a validated (lo, hi) range (the cluster
+        range is integer, inside [0, n_clusters))."""
+        return tuple(e for e in self.fault_events()
+                     if e[0] == "stochastic_regional_outage")
 
     def stochastic_events(self) -> Tuple[Tuple, ...]:
         """All stochastic events, in script order — the list
@@ -699,6 +833,7 @@ class AvalancheConfig:
         self._validate_fault_script()
         self._validate_rtt_matrix()
         self._validate_arrival()
+        self._validate_stake()
         if self.latency_mode == "rtt":
             if self.rtt_matrix is None:
                 raise ValueError(
@@ -884,6 +1019,19 @@ class AvalancheConfig:
 
         _range(fields[0], ev[1], integer=True, lo_min=0)       # start
         _range(fields[1], ev[2], integer=True, lo_min=1)       # length
+        if kind == "stochastic_regional_outage":
+            if self.n_clusters < 2:
+                raise ValueError(
+                    f"fault_script[{i}]: stochastic_regional_outage "
+                    f"needs a clustered topology (n_clusters > 1), got "
+                    f"n_clusters={self.n_clusters}")
+            _range(fields[2], ev[3], integer=True, lo_min=0)   # cluster
+            if ev[3][1] >= self.n_clusters:
+                raise ValueError(
+                    f"fault_script[{i}]: stochastic_regional_outage "
+                    f"cluster range must stay inside [0, "
+                    f"{self.n_clusters}), got {ev[3]!r}")
+            return
         if kind == "stochastic_partition":
             # frac needs OPEN bounds on both sides, which _range's
             # lo_min<=lo<=hi shape doesn't spell — validated here with
@@ -923,6 +1071,12 @@ class AvalancheConfig:
                     "arrival_backpressure is only read when arrival_mode "
                     "is on (occupancy throttles the arrival draw); with "
                     "mode 'off' it would be silently ignored")
+            if self.arrival_cluster_weights is not None:
+                raise ValueError(
+                    "arrival_cluster_weights is only read when "
+                    "arrival_mode is on (it scales the in-graph arrival "
+                    "draw per region); with mode 'off' it would be "
+                    "silently ignored")
             return
         if self.arrival_mode == "external":
             if self.arrival_rate != 0.0:
@@ -983,10 +1137,136 @@ class AvalancheConfig:
                     f"arrival_backpressure needs 0 <= lo < hi <= 1 "
                     f"(full rate below lo, fully throttled above hi), "
                     f"got {bp!r}")
+        if self.arrival_cluster_weights is not None:
+            if self.arrival_mode == "external":
+                raise ValueError(
+                    "arrival_cluster_weights scales the in-graph arrival "
+                    "DRAW, which arrival_mode 'external' never performs "
+                    "(pushed arrivals are admitted as-is) — a silently "
+                    "inert skew would mislabel the run as hot-region "
+                    "traffic")
+            if self.n_clusters < 2:
+                raise ValueError(
+                    "arrival_cluster_weights needs a clustered topology "
+                    "(n_clusters > 1): the per-region admission blocks "
+                    "derive from the same cluster_of partition as the "
+                    "node clusters — with one cluster the skew is inert")
+            wts = tuple(self.arrival_cluster_weights)
+            object.__setattr__(self, "arrival_cluster_weights", wts)
+            if len(wts) != self.n_clusters:
+                raise ValueError(
+                    f"arrival_cluster_weights is one rate multiplier per "
+                    f"cluster (n_clusters = {self.n_clusters}), got "
+                    f"{len(wts)} entries")
+            for i, w in enumerate(wts):
+                if isinstance(w, bool) or not isinstance(w, (int, float)) \
+                        or not (w > 0.0) or not math.isfinite(w):
+                    raise ValueError(
+                        f"arrival_cluster_weights[{i}] must be a "
+                        f"positive finite rate multiplier, got {w!r}")
         if self.arrival_latency_buckets < 2:
             raise ValueError(
                 f"arrival_latency_buckets must be >= 2 (latencies clamp "
                 f"into [0, buckets)), got {self.arrival_latency_buckets}")
+
+    def _validate_stake(self) -> None:
+        """Stake / node-registry knobs (`go_avalanche_tpu/stake.py`,
+        `models/node_stream.py`): reject inert or out-of-range configs
+        at CONSTRUCTION (the rtt_matrix rule); run_sim mirrors these at
+        its parser."""
+        modes = ("off", "uniform", "zipf", "explicit")
+        if self.stake_mode not in modes:
+            raise ValueError(
+                f"stake_mode must be one of {', '.join(modes)}, got "
+                f"{self.stake_mode!r}")
+        if self.stake_mode == "zipf":
+            if not (isinstance(self.stake_zipf_s, (int, float))
+                    and not isinstance(self.stake_zipf_s, bool)
+                    and self.stake_zipf_s > 0.0
+                    and math.isfinite(self.stake_zipf_s)):
+                raise ValueError(
+                    f"stake_zipf_s must be a positive finite zipf "
+                    f"exponent, got {self.stake_zipf_s!r}")
+        elif self.stake_zipf_s != 1.0:
+            raise ValueError(
+                f"stake_zipf_s is only read by stake_mode 'zipf', got "
+                f"exponent {self.stake_zipf_s!r} with mode "
+                f"{self.stake_mode!r} — a silently ignored exponent "
+                f"would mislabel the run")
+        if self.stake_mode == "explicit":
+            if self.stake_weights is None:
+                raise ValueError(
+                    "stake_mode 'explicit' needs a stake_weights vector "
+                    "(one positive stake per node)")
+            wts = tuple(self.stake_weights)
+            object.__setattr__(self, "stake_weights", wts)
+            if not wts:
+                raise ValueError("stake_weights must be non-empty")
+            for i, w in enumerate(wts):
+                if isinstance(w, bool) or not isinstance(w, (int, float)) \
+                        or not (w > 0.0) or not math.isfinite(w):
+                    raise ValueError(
+                        f"stake_weights[{i}] must be a positive finite "
+                        f"stake, got {w!r}")
+        elif self.stake_weights is not None:
+            raise ValueError(
+                f"stake_weights is only read by stake_mode 'explicit', "
+                f"got a vector with mode {self.stake_mode!r} — a "
+                f"silently ignored vector would mislabel the run")
+        if self.stake_mode != "off":
+            if not self.sample_with_replacement:
+                raise ValueError(
+                    "stake-weighted sampling requires "
+                    "sample_with_replacement (same O(N^2) Gumbel-top-k "
+                    "argument as weighted_sampling)")
+            if self.latency_mode == "weighted":
+                raise ValueError(
+                    "stake_mode folds the stake vector into the "
+                    "latency_weight sampling-propensity plane at init; "
+                    "latency_mode 'weighted' reads that same plane to "
+                    "derive response latency, which would silently "
+                    "couple delay to stake — use fixed/geometric/rtt "
+                    "latency with stake")
+        # --- node registry (models/node_stream.py) ---
+        if (self.registry_nodes > 0) != (self.active_nodes > 0):
+            raise ValueError(
+                f"registry_nodes and active_nodes come together (the "
+                f"node-stream scheduler streams active_nodes resident "
+                f"rows out of a registry_nodes population), got "
+                f"registry_nodes={self.registry_nodes}, "
+                f"active_nodes={self.active_nodes}")
+        if self.registry_nodes < 0 or self.active_nodes < 0:
+            raise ValueError("registry_nodes/active_nodes must be >= 0 "
+                             "(0 disables the node registry)")
+        if self.registry_nodes > 0:
+            if self.stake_mode == "off":
+                raise ValueError(
+                    "the node registry draws its working set "
+                    "STAKE-proportionally — registry_nodes > 0 needs a "
+                    "stake_mode ('uniform' for uniform residency)")
+            if not (self.active_nodes < self.registry_nodes):
+                raise ValueError(
+                    f"active_nodes ({self.active_nodes}) must be "
+                    f"smaller than registry_nodes "
+                    f"({self.registry_nodes}): churn rotates the window "
+                    f"through a non-resident pool, which an "
+                    f"active == registry config leaves empty")
+            if (self.stake_mode == "explicit"
+                    and len(self.stake_weights) != self.registry_nodes):
+                raise ValueError(
+                    f"with the node registry on, stake_weights is the "
+                    f"REGISTRY's stake vector: expected "
+                    f"{self.registry_nodes} entries, got "
+                    f"{len(self.stake_weights)}")
+        if not (0.0 <= self.node_churn_rate <= 1.0):
+            raise ValueError(
+                f"node_churn_rate must be in [0, 1], got "
+                f"{self.node_churn_rate!r}")
+        if self.node_churn_rate > 0.0 and self.registry_nodes == 0:
+            raise ValueError(
+                "node_churn_rate is only read by the node-stream "
+                "scheduler (registry_nodes > 0) — without the registry "
+                "the knob is inert and would mislabel the run")
 
     def _validate_rtt_matrix(self) -> None:
         """The cluster-pair RTT matrix must be square, match the

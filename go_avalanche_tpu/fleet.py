@@ -182,6 +182,11 @@ class TrialOutcome(NamedTuple):
     lat_p999: Optional[jax.Array] = None  #   plane (backlog model with
                                   #   arrivals on; None otherwise)
     arrived: Optional[jax.Array] = None   # int32 — units arrived
+    region_start: Optional[jax.Array] = None  # int32 [Er] realized
+    region_end: Optional[jax.Array] = None    #   stochastic_regional_
+    region_cluster: Optional[jax.Array] = None  # outage windows + the
+                                  #   drawn severed cluster (None: none
+                                  #   scheduled)
 
 
 def _fault_realizations(fault_params) -> Dict:
@@ -197,7 +202,10 @@ def _fault_realizations(fault_params) -> Dict:
                 cut_split=fault_params.cut_split,
                 spike_start=fault_params.spike_start,
                 spike_end=fault_params.spike_end,
-                spike_extra=fault_params.spike_extra)
+                spike_extra=fault_params.spike_extra,
+                region_start=fault_params.region_start,
+                region_end=fault_params.region_end,
+                region_cluster=fault_params.region_cluster)
 
 
 def _outcome_snowball(state, cfg: AvalancheConfig) -> TrialOutcome:
@@ -381,6 +389,10 @@ class FleetResult:
                                     # int32 [F, Es, 3] realized
                                     #   stochastic_spike (start, end,
                                     #   extra) triples
+    region_windows: Optional[np.ndarray] = None
+                                    # int32 [F, Er, 3] realized
+                                    #   stochastic_regional_outage
+                                    #   (start, end, cluster) triples
     lat_percentiles: Optional[np.ndarray] = None
                                     # int32 [F, 3] per-trial finality-
                                     #   latency (p50, p99, p999); the
@@ -451,6 +463,9 @@ class FleetResult:
             out["cut"] = cuts.astype(int).tolist()
         if self.spike_windows is not None and self.spike_windows.shape[1]:
             out["spike"] = self.spike_windows.astype(int).tolist()
+        if (self.region_windows is not None
+                and self.region_windows.shape[1]):
+            out["region"] = self.region_windows.astype(int).tolist()
         return out
 
 
@@ -498,6 +513,22 @@ def run_fleet(
             "for fleet offered-load sweeps")
     if fleet < 1:
         raise ValueError(f"fleet must be >= 1, got {fleet}")
+    if cfg.stake_mode != "off" and model == "snowball":
+        raise ValueError(
+            "the snowball model samples peers uniformly (no "
+            "latency_weight plane), so a stake config is inert there "
+            "and every trial would be mislabeled "
+            f"'{cfg.stake_mode}-stake' — use the avalanche/dag/backlog "
+            "models for stake-weighted committee fleets")
+    if cfg.registry_nodes > 0:
+        raise ValueError(
+            "the node registry (cfg.registry_nodes) is the node-stream "
+            "scheduler's axis (models/node_stream), which no fleet "
+            "model runs — av.init deliberately skips the stake fold "
+            "under the registry, so every trial would draw UNIFORM "
+            f"peers while tagged 'registry{cfg.registry_nodes}/"
+            f"{cfg.active_nodes}'; a fleet node_stream model is the "
+            "open ROADMAP follow-up (million-node axis, next steps)")
     if cfg.metrics_every > 0:
         raise ValueError(
             "the in-graph metrics tap (cfg.metrics_every > 0) cannot "
@@ -516,7 +547,7 @@ def run_fleet(
     settled = np.asarray(jax.device_get(outcome.settled))
     finality = np.asarray(jax.device_get(outcome.finality_round))
     frac = np.asarray(jax.device_get(outcome.finalized_fraction))
-    cut_windows = cut_split = spike_windows = None
+    cut_windows = cut_split = spike_windows = region_windows = None
     if outcome.cut_start is not None:
         cut_windows = np.stack(
             [np.asarray(jax.device_get(outcome.cut_start)),
@@ -526,6 +557,11 @@ def run_fleet(
             [np.asarray(jax.device_get(outcome.spike_start)),
              np.asarray(jax.device_get(outcome.spike_end)),
              np.asarray(jax.device_get(outcome.spike_extra))], axis=-1)
+        region_windows = np.stack(
+            [np.asarray(jax.device_get(outcome.region_start)),
+             np.asarray(jax.device_get(outcome.region_end)),
+             np.asarray(jax.device_get(outcome.region_cluster))],
+            axis=-1)
     lat_percentiles = arrived = None
     if outcome.lat_p50 is not None:
         lat_percentiles = np.stack(
@@ -539,7 +575,7 @@ def run_fleet(
         violations=violations, settled=settled, finality_round=finality,
         finalized_fraction=frac, telemetry=jax.device_get(telemetry),
         cut_windows=cut_windows, cut_split=cut_split,
-        spike_windows=spike_windows,
+        spike_windows=spike_windows, region_windows=region_windows,
         lat_percentiles=lat_percentiles, arrived=arrived,
         p_violation=float(violations.mean()),
         violation_ci=wilson_interval(int(violations.sum()), fleet),
@@ -591,6 +627,7 @@ _GRID_AXES = {
     "latency_rounds": int,
     "adversary_strategy": str,
     "arrival_rate": float,
+    "stake_zipf_s": float,
 }
 
 
@@ -706,6 +743,17 @@ def run_phase_grid(
                 f"model (the traffic plane is not threaded through "
                 f"{model!r} — every point would measure the same "
                 f"program)")
+    if (base_cfg.stake_mode != "zipf"
+            and any("stake_zipf_s" in p for p in points)):
+        # Same inert-knob class as latency_rounds: under any other
+        # stake mode the exponent is rejected (or ignored) per point —
+        # fail with the sweep-level message before the first point
+        # compiles.
+        raise ValueError(
+            "a stake_zipf_s phase axis needs the base config's "
+            "stake_mode set to 'zipf' (the exponent is only read "
+            "there — every point would otherwise reject or measure "
+            "the same program)")
     rows = []
     for point in points:
         cfg = point_config(base_cfg, point)

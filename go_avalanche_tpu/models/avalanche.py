@@ -248,6 +248,20 @@ def init(
         valid = jnp.ones((n_txs,), jnp.bool_)
     if latency_weights is None:
         latency_weights = jnp.ones((n_nodes,), jnp.float32)
+    latency_weights = jnp.asarray(latency_weights, jnp.float32)
+    if cfg.stake_mode != "off" and not cfg.registry_nodes:
+        # Stake subsystem (go_avalanche_tpu/stake.py): the jit-static
+        # per-node stake vector folds into the sampling-propensity
+        # plane, turning every peer draw into a stake-weighted
+        # committee draw (`ops/sampling.draw_peers` stake dispatch).
+        # Off = plane untouched (every archived hlo pin byte-identical).
+        # With the node registry on, row index != node id — the
+        # node-stream scheduler owns the plane and overwrites it with
+        # the residents' registry stakes (`models/node_stream.init`).
+        from go_avalanche_tpu import stake as stake_mod
+
+        latency_weights = latency_weights * stake_mod.node_stake(
+            cfg, n_nodes)
 
     n_byz = int(round(cfg.byzantine_fraction * n_nodes))
     score_rank, poll_order, poll_order_inv = score_rank_with_orders(scores)

@@ -1,0 +1,333 @@
+"""Node-axis streaming scheduler: million-node registries in bounded HBM.
+
+`models/backlog.py` opened the TX axis: a bounded window of W slots
+streams a 1M-tx backlog through dense ``[N, W]`` state.  This module is
+its mirror on the NODE axis — the last un-scaled dimension.  A
+production network has a *registry* of R nodes (R can be 1M+), but only
+a bounded ACTIVE working set participates in any round (DAG-Sword,
+PAPERS.md arXiv 2311.04638, simulates large topologies by keeping only
+an active set resident).  Here:
+
+  * the **registry** lives as cheap ``[R]`` metadata (stake, residency)
+    — megabytes at 1M nodes, noise next to the window planes;
+  * the **active window** is a dense ``[W, T]`` `AvalancheSimState`
+    whose row r hosts registry node `slot_node[r]`; the inner consensus
+    round is exactly `models/avalanche.round_step`, so everything
+    composes — stake-weighted committee draws (`cfg.stake_mode`, row
+    propensities are the residents' registry stakes), fault scripts,
+    vote modes, ingest engines, and the sharded nodes axis
+    (`parallel/sharded_node_stream.py`);
+  * the working set is drawn **stake-proportionally** from the registry
+    (exact weighted-without-replacement Gumbel top-k,
+    `stake.draw_working_set`) and **churn** rotates it: each step every
+    active row departs with probability `cfg.node_churn_rate`;
+    departing rows' vote records retire (the node leaves, its window
+    rows are surrendered) and arriving rows initialize from the
+    registry prior — exactly how a fresh `NewVoteRecord(t.IsAccepted())`
+    seeds (`processor.go:56`).  The window stays FULL: a departure
+    without a drawable replacement (the non-resident pool is exhausted
+    of positive-stake nodes) is cancelled.
+
+This is what makes ``nodes >> devices * VMEM`` a supported regime
+instead of an OOM: HBM holds ``W x T`` consensus state however large R
+grows, and the registry axis costs one ``[R]`` top-k per step.
+
+Determinism contract (mirrors the live-traffic plane,
+`go_avalanche_tpu/traffic.py`): the churn stream folds its OWN key off
+the sim init key (`_CHURN_FOLD`), so (1) the consensus PRNG is
+untouched — a churn-rate-0 run is bit-identical to the plain window
+sim — and (2) the dense and sharded schedulers realize the SAME
+working-set trajectory for the same key (the draw runs on replicated
+registry state; `tests/test_node_stream.py` pins `slot_node` /
+`resident` / the churn counters leaf-exact dense vs sharded, the same
+window-parity the acceptance criterion names — the inner round's
+per-shard PRNG streams differ by design, like every sharded model).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from go_avalanche_tpu import stake as stake_mod
+from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
+from go_avalanche_tpu.models import avalanche as av
+from go_avalanche_tpu.obs import sink as obs_sink
+from go_avalanche_tpu.ops import inflight
+from go_avalanche_tpu.ops import voterecord as vr
+
+# fold_in constant deriving the registry-churn stream from the sim's
+# init key: rotating the window must never perturb the consensus
+# draws (a node_churn_rate-0 node-stream trajectory is bit-identical
+# to the plain [W, T] sim's), and the replicated draw is what makes
+# dense == sharded on the working-set window.
+_CHURN_FOLD = 0x2E617
+
+
+class NodeStreamState(NamedTuple):
+    """Active window + registry; the full node-streaming sim state."""
+
+    sim: av.AvalancheSimState   # dense [W, T] window state; row r hosts
+                                #   registry node slot_node[r]
+    slot_node: jax.Array        # int32 [W] — registry id per window row
+    resident: jax.Array         # bool [R] — registry nodes currently in
+                                #   the window (always exactly W True)
+    stake: jax.Array            # float32 [R] — the registry stake plane
+                                #   (cfg.stake_mode realized over R)
+    init_pref: jax.Array        # bool [T] — the prior an arriving
+                                #   node's fresh records adopt
+    churn_key: jax.Array        # the registry churn PRNG stream (its
+                                #   own fold off the init key)
+    churned_in: jax.Array       # int32 — cumulative arrivals
+    churned_out: jax.Array      # int32 — cumulative departures
+
+
+class NodeStreamTelemetry(NamedTuple):
+    """Per-step scalars: the inner round's telemetry plus registry
+    stats."""
+
+    round: av.SimTelemetry
+    departed: jax.Array        # int32 — rows rotated out this step
+    resident_stake: jax.Array  # float32 — fraction of total registry
+                               #   stake currently resident (the
+                               #   committee's voting-power coverage)
+
+
+def _registry_byzantine(cfg: AvalancheConfig, r: int) -> jax.Array:
+    """bool [R]: the registry's adversarial nodes — the first
+    ``round(byzantine_fraction * R)`` ids, the same convention as
+    `av.init` (with zipf stake this is the TOP-stake adversary — the
+    worst case, documented in config.py)."""
+    n_byz = int(round(cfg.byzantine_fraction * r))
+    return jnp.arange(r, dtype=jnp.int32) < n_byz
+
+
+def init(
+    key: jax.Array,
+    n_txs: int,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    init_pref: Optional[jax.Array] = None,
+    scores: Optional[jax.Array] = None,
+    track_finality: bool = True,
+) -> NodeStreamState:
+    """Fresh registry + a stake-proportionally drawn initial window.
+
+    R/W come from `cfg.registry_nodes` / `cfg.active_nodes` (validated
+    together with `cfg.stake_mode` at config construction).  The
+    initial W residents are an exact weighted-without-replacement draw
+    over the registry stake; `init_pref` (bool ``[T]``, default
+    all-accepted) is both the window's initial prior and the prior
+    every later arrival adopts.
+    """
+    if not stake_mod.registry_enabled(cfg):
+        raise ValueError(
+            "the node-stream scheduler needs cfg.registry_nodes / "
+            "cfg.active_nodes set (the registry-off window sim is "
+            "models/avalanche)")
+    r, w = cfg.registry_nodes, cfg.active_nodes
+    stake_r = stake_mod.node_stake(cfg, r)
+    churn_key = jax.random.fold_in(key, _CHURN_FOLD)
+    churn_key, k_draw = jax.random.split(churn_key)
+    # Every built-in stake mode realizes strictly positive stakes
+    # (config-validated for "explicit"), so the full W-draw is always
+    # honored here — `valid` only matters for the churn pass's masked
+    # pool.
+    ids, _ = stake_mod.draw_working_set(k_draw, stake_r, w)
+    if init_pref is None:
+        init_pref = jnp.ones((n_txs,), jnp.bool_)
+    init_pref = jnp.asarray(init_pref, jnp.bool_)
+    # Canonical ascending row order for the initial window (top-k order
+    # is score-sorted; rows are an arbitrary hosting assignment).
+    slot_node = jnp.sort(ids)
+    resident = (jnp.zeros((r,), jnp.bool_)
+                .at[slot_node].set(True))
+    sim = av.init(key, w, n_txs, cfg, init_pref=init_pref,
+                  scores=scores, track_finality=track_finality)
+    byz_r = _registry_byzantine(cfg, r)
+    sim = sim._replace(
+        # Row propensities are the RESIDENTS' registry stakes — row
+        # index is a hosting slot, not a node id, so av.init's
+        # positional stake fold is skipped under the registry
+        # (models/avalanche.init) and the plane is owned here.
+        latency_weight=stake_r[slot_node],
+        byzantine=byz_r[slot_node],
+    )
+    return NodeStreamState(
+        sim=sim,
+        slot_node=slot_node,
+        resident=resident,
+        stake=stake_r,
+        init_pref=init_pref,
+        churn_key=churn_key,
+        churned_in=jnp.int32(0),
+        churned_out=jnp.int32(0),
+    )
+
+
+def draw_churn_swaps(state: NodeStreamState, cfg: AvalancheConfig):
+    """The churn pass's REPLICATED draw: which rows rotate, to whom,
+    and the updated residency — everything a shard can compute
+    identically from replicated registry planes.  Returns
+    ``(swap [W], new_slot [W], resident [R], n_swapped, next key)``.
+
+    THE one spelling of the rotation rule, shared verbatim by the
+    dense scheduler below and the sharded twin
+    (`parallel/sharded_node_stream._local_churn`): the dense-vs-
+    sharded leaf-exact window parity rests on both drivers executing
+    THIS draw, so a second copy could silently diverge.
+
+    Exact stake-proportional arrivals from the non-resident pool; the
+    pool holds R - W entries, so at most min(W, R - W) swaps can be
+    honored per step (excess departures are cancelled — the window
+    never runs rows empty).
+    """
+    w = state.slot_node.shape[0]
+    r = state.resident.shape[0]
+    k_dep, k_arr, k_next = jax.random.split(state.churn_key, 3)
+    depart = jax.random.bernoulli(k_dep, cfg.node_churn_rate, (w,))
+    cap = min(w, r - w)
+    cand_ids, cand_valid = stake_mod.draw_working_set(
+        k_arr, state.stake, cap, mask=jnp.logical_not(state.resident))
+    rank = jnp.cumsum(depart.astype(jnp.int32)) - 1     # rank among departs
+    rank_safe = jnp.clip(rank, 0, cap - 1)
+    swap = depart & (rank < cap) & cand_valid[rank_safe]
+    new_slot = jnp.where(swap, cand_ids[rank_safe], state.slot_node)
+    # Residency flip: departing ids out, arriving ids in (one dropped-
+    # write scatter each; swaps are disjoint by construction).
+    resident = (state.resident
+                .at[jnp.where(swap, state.slot_node, r)]
+                .set(False, mode="drop")
+                .at[jnp.where(swap, new_slot, r)]
+                .set(True, mode="drop"))
+    return swap, new_slot, resident, swap.sum().astype(jnp.int32), k_next
+
+
+def churn(state: NodeStreamState,
+          cfg: AvalancheConfig) -> Tuple[NodeStreamState, jax.Array]:
+    """One churn pass: rotate departing rows out, draw replacements
+    stake-proportionally from the non-resident registry.  Returns
+    ``(new_state, rows_swapped)``.  Statically absent (state passes
+    through untraced) when `cfg.node_churn_rate` is 0.
+
+    Every draw here runs on REPLICATED registry planes from the
+    dedicated churn key (`draw_churn_swaps`), so the sharded twin
+    realizes the identical swap sequence (the dense-vs-sharded
+    window-parity contract).
+    """
+    if cfg.node_churn_rate <= 0.0:
+        return state, jnp.int32(0)
+    sim = state.sim
+    r = state.resident.shape[0]
+    swap, new_slot, resident, n_swapped, k_next = draw_churn_swaps(
+        state, cfg)
+
+    # Rotate the window rows: departing records RETIRE (surrendered
+    # with the row), arrivals seed fresh records from the registry
+    # prior — exactly the backlog scheduler's refill shape, on the
+    # other axis.
+    fresh = vr.init_state(jnp.broadcast_to(state.init_pref[None, :],
+                                           sim.records.votes.shape))
+
+    def fill(plane, fresh_plane):
+        return jnp.where(swap[:, None], fresh_plane, plane)
+
+    records = vr.VoteRecordState(
+        votes=fill(sim.records.votes, fresh.votes),
+        consider=fill(sim.records.consider, fresh.consider),
+        confidence=fill(sim.records.confidence, fresh.confidence),
+    )
+    added = jnp.where(swap[:, None], True, sim.added)
+    finalized_at = (None if sim.finalized_at is None
+                    else jnp.where(swap[:, None], -1, sim.finalized_at))
+    byz_r = _registry_byzantine(cfg, r)
+    new_sim = sim._replace(
+        records=records,
+        added=added,
+        finalized_at=finalized_at,
+        latency_weight=state.stake[new_slot],
+        byzantine=byz_r[new_slot],
+        alive=jnp.where(swap, True, sim.alive),
+        # Responses still in flight for a departed node must not land
+        # on — or be answered by proxy of — its replacement (the swap
+        # mask gates both the querier and the polled-peer side).
+        inflight=inflight.clear_rows(sim.inflight, swap,
+                                     peer_rows=swap),
+    )
+    return state._replace(
+        sim=new_sim,
+        slot_node=new_slot,
+        resident=resident,
+        churn_key=k_next,
+        churned_in=state.churned_in + n_swapped,
+        churned_out=state.churned_out + n_swapped,
+    ), n_swapped
+
+
+def step(
+    state: NodeStreamState,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+) -> Tuple[NodeStreamState, NodeStreamTelemetry]:
+    """Churn the window, then one consensus round on it.  Pure; scans.
+
+    With the in-graph metrics tap on (`cfg.metrics_every > 0`) the
+    SCHEDULER emits the full `NodeStreamTelemetry` record and
+    suppresses the inner round's own emission, one JSONL line per
+    round (the backlog scheduler's convention, docs/observability.md).
+    """
+    round_val = state.sim.round
+    state, swapped = churn(state, cfg)
+    inner_cfg = (cfg if cfg.metrics_every == 0
+                 else dataclasses.replace(cfg, metrics_every=0))
+    new_sim, round_tel = av.round_step(state.sim, inner_cfg)
+    new_state = state._replace(sim=new_sim)
+    total = state.stake.sum()
+    tel = NodeStreamTelemetry(
+        round=round_tel,
+        departed=swapped,
+        resident_stake=(jnp.where(state.resident, state.stake, 0.0).sum()
+                        / jnp.maximum(total, jnp.float32(1e-38))),
+    )
+    obs_sink.emit_round(cfg, round_val, tel)
+    return new_state, tel
+
+
+def run_scan(
+    state: NodeStreamState,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    n_rounds: int = 100,
+) -> Tuple[NodeStreamState, NodeStreamTelemetry]:
+    """Fixed-round run with stacked telemetry (the node axis has no
+    drain condition — the registry never exhausts)."""
+
+    def body(s, _):
+        new_s, tel = step(s, cfg)
+        return new_s, tel
+
+    return lax.scan(body, state, None, length=n_rounds)
+
+
+def window_summary(state: NodeStreamState,
+                   cfg: AvalancheConfig = DEFAULT_CONFIG) -> dict:
+    """Host-side digest of a final state: window finality, churn
+    totals, and resident stake coverage (one device_get batch)."""
+    fin = vr.has_finalized(state.sim.records.confidence, cfg)
+    total = state.stake.sum()
+    out = jax.device_get({
+        "finalized_fraction": fin.mean(),
+        "churned_in": state.churned_in,
+        "churned_out": state.churned_out,
+        "resident_stake_fraction":
+            jnp.where(state.resident, state.stake, 0.0).sum()
+            / jnp.maximum(total, jnp.float32(1e-38)),
+        "resident_count": state.resident.sum(),
+    })
+    return {"finalized_fraction": float(out["finalized_fraction"]),
+            "churned_in": int(out["churned_in"]),
+            "churned_out": int(out["churned_out"]),
+            "resident_stake_fraction":
+                float(out["resident_stake_fraction"]),
+            "resident_count": int(out["resident_count"])}

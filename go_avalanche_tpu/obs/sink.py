@@ -105,7 +105,7 @@ class MetricsSink:
         wrote = 0
         for r in range(0, n, every):
             self.write({"round": start_round + r,
-                        **{k: int(np.asarray(v[r])) for k, v in
+                        **{k: _scalar(np.asarray(v[r])) for k, v in
                            flat.items()}})
             wrote += 1
         return wrote
@@ -143,12 +143,20 @@ def active_sink() -> Optional[MetricsSink]:
     return _ACTIVE[-1] if _ACTIVE else None
 
 
+def _scalar(a):
+    """JSON-ready python scalar: floats stay floats (the PR 10
+    `resident_stake` fraction — an int() cast silently truncated it to
+    0), every integer/bool counter stays int."""
+    return float(a) if np.issubdtype(a.dtype, np.floating) else int(a)
+
+
 def _host_write(payload: dict) -> None:
     """io_callback target: route one record to the active sink (drop
     when none — the compiled program outlives any one sink)."""
     if not _ACTIVE:
         return
-    _ACTIVE[-1].write({k: int(np.asarray(v)) for k, v in payload.items()})
+    _ACTIVE[-1].write({k: _scalar(np.asarray(v))
+                       for k, v in payload.items()})
 
 
 def emit_round(cfg, round_, telemetry) -> None:

@@ -29,11 +29,23 @@ fixed order:
                                untagged (no archived chain used one)
     ", {engine}-inflight"      cfg.inflight_engine != "walk"
     ", partition"              cfg.partition_spec scheduled
+    ", {mode}-stake[S]"        cfg.stake_mode != "off" (stake-weighted
+                               committee draws change the timed
+                               program; S = stake_zipf_s, %g-formatted,
+                               zipf mode only)
+    ", hier{C}"                stake on with n_clusters > 1 (the
+                               two-level hierarchical sampling engine;
+                               C = n_clusters)
+    ", registry{R}/{W}"        cfg.registry_nodes > 0 (the node-axis
+                               streaming scheduler's R-entry registry
+                               over a W-row window)
     ", {mode}-arrival{R}"      cfg.arrivals_enabled() (the live-traffic
                                plane changes the timed program; R =
                                arrival_rate, %g-formatted)
     ", backpressure"           cfg.arrival_backpressure set (closed-loop
                                admission throttles the offered rate)
+    ", arrival-skew"           cfg.arrival_cluster_weights set (hot-
+                               region per-cluster rate multipliers)
     ", metrics{N}"             cfg.metrics_every > 0 (the in-graph tap
                                changes the timed program)
 """
@@ -78,10 +90,20 @@ def tag_from_config(cfg: AvalancheConfig) -> str:
             tag += f", {cfg.inflight_engine}-inflight"
         if cfg.partition_spec is not None:
             tag += ", partition"
+    if cfg.stake_mode != "off":
+        tag += f", {cfg.stake_mode}-stake"
+        if cfg.stake_mode == "zipf":
+            tag += f"{cfg.stake_zipf_s:g}"
+        if cfg.n_clusters > 1:
+            tag += f", hier{cfg.n_clusters}"
+    if cfg.registry_nodes > 0:
+        tag += f", registry{cfg.registry_nodes}/{cfg.active_nodes}"
     if cfg.arrivals_enabled():
         tag += f", {cfg.arrival_mode}-arrival{cfg.arrival_rate:g}"
         if cfg.arrival_backpressure is not None:
             tag += ", backpressure"
+        if cfg.arrival_cluster_weights is not None:
+            tag += ", arrival-skew"
     if cfg.metrics_every > 0:
         tag += f", metrics{cfg.metrics_every}"
     return tag

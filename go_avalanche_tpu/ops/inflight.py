@@ -145,6 +145,11 @@ class FaultParams(NamedTuple):
     spike_start: jax.Array  # int32 [Es] — stochastic_spike starts
     spike_end: jax.Array    # int32 [Es]
     spike_extra: jax.Array  # int32 [Es] — realized extra rounds
+    region_start: jax.Array  # int32 [Er] — stochastic_regional_outage
+    region_end: jax.Array    #   realized windows (end-exclusive)
+    region_cluster: jax.Array  # int32 [Er] — the realized severed
+                            #   cluster, drawn from the event's
+                            #   [lo, hi] cluster range
 
 
 def _stochastic_split(cfg: AvalancheConfig, n_global: int,
@@ -180,6 +185,7 @@ def draw_fault_params(cfg: AvalancheConfig, key: jax.Array,
     key = jax.random.fold_in(key, _FAULT_PARAM_FOLD)
     cut = {"start": [], "end": [], "split": []}
     spike = {"start": [], "end": [], "extra": []}
+    region = {"start": [], "end": [], "cluster": []}
     for i, ev in enumerate(events):
         ks, kl, kp = jax.random.split(jax.random.fold_in(key, i), 3)
         (slo, shi), (llo, lhi) = ev[1], ev[2]
@@ -194,6 +200,12 @@ def draw_fault_params(cfg: AvalancheConfig, key: jax.Array,
             cut["start"].append(start)
             cut["end"].append(start + length)
             cut["split"].append(_stochastic_split(cfg, n_global, frac))
+        elif ev[0] == "stochastic_regional_outage":
+            clo, chi = ev[3]
+            region["start"].append(start)
+            region["end"].append(start + length)
+            region["cluster"].append(jax.random.randint(
+                kp, (), int(clo), int(chi) + 1, dtype=jnp.int32))
         else:                                   # stochastic_spike
             elo, ehi = ev[3]
             spike["start"].append(start)
@@ -209,7 +221,10 @@ def draw_fault_params(cfg: AvalancheConfig, key: jax.Array,
                        cut_split=stack(cut["split"]),
                        spike_start=stack(spike["start"]),
                        spike_end=stack(spike["end"]),
-                       spike_extra=stack(spike["extra"]))
+                       spike_extra=stack(spike["extra"]),
+                       region_start=stack(region["start"]),
+                       region_end=stack(region["end"]),
+                       region_cluster=stack(region["cluster"]))
 
 
 class InflightState(NamedTuple):
@@ -463,7 +478,8 @@ def partition_cut(
     """
     events = cfg.cut_events()
     n_sto = len(cfg.stochastic_cut_events())
-    if not events and not n_sto:
+    n_reg = len(cfg.stochastic_region_events())
+    if not events and not n_sto and not n_reg:
         return None
     rows = peers.shape[0]
     qids = (jnp.arange(rows, dtype=jnp.int32)
@@ -494,6 +510,25 @@ def partition_cut(
             split = fault_params.cut_split[i]
             cut = cut | (active & ((qids < split)[:, None]
                                    != (peers < split)))
+    if n_reg:
+        # stochastic_regional_outage: the severed CLUSTER is realized
+        # per sim (drawn from the event's [lo, hi] range) — the window
+        # test and the region id are traced scalars, the mask structure
+        # is the static regional_outage's.
+        if fault_params is None:
+            raise ValueError(
+                "stochastic_regional_outage events need the realized "
+                "FaultParams drawn at init (state.fault_params) — the "
+                "caller must thread it through (every model round "
+                "does)")
+        qc = _cluster_of(qids, cfg.n_clusters, n_global)
+        pc = _cluster_of(peers, cfg.n_clusters, n_global)
+        for i in range(n_reg):
+            active = ((round_ >= fault_params.region_start[i])
+                      & (round_ < fault_params.region_end[i]))
+            region = fault_params.region_cluster[i]
+            cut = cut | (active & ((qc == region)[:, None]
+                                   != (pc == region)))
     return cut
 
 
@@ -1366,3 +1401,44 @@ def clear_columns(ring: Optional[InflightState],
         return ring._replace(polled=ring.polled & keep[None, None, :])
     return ring._replace(
         polled=ring.polled & jnp.logical_not(cols)[None, None, :])
+
+
+def clear_rows(ring: Optional[InflightState],
+               rows: jax.Array,
+               peer_rows: Optional[jax.Array] = None
+               ) -> Optional[InflightState]:
+    """Drop pending updates for window ROWS being rotated out.
+
+    The node-axis streaming scheduler (`models/node_stream` and its
+    sharded twin) reuses window rows for NEW registry nodes; a response
+    still in flight for the departed node must not land on — or be
+    answered on behalf of — its replacement:
+
+      * `rows` (bool ``[rows_local]``, True = row re-assigned) masks
+        the departed rows as QUERIERS — their stored poll masks drop,
+        so nothing ever registers on the replacement's records;
+      * `peer_rows` (bool ``[W]`` over GLOBAL window row ids — the
+        FULL swap mask on a sharded driver, where `rows` is the local
+        block) masks them as polled PEERS — in-flight entries whose
+        stored peer departed lose their `responded` bit, so delivery
+        gathers never attribute the REPLACEMENT's preference to the
+        departed node (the entry delivers absence, exactly like a peer
+        that churned dead).  Defaults to `rows` (the dense case, where
+        local == global).
+
+    None ring (engine off) passes through.  Row masking is
+    layout-independent (the poll-mask plane's row axis is never
+    packed), so the packed coalesced ring takes the same `where`.
+    """
+    if ring is None:
+        return None
+    keep = jnp.logical_not(rows)
+    polled_keep = (keep[None, :, None].astype(ring.polled.dtype)
+                   if ring.polled.ndim == 3 else keep[None, :])
+    peer_gone = (rows if peer_rows is None else peer_rows)[ring.peers]
+    return ring._replace(
+        polled=ring.polled * polled_keep if ring.polled.dtype == jnp.uint8
+        else ring.polled & polled_keep,
+        responded=(ring.responded & keep[None, :, None]
+                   & jnp.logical_not(peer_gone)),
+    )

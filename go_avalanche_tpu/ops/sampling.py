@@ -127,6 +127,69 @@ def sample_peers_weighted(
     return jnp.clip(idx, 0, weights.shape[0] - 1).astype(jnp.int32)
 
 
+def sample_peers_hierarchical(
+    key: jax.Array,
+    weights: jax.Array,
+    n_rows: int,
+    k: int,
+    n_clusters: int,
+) -> jax.Array:
+    """Two-level stake-weighted k-peer sample; int32 ``[n_rows, k]``,
+    with replacement — BIT-IDENTICAL to `sample_peers_weighted` on the
+    same key (tests/test_stake.py pins the parity across
+    ``n_clusters ∈ {1, 4, 7}`` including C ∤ N).
+
+    The flat inverse-CDF draw binary-searches the full ``[N]`` CDF per
+    draw; at million-node registries the committee structure makes that
+    decomposable: draw a CLUSTER from the ``[C]`` stake-mass boundary
+    values, then the peer WITHIN that cluster's contiguous block —
+    log C + log(N/C) probes instead of log N over the whole vector,
+    and the cluster level is exactly the stake-mass-per-committee
+    table deployments publish.  Clusters are `cluster_of`'s contiguous
+    blocks (THE one partition spelling — committees, outages, and RTT
+    all agree on it).
+
+    Exactness: both levels compare the SAME flat-CDF floats the oracle
+    compares — the cluster search uses the CDF's value at each block's
+    last element, the within-block search is a lower-bound binary
+    search over the flat CDF restricted to the block — so every
+    comparison (and therefore every drawn id) matches
+    `searchsorted(cdf, u, side="right")` bit for bit; no re-summed
+    per-cluster CDF whose float rounding could drift.
+    """
+    weights = jnp.asarray(weights, jnp.float32)
+    n = weights.shape[0]
+    if not (1 <= n_clusters <= n):
+        raise ValueError(f"n_clusters={n_clusters} must be in [1, {n}]")
+    cdf = jnp.cumsum(weights)
+    total = cdf[-1]
+    u = jax.random.uniform(key, (n_rows, k), jnp.float32) * total
+
+    # Static block geometry from cluster_of's own partition: block c is
+    # [ceil(c*N/C), ceil((c+1)*N/C)).
+    starts = [-(-c * n // n_clusters) for c in range(n_clusters)]
+    ends = starts[1:] + [n]
+    starts_a = jnp.asarray(starts, jnp.int32)
+    ends_a = jnp.asarray(ends, jnp.int32)
+    bounds = cdf[ends_a - 1]                     # [C] cluster mass marks
+
+    c = jnp.clip(jnp.searchsorted(bounds, u, side="right"),
+                 0, n_clusters - 1)
+    lo = starts_a[c]
+    hi = ends_a[c]
+    # Lower-bound binary search over cdf[lo:hi): smallest index whose
+    # CDF value exceeds u — identical comparisons to the flat
+    # side="right" search restricted to the chosen block.
+    max_block = max(e - s for s, e in zip(starts, ends))
+    for _ in range(max(1, max_block.bit_length())):
+        open_ = lo < hi
+        mid = (lo + hi) // 2
+        go_right = cdf[jnp.clip(mid, 0, n - 1)] <= u
+        lo = jnp.where(open_ & go_right, mid + 1, lo)
+        hi = jnp.where(open_ & jnp.logical_not(go_right), mid, hi)
+    return jnp.clip(lo, 0, n - 1).astype(jnp.int32)
+
+
 def cluster_of(ids: jax.Array, n_clusters: int,
                n_nodes: int) -> jax.Array:
     """Cluster of each global node id: ``i * C // N`` — contiguous
@@ -200,14 +263,30 @@ def draw_peers(
 ) -> tuple:
     """The per-round peer draw shared by every multi-target model.
 
-    Dispatches on the config: clustered topology (`n_clusters > 1`),
-    latency-weighted, or uniform (with/without replacement, self-excluded).
-    Returns ``(peers [rows, k], self_draw)`` where `self_draw` is a bool
-    mask in the weighted/clustered families (per-row exclusion there would
-    be O(N^2); callers abstain those draws) and None in the uniform family
-    (exclusion is exact).
+    Dispatches on the config: stake-weighted committee draws
+    (`cfg.stake_mode != "off"` — the stake vector is folded into
+    `latency_weight` at init, flat CDF for one cluster and the
+    two-level hierarchical engine for a clustered topology, identical
+    bits either way), clustered topology (`n_clusters > 1`),
+    latency-weighted, or uniform (with/without replacement,
+    self-excluded).  Returns ``(peers [rows, k], self_draw)`` where
+    `self_draw` is a bool mask in the weighted/clustered/stake families
+    (per-row exclusion there would be O(N^2); callers abstain those
+    draws) and None in the uniform family (exclusion is exact).
+
+    Stake draws are SOURCE-INDEPENDENT (a committee draw, not a
+    locality model): with `stake_mode` on, `cluster_locality` is unread
+    and `n_clusters` selects only the two-level sampling engine.
     """
     rows = n_nodes if n_local is None else n_local
+    if cfg.stake_mode != "off":
+        w = latency_weight * alive.astype(jnp.float32)
+        if cfg.n_clusters > 1:
+            peers = sample_peers_hierarchical(key, w, rows, cfg.k,
+                                              cfg.n_clusters)
+        else:
+            peers = sample_peers_weighted(key, w, rows, cfg.k)
+        return peers, self_sample_mask(peers, id_offset=id_offset)
     if cfg.n_clusters > 1:
         w = latency_weight * alive.astype(jnp.float32)
         peers = sample_peers_clustered(key, w, rows, cfg.k, cfg.n_clusters,

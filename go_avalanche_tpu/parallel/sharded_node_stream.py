@@ -1,0 +1,199 @@
+"""Mesh-sharded node-axis streaming scheduler: the registry at scale.
+
+`models/node_stream` re-expressed under `jax.shard_map`: the dense
+``[W, T]`` active window shards exactly like the plain simulator
+(`parallel/sharded.py` — the inner round IS `sharded._local_round`),
+while the registry planes (``[R]`` stake / residency, the ``[W]``
+slot-node map) stay REPLICATED — 1M nodes of registry metadata is ~MBs,
+noise next to the window state.
+
+The churn pass runs on those replicated planes from the replicated
+churn key with NO shard folds, so every shard computes the identical
+swap sequence (the same trick the live-traffic arrival draw uses,
+`parallel/sharded_backlog.py`); only the record-plane rotation is
+row-local (each node shard fills its own block's rows).  That is what
+makes the dense and sharded schedulers agree LEAF-EXACT on the
+working-set window — `slot_node`, `resident`, the stake plane, and the
+churn counters — for the same key (tests/test_node_stream.py), while
+the inner consensus round keeps the sharded models' own per-shard PRNG
+streams.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
+from go_avalanche_tpu.models import avalanche as av
+from go_avalanche_tpu.models import node_stream as ns_model
+from go_avalanche_tpu.models.node_stream import (
+    NodeStreamState,
+    NodeStreamTelemetry,
+    _registry_byzantine,
+)
+from go_avalanche_tpu.ops import inflight
+from go_avalanche_tpu.ops import voterecord as vr
+from go_avalanche_tpu.parallel import sharded
+from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS, shard_map
+
+
+def node_stream_state_specs(track_finality: bool = True,
+                            with_inflight: bool = False,
+                            with_fault_params: bool = False
+                            ) -> NodeStreamState:
+    """PartitionSpecs for every leaf of `NodeStreamState`."""
+    return NodeStreamState(
+        sim=sharded.state_specs(track_finality, with_inflight,
+                                with_fault_params),
+        slot_node=P(),      # replicated [W]: every shard needs the full
+        resident=P(),       #   hosting map / residency for the churn
+        stake=P(),          #   draw (registry metadata, ~MBs at 1M)
+        init_pref=P(TXS_AXIS),
+        churn_key=P(),
+        churned_in=P(),
+        churned_out=P(),
+    )
+
+
+def shard_node_stream_state(state: NodeStreamState,
+                            mesh) -> NodeStreamState:
+    """Place a host-built node-stream state onto the mesh."""
+    state = state._replace(sim=state.sim._replace(
+        inflight=inflight.repack_polled_for_shards(
+            state.sim.inflight, state.sim.records.votes.shape[1],
+            mesh.shape[TXS_AXIS])))
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        state,
+        node_stream_state_specs(state.sim.finalized_at is not None,
+                                state.sim.inflight is not None,
+                                state.sim.fault_params is not None))
+
+
+def _local_churn(state: NodeStreamState,
+                 cfg: AvalancheConfig) -> Tuple[NodeStreamState,
+                                                jax.Array]:
+    """The churn pass on one shard: replicated draws, row-local record
+    rotation; see `models/node_stream.churn`."""
+    if cfg.node_churn_rate <= 0.0:
+        return state, jnp.int32(0)
+    sim = state.sim
+    r = state.resident.shape[0]
+    w_local = sim.records.votes.shape[0]
+    nshard = lax.axis_index(NODES_AXIS)
+    offset = nshard * w_local
+
+    # --- replicated planes: THE shared draw (models/node_stream.
+    # draw_churn_swaps), identical on every shard — no axis folds, so
+    # the dense and sharded schedulers realize one swap sequence (the
+    # leaf-exact window-parity contract rests on this being the same
+    # function, not a copy).
+    swap, new_slot, resident, n_swapped, k_next = ns_model.draw_churn_swaps(
+        state, cfg)
+    byz_r = _registry_byzantine(cfg, r)
+
+    # --- row-local rotation: this shard's block of the swap mask.
+    swap_local = lax.dynamic_slice(swap, (offset,), (w_local,))
+    fresh = vr.init_state(jnp.broadcast_to(state.init_pref[None, :],
+                                           sim.records.votes.shape))
+
+    def fill(plane, fresh_plane):
+        return jnp.where(swap_local[:, None], fresh_plane, plane)
+
+    records = vr.VoteRecordState(
+        votes=fill(sim.records.votes, fresh.votes),
+        consider=fill(sim.records.consider, fresh.consider),
+        confidence=fill(sim.records.confidence, fresh.confidence),
+    )
+    added = jnp.where(swap_local[:, None], True, sim.added)
+    finalized_at = (None if sim.finalized_at is None
+                    else jnp.where(swap_local[:, None], -1,
+                                   sim.finalized_at))
+    new_sim = sim._replace(
+        records=records,
+        added=added,
+        finalized_at=finalized_at,
+        latency_weight=state.stake[new_slot],     # replicated [W]
+        byzantine=byz_r[new_slot],                # replicated [W]
+        alive=jnp.where(swap, True, sim.alive),   # replicated [W]
+        # Querier side masks this shard's local block; the polled-peer
+        # side needs the FULL swap mask (ring.peers holds global ids).
+        inflight=inflight.clear_rows(sim.inflight, swap_local,
+                                     peer_rows=swap),
+    )
+    return state._replace(
+        sim=new_sim,
+        slot_node=new_slot,
+        resident=resident,
+        churn_key=k_next,
+        churned_in=state.churned_in + n_swapped,
+        churned_out=state.churned_out + n_swapped,
+    ), n_swapped
+
+
+def _local_step(
+    state: NodeStreamState,
+    cfg: AvalancheConfig,
+    n_global: int,
+    n_tx_shards: int,
+) -> Tuple[NodeStreamState, NodeStreamTelemetry]:
+    state, swapped = _local_churn(state, cfg)
+    new_sim, round_tel = sharded._local_round(state.sim, cfg, n_global,
+                                              n_tx_shards)
+    total = state.stake.sum()
+    tel = NodeStreamTelemetry(
+        round=round_tel,
+        departed=swapped,
+        resident_stake=(jnp.where(state.resident, state.stake, 0.0).sum()
+                        / jnp.maximum(total, jnp.float32(1e-38))),
+    )
+    return state._replace(sim=new_sim), tel
+
+
+def _shard_mapped(mesh, fn, with_tel=True, track_finality: bool = True,
+                  with_inflight: bool = False,
+                  with_fault_params: bool = False):
+    specs = node_stream_state_specs(track_finality, with_inflight,
+                                    with_fault_params)
+    if with_tel:
+        tel_specs = NodeStreamTelemetry(
+            round=av.SimTelemetry(
+                *([P()] * len(av.SimTelemetry._fields))),
+            departed=P(), resident_stake=P())
+        out_specs = (specs, tel_specs)
+    else:
+        out_specs = specs
+    return shard_map(fn, mesh=mesh, in_specs=(specs,),
+                     out_specs=out_specs, check_vma=False)
+
+
+def run_scan_sharded_node_stream(
+    mesh,
+    state: NodeStreamState,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    n_rounds: int = 100,
+    donate: bool = False,
+) -> Tuple[NodeStreamState, NodeStreamTelemetry]:
+    """Fixed-round sharded node stream; one jit, collectives inside the
+    scan."""
+    n_global = state.slot_node.shape[0]
+    n_tx = mesh.shape[TXS_AXIS]
+
+    def local_scan(s):
+        def body(carry, _):
+            new_s, tel = _local_step(carry, cfg, n_global, n_tx)
+            return new_s, tel
+        return lax.scan(body, s, None, length=n_rounds)
+
+    return jax.jit(_shard_mapped(
+        mesh, local_scan,
+        track_finality=state.sim.finalized_at is not None,
+        with_inflight=state.sim.inflight is not None,
+        with_fault_params=state.sim.fault_params is not None),
+        donate_argnums=sharded._donate(donate))(state)

@@ -130,6 +130,14 @@ def build_config(args: argparse.Namespace) -> AvalancheConfig:
         churn_probability=args.churn,
         skip_absent_votes=args.skip_absent_votes,
         stream_retire_cap=getattr(args, "stream_retire_cap", None),
+        stake_mode=getattr(args, "stake_mode", "off"),
+        stake_zipf_s=getattr(args, "stake_zipf_s", 1.0),
+        stake_weights=getattr(args, "stake_weights_parsed", None),
+        registry_nodes=getattr(args, "registry_nodes", 0),
+        active_nodes=getattr(args, "active_nodes", 0),
+        node_churn_rate=getattr(args, "node_churn_rate", 0.0),
+        arrival_cluster_weights=getattr(
+            args, "arrival_cluster_weights_parsed", None),
         ingest_engine=getattr(args, "ingest_engine", "u8"),
         inflight_engine=getattr(args, "inflight_engine", "walk"),
         metrics_every=(getattr(args, "metrics_every", 0)
@@ -416,6 +424,37 @@ def run_backlog(args, cfg: AvalancheConfig) -> Dict:
     }
 
 
+def run_node_stream(args, cfg: AvalancheConfig) -> Dict:
+    """Node-axis streaming run: a `--registry-nodes` population of which
+    `--active-nodes` rows are resident in the dense window at a time,
+    churn rotating the working set stake-proportionally
+    (models/node_stream) — the million-node-axis path."""
+    from go_avalanche_tpu.models import node_stream as ns
+
+    state = ns.init(jax.random.key(args.seed), args.txs, cfg)
+    if args.mesh:
+        from go_avalanche_tpu.parallel import sharded_node_stream as sns
+
+        mesh = _parse_mesh(args.mesh)
+        state = sns.shard_node_stream_state(state, mesh)
+        final, _ = sns.run_scan_sharded_node_stream(
+            mesh, state, cfg, n_rounds=args.max_rounds,
+            donate=args.donate)
+    else:
+        final, _ = jax.jit(ns.run_scan,
+                           static_argnames=("cfg", "n_rounds"))(
+            state, cfg, args.max_rounds)
+    return {
+        # Overrides the generic "nodes" key (--nodes is unread here —
+        # the window height is --active-nodes).
+        "nodes": cfg.active_nodes,
+        "rounds": int(jax.device_get(final.sim.round)),
+        "registry_nodes": cfg.registry_nodes,
+        "active_nodes": cfg.active_nodes,
+        **ns.window_summary(final, cfg),
+    }
+
+
 def run_fleet_mode(args, cfg: AvalancheConfig) -> Dict:
     """`--fleet` driver: one vmapped Monte-Carlo fleet per config point
     (go_avalanche_tpu/fleet.py), Wilson-CI estimates out; with
@@ -455,7 +494,7 @@ def main(argv=None) -> Dict:
     parser.add_argument("--model",
                         choices=["slush", "snowflake", "snowball",
                                  "avalanche", "dag", "backlog",
-                                 "streaming_dag"],
+                                 "streaming_dag", "node_stream"],
                         default="avalanche")
     parser.add_argument("--nodes", type=int, default=256)
     parser.add_argument("--txs", type=int, default=64)
@@ -486,6 +525,54 @@ def main(argv=None) -> Dict:
     parser.add_argument("--cluster-locality", type=float, default=0.8,
                         help="P(a draw lands in the drawing node's own "
                              "cluster)")
+    # stake subsystem (go_avalanche_tpu/stake.py)
+    parser.add_argument("--stake-mode",
+                        choices=["off", "uniform", "zipf", "explicit"],
+                        default="off",
+                        help="per-node stake distribution "
+                             "(cfg.stake_mode): peer draws become "
+                             "stake-weighted COMMITTEE draws — "
+                             "'uniform' equal stake, 'zipf' node i "
+                             "holds 1/(i+1)^s with s = --stake-zipf-s "
+                             "(id 0 richest), 'explicit' the "
+                             "--stake-weights vector.  With "
+                             "--clusters > 1 the draw runs the "
+                             "two-level hierarchical sampler "
+                             "(bit-identical to the flat CDF).  "
+                             "Models with a peer-draw dispatch only "
+                             "(avalanche, dag, backlog, "
+                             "streaming_dag, node_stream); 'off' = "
+                             "the weightless pre-stake path")
+    parser.add_argument("--stake-zipf-s", type=float, default=1.0,
+                        help="zipf exponent for --stake-mode zipf "
+                             "(> 0; larger = more concentrated stake)")
+    parser.add_argument("--stake-weights", type=str, default=None,
+                        metavar="W1,W2,...",
+                        help="--stake-mode explicit: the per-node "
+                             "stake vector (comma-separated positive "
+                             "numbers; one per node — or per REGISTRY "
+                             "entry with --registry-nodes)")
+    parser.add_argument("--registry-nodes", type=int, default=0,
+                        metavar="R",
+                        help="node-axis streaming scheduler "
+                             "(models/node_stream, --model "
+                             "node_stream): the full node-registry "
+                             "size, of which only --active-nodes rows "
+                             "are resident in the dense window at a "
+                             "time — the nodes >> HBM regime.  Needs "
+                             "a --stake-mode (the working set is "
+                             "drawn stake-proportionally)")
+    parser.add_argument("--active-nodes", type=int, default=0,
+                        metavar="W",
+                        help="node_stream: active working-set rows "
+                             "(the dense window height; "
+                             "< --registry-nodes)")
+    parser.add_argument("--node-churn-rate", type=float, default=0.0,
+                        help="node_stream: P(an active row rotates "
+                             "out, per round); departures retire "
+                             "their vote records, arrivals are drawn "
+                             "stake-proportionally from the "
+                             "non-resident registry")
     parser.add_argument("--yes-fraction", type=float, default=1.0,
                         help="slush/snowflake/snowball: initial "
                              "yes-preference fraction")
@@ -536,6 +623,17 @@ def main(argv=None) -> Dict:
     parser.add_argument("--arrival-depth", type=float, default=0.0,
                         help="diurnal: sinusoid modulation depth in "
                              "[0, 1]")
+    parser.add_argument("--arrival-cluster-weights", type=str,
+                        default=None, metavar="W1,W2,...",
+                        help="per-cluster arrival skew (hot regions): "
+                             "one positive rate multiplier per "
+                             "cluster (--clusters entries) — the "
+                             "admission order splits into contiguous "
+                             "region blocks (the clustered topology's "
+                             "own cluster_of partition) and each "
+                             "block's arrivals draw at rate x its "
+                             "region weight.  Needs --clusters > 1 "
+                             "and an in-graph schedule mode")
     parser.add_argument("--arrival-backpressure", type=str, default=None,
                         metavar="LO,HI",
                         help="closed-loop admission control: working-set "
@@ -796,6 +894,11 @@ def main(argv=None) -> Dict:
                          "--latency-mode is 'none', under which the "
                          "knob is inert — every point would measure "
                          "the same program")
+        if "stake_zipf_s" in grid and args.stake_mode != "zipf":
+            parser.error("--phase-grid sweeps stake_zipf_s but "
+                         "--stake-mode is not 'zipf' (the exponent is "
+                         "only read there) — stake-concentration "
+                         "sweeps need the zipf distribution")
         if "arrival_rate" in grid:
             if args.arrival_mode == "off":
                 parser.error("--phase-grid sweeps arrival_rate but "
@@ -821,6 +924,42 @@ def main(argv=None) -> Dict:
                      "--max-rounds.  Use a schedule mode here, or "
                      "drive an external stream through "
                      "connector.client.sim_submit")
+    # Stake / node-registry validation: everything parser-level (the
+    # PR 5 rule — a bad stake config must die here, not in the worker).
+    args.stake_weights_parsed = None
+    if args.stake_weights is not None:
+        try:
+            args.stake_weights_parsed = tuple(
+                float(x) for x in args.stake_weights.split(","))
+        except ValueError:
+            parser.error(f"--stake-weights must be comma-separated "
+                         f"numbers, got {args.stake_weights!r}")
+    if args.stake_mode != "off" and args.model in ("slush", "snowflake",
+                                                   "snowball"):
+        parser.error(f"--stake-mode is a peer-draw-dispatch axis "
+                     f"(models avalanche/dag/backlog/streaming_dag/"
+                     f"node_stream); the {args.model} model samples "
+                     f"uniformly, so a stake config would be silently "
+                     f"inert there")
+    if args.model == "node_stream":
+        if args.registry_nodes <= 0 or args.active_nodes <= 0:
+            parser.error("--model node_stream streams --active-nodes "
+                         "resident rows out of a --registry-nodes "
+                         "population — both must be set (> 0)")
+    elif args.registry_nodes or args.active_nodes or args.node_churn_rate:
+        parser.error("--registry-nodes/--active-nodes/"
+                     "--node-churn-rate are node-stream scheduler axes "
+                     "(--model node_stream); with other models they "
+                     "would be silently inert")
+    args.arrival_cluster_weights_parsed = None
+    if args.arrival_cluster_weights is not None:
+        try:
+            args.arrival_cluster_weights_parsed = tuple(
+                float(x) for x in args.arrival_cluster_weights.split(","))
+        except ValueError:
+            parser.error(f"--arrival-cluster-weights must be "
+                         f"comma-separated numbers, got "
+                         f"{args.arrival_cluster_weights!r}")
     args.arrival_backpressure_parsed = None
     if args.arrival_backpressure is not None:
         try:
@@ -832,9 +971,9 @@ def main(argv=None) -> Dict:
                          f"{args.arrival_backpressure!r}")
 
     if args.mesh and args.model not in ("avalanche", "dag", "backlog",
-                                        "streaming_dag"):
+                                        "streaming_dag", "node_stream"):
         parser.error(f"--mesh supports models avalanche/dag/backlog/"
-                     f"streaming_dag, not {args.model}")
+                     f"streaming_dag/node_stream, not {args.model}")
     if args.donate and not args.mesh:
         parser.error("--donate is a --mesh option (the single-chip "
                      "avalanche path already donates unconditionally)")
@@ -911,7 +1050,8 @@ def main(argv=None) -> Dict:
         runner = {"slush": run_slush, "snowflake": run_snowflake,
                   "snowball": run_snowball, "avalanche": run_avalanche,
                   "dag": run_dag, "backlog": run_backlog,
-                  "streaming_dag": run_streaming_dag}[args.model]
+                  "streaming_dag": run_streaming_dag,
+                  "node_stream": run_node_stream}[args.model]
 
     ctx = tracing.trace(args.trace) if args.trace else contextlib.nullcontext()
     if args.metrics:

@@ -1,0 +1,112 @@
+"""The stake subsystem: jit-static stake distributions + registry draws.
+
+"Committee Selection is More Similar Than You Think" (PAPERS.md,
+arXiv 1904.09839) shows Avalanche's per-query peer sampling is formally
+a stake-weighted committee draw; real deployments weight nodes by stake,
+not uniformly.  This module realizes `cfg.stake_mode` into a per-node
+stake vector and provides the weighted-without-replacement registry
+draw behind the node-axis streaming scheduler
+(`models/node_stream.py`):
+
+  * **`node_stake`** — the jit-static realization: "uniform" (equal
+    stake — the weighted machinery with a flat distribution), "zipf"
+    (node i holds ``1/(i+1)**s``; id 0 richest, and with
+    ``byzantine_fraction > 0`` the adversary holds the TOP stake — the
+    worst case), or "explicit" (the validated `cfg.stake_weights`
+    vector).  The vector is FOLDED INTO the `latency_weight`
+    sampling-propensity plane at init (`models/avalanche.init`), so the
+    peer draw dispatch (`ops/sampling.draw_peers`) sees one composed
+    propensity plane — stake x latency weights x aliveness — and the
+    inverse-CDF machinery generalizes unchanged.  "off" returns None
+    (statically absent: every archived hlo pin byte-identical,
+    machine-checked by `benchmarks/hlo_pin.py --verify-off-path`).
+  * **`draw_working_set`** — EXACT stake-proportional sampling without
+    replacement via the Gumbel top-k trick (perturbed log-stake,
+    ``lax.top_k``): the distribution over W-subsets is successive
+    weighted draws without replacement, which is precisely how a
+    bounded active-node working set should be drawn from an R-entry
+    registry (DAG-Sword's resident-working-set regime, PAPERS.md
+    arXiv 2311.04638).  Zero-stake (or masked) entries carry a -inf
+    score and are never drawn.
+
+Everything here is a pure function of (config, shapes[, key]) — no
+state, no host round-trips — so it composes with `vmap` (the
+Monte-Carlo fleet sweeps `stake_zipf_s` as a phase axis) and with the
+sharded drivers (replicated stake planes draw identically everywhere).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from go_avalanche_tpu.config import AvalancheConfig
+
+
+def stake_enabled(cfg: AvalancheConfig) -> bool:
+    """Static: is the stake subsystem on for this config?"""
+    return cfg.stake_mode != "off"
+
+
+def registry_enabled(cfg: AvalancheConfig) -> bool:
+    """Static: is the node-axis streaming registry on
+    (`models/node_stream.py`)?"""
+    return cfg.registry_nodes > 0
+
+
+def node_stake(cfg: AvalancheConfig,
+               n_nodes: int) -> Optional[jax.Array]:
+    """float32 ``[n_nodes]`` per-node stake realized from the config;
+    None (statically) when `cfg.stake_mode` is "off".
+
+    jit-static: a pure function of (config, n_nodes), constant under
+    `vmap` — every fleet trial at one config point sees the same stake
+    vector (trial-to-trial variation is the PRNG's, not the
+    distribution's).  An "explicit" vector whose length does not match
+    `n_nodes` raises at trace time with both lengths — the registry
+    case is already caught at config construction.
+    """
+    if cfg.stake_mode == "off":
+        return None
+    if cfg.stake_mode == "uniform":
+        return jnp.ones((n_nodes,), jnp.float32)
+    if cfg.stake_mode == "zipf":
+        ranks = jnp.arange(1, n_nodes + 1, dtype=jnp.float32)
+        return (1.0 / ranks ** jnp.float32(cfg.stake_zipf_s)).astype(
+            jnp.float32)
+    # explicit — length re-checked here because the config cannot know
+    # the node count (only the registry spelling pins it up front).
+    if len(cfg.stake_weights) != n_nodes:
+        raise ValueError(
+            f"stake_mode 'explicit' needs one stake per node: "
+            f"stake_weights has {len(cfg.stake_weights)} entries for "
+            f"{n_nodes} nodes")
+    return jnp.asarray(cfg.stake_weights, jnp.float32)
+
+
+def draw_working_set(
+    key: jax.Array,
+    stake: jax.Array,
+    w: int,
+    mask: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Draw `w` DISTINCT registry ids stake-proportionally (exact
+    weighted sampling without replacement, Gumbel top-k).
+
+    Returns ``(ids [w], valid [w])`` in descending perturbed-score
+    order: `valid[i]` is False where fewer than `w` drawable entries
+    exist (zero stake, or excluded by `mask`) — those slots must not be
+    consumed.  `mask` (bool ``[R]``, True = drawable) restricts the
+    pool; the node-stream churn pass excludes resident rows with it.
+    """
+    stake = jnp.asarray(stake, jnp.float32)
+    drawable = stake > 0.0
+    if mask is not None:
+        drawable = drawable & mask
+    log_stake = jnp.where(drawable, jnp.log(jnp.maximum(stake, 1e-38)),
+                          -jnp.inf)
+    score = log_stake + jax.random.gumbel(key, stake.shape)
+    top, ids = jax.lax.top_k(score, w)
+    return ids.astype(jnp.int32), top > -jnp.inf

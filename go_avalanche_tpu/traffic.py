@@ -169,6 +169,20 @@ def arrive(traffic: TrafficState, cfg: AvalancheConfig,
     lam = (schedule_rate(cfg, round_)
            * backpressure_factor(
                cfg, occupied.astype(jnp.float32) / jnp.float32(capacity)))
+    if cfg.arrival_cluster_weights is not None:
+        # Per-cluster arrival skew (hot regions): units partition into
+        # n_clusters contiguous admission-order blocks via THE one
+        # cluster_of spelling (`ops/sampling.py` — the same partition
+        # nodes use), and the draw's rate scales by the stream head's
+        # region weight, so a hot region's block drains proportionally
+        # faster.  Statically absent when unset (flagship_traffic pin
+        # byte-identical).
+        from go_avalanche_tpu.ops.sampling import cluster_of
+
+        wts = jnp.asarray(cfg.arrival_cluster_weights, jnp.float32)
+        head = cluster_of(jnp.clip(traffic.arrived_idx, 0, b - 1),
+                          cfg.n_clusters, b)
+        lam = lam * wts[head]
     key, sub = jax.random.split(traffic.key)
     n_new = jnp.minimum(
         jax.random.poisson(sub, lam).astype(jnp.int32),

@@ -363,3 +363,102 @@ def test_post_capture_probe_attributes_failures(monkeypatch, tmp_path):
         [{"status": "pass"}, {"status": "timeout"}], env)
     assert out == {"status": "fail", "detail": {"why": "wedged"}}
     assert len(calls) == 1 and calls[0].endswith("dispatch_probe.py")
+
+
+def test_stale_pins_archive_is_clean():
+    """Every committed pin's lowering path must exist: the program is
+    known to hlo_pin.PROGRAMS and its benchmarks/workload.py builders
+    are live — pin rot is caught HERE at the gate, not on a TPU
+    window (PR 10 satellite)."""
+    from benchmarks import hlo_pin
+
+    assert hlo_pin.stale_pins(hlo_pin._load_archive()) == []
+    # Every known program has a builder row, so new pins cannot dodge
+    # the check by omission.
+    assert set(hlo_pin.PROGRAM_BUILDERS) == set(hlo_pin.PROGRAMS)
+
+
+def test_stale_pins_flags_unknown_and_missing_builders():
+    from benchmarks import hlo_pin
+
+    archive = {"programs": {
+        "flagship": {"workload": {}, "hashes": {}},
+        "ghost_program": {"workload": {}, "hashes": {}},
+    }}
+    stale = hlo_pin.stale_pins(archive)
+    assert len(stale) == 1 and "ghost_program" in stale[0]
+    # a known program whose workload builder vanished is flagged too
+    orig = hlo_pin.PROGRAM_BUILDERS["flagship"]
+    hlo_pin.PROGRAM_BUILDERS["flagship"] = ("no_such_builder",)
+    try:
+        stale = hlo_pin.stale_pins({"programs": {
+            "flagship": {"workload": {}, "hashes": {}}}})
+        assert len(stale) == 1 and "no_such_builder" in stale[0]
+    finally:
+        hlo_pin.PROGRAM_BUILDERS["flagship"] = orig
+
+
+def test_hlo_pin_stale_cli():
+    """`--stale` exits 0 on the committed archive and annotates
+    `--list`; the check is metadata-only (no lowering), so it is
+    gate-cheap."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, str(repo / "benchmarks" / "hlo_pin.py"),
+         "--stale"],
+        capture_output=True, text=True, timeout=120, cwd=str(repo),
+        env=env)
+    assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]
+    assert "live builders" in out.stdout
+
+
+def test_bench_stake_lane_parser_rejections():
+    """The --stake lane's parser-level guards (the PR 5 rule): bad
+    combinations die at argparse, before any jax import."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for argv, msg in (
+            (["--stake", "explicit"], "per-node stake vector"),
+            (["--stake-clusters", "4"], "without --stake"),
+            (["--stake", "zipf", "--arrival", "8"], "pick one lane"),
+            (["--stake", "zipf", "--stake-clusters", "0"], ">= 1"),
+            (["--stake", "zipf", "--stake-clusters", "4096",
+              "--nodes", "2048"], "must not exceed")):
+        out = subprocess.run(
+            [sys.executable, str(repo / "bench.py"), *argv],
+            capture_output=True, text=True, timeout=60, cwd=str(repo),
+            env=env)
+        assert out.returncode == 2, argv
+        assert msg in out.stderr, (argv, out.stderr[-500:])
+
+
+def test_hlo_pin_stale_rejects_other_modes():
+    """--stale short-circuits before any lowering, so combining it
+    with --update / --verify-off-path must be a parser error — a CI
+    step must never green-light a check it silently skipped."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for extra in (["--verify-off-path"], ["--update"]):
+        out = subprocess.run(
+            [sys.executable, str(repo / "benchmarks" / "hlo_pin.py"),
+             "--stale", *extra],
+            capture_output=True, text=True, timeout=60, cwd=str(repo),
+            env=env)
+        assert out.returncode == 2, extra
+        assert "composes with --list only" in out.stderr

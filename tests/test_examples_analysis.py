@@ -357,3 +357,17 @@ def test_retire_cap_artifact_reproduces_cross_backend():
     assert redo == cell, (redo, cell)
     assert redo["settle_latency_median"] == dense["settle_latency_median"]
     assert redo["settle_latency_p90"] == dense["settle_latency_p90"]
+
+
+@pytest.mark.slow
+def test_committee_scaling_point_engine_parity():
+    """One committee-scaling point runs on CPU and the flat vs
+    hierarchical engines report identical fleet statistics (the
+    example's own acceptance assert, exercised small)."""
+    from examples.committee_scaling import sweep_point
+
+    flat = sweep_point(24, 1, 6, 120, 8, 1.0, 4, seed=1)
+    hier = sweep_point(24, 4, 6, 120, 8, 1.0, 4, seed=1)
+    for key in ("p_settled", "finality_mean", "p_violation"):
+        assert flat[key] == hier[key]
+    assert flat["engine"] == "flat" and hier["engine"] == "hier4"

@@ -42,7 +42,11 @@ import numpy as np
 import pytest
 
 from go_avalanche_tpu import fleet
-from go_avalanche_tpu.config import AdversaryStrategy, AvalancheConfig
+from go_avalanche_tpu.config import (
+    AdversaryStrategy,
+    AvalancheConfig,
+    fault_script_from_json,
+)
 from go_avalanche_tpu.models import avalanche as av
 from go_avalanche_tpu.models import dag as dag_model
 from go_avalanche_tpu.models import snowball as sb
@@ -655,3 +659,141 @@ def test_run_sim_fleet_end_to_end(tmp_path, capsys):
     rows = [_json.loads(line)
             for line in (tmp_path / "phase.jsonl").read_text().splitlines()]
     assert len(rows) == 1 and rows[0]["fleet"] == 6 and "tag" in rows[0]
+
+
+# --- stochastic_regional_outage (PR 10 satellite: the ROADMAP "more
+# stochastic kinds" follow-up — cluster drawn per trial from the init
+# key through the draw_fault_params range machinery).
+
+
+def _region_cfg(**kw):
+    base = dict(n_clusters=4, time_step_s=1.0, request_timeout_s=3.0,
+                fault_script=(("stochastic_regional_outage",
+                               (2, 4), (2, 3), (1, 2)),))
+    base.update(kw)
+    return AvalancheConfig(**base)
+
+
+def test_stochastic_regional_outage_schema_rejections():
+    # needs a clustered topology
+    with pytest.raises(ValueError, match="clustered topology"):
+        AvalancheConfig(fault_script=(("stochastic_regional_outage",
+                                       (2, 4), (2, 3), (0, 1)),))
+    # cluster range must stay inside [0, n_clusters)
+    with pytest.raises(ValueError, match="inside"):
+        _region_cfg(fault_script=(("stochastic_regional_outage",
+                                   (2, 4), (2, 3), (1, 4)),))
+    # range machinery: bad bounds reject with the indexed message
+    with pytest.raises(ValueError, match=r"fault_script\[0\]"):
+        _region_cfg(fault_script=(("stochastic_regional_outage",
+                                   (2, 4), (2, 3), (1, "a")),))
+    with pytest.raises(ValueError, match=r"fault_script\[0\]"):
+        _region_cfg(fault_script=(("stochastic_regional_outage",
+                                   (4, 2), (2, 3), (1, 2)),))
+    # JSON object spelling round-trips through the one schema row
+    ev = fault_script_from_json([{"kind": "stochastic_regional_outage",
+                                  "start": [2, 4], "length": [2, 3],
+                                  "cluster": [1, 2]}])
+    cfg = _region_cfg(fault_script=ev)
+    assert cfg.stochastic_region_events() == (
+        ("stochastic_regional_outage", (2, 4), (2, 3), (1, 2)),)
+    assert cfg.async_queries()            # the ring turns on
+
+
+def test_stochastic_regional_outage_realization_bounds_and_determinism():
+    cfg = _region_cfg()
+    fp = inflight.draw_fault_params(cfg, jax.random.key(7), 32)
+    fp2 = inflight.draw_fault_params(cfg, jax.random.key(7), 32)
+    for leaf in ("region_start", "region_end", "region_cluster"):
+        np.testing.assert_array_equal(np.asarray(getattr(fp, leaf)),
+                                      np.asarray(getattr(fp2, leaf)))
+    start = int(fp.region_start[0])
+    length = int(fp.region_end[0]) - start
+    assert 2 <= start <= 4 and 2 <= length <= 3
+    assert 1 <= int(fp.region_cluster[0]) <= 2
+    # a different key realizes from the same ranges
+    fp3 = inflight.draw_fault_params(cfg, jax.random.key(8), 32)
+    assert 1 <= int(fp3.region_cluster[0]) <= 2
+
+
+def test_stochastic_regional_outage_cut_severs_realized_region_only():
+    cfg = _region_cfg()
+    n = 32
+    fp = inflight.draw_fault_params(cfg, jax.random.key(3), n)
+    peers = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[None, :8],
+                             (n, 8))
+    inside = jnp.int32(int(fp.region_start[0]))
+    cut = np.asarray(inflight.partition_cut(cfg, inside, 0, peers, n,
+                                            fp))
+    cl = np.arange(n) * cfg.n_clusters // n
+    region = int(fp.region_cluster[0])
+    expect = (cl[:, None] == region) != (cl[np.asarray(peers)] == region)
+    np.testing.assert_array_equal(cut, expect)
+    assert cut.any() and not cut.all()    # severed, but only the region
+    healed = jnp.int32(int(fp.region_end[0]))
+    assert not np.asarray(inflight.partition_cut(cfg, healed, 0, peers,
+                                                 n, fp)).any()
+
+
+def test_fleet_regional_outage_blocks_and_captures_realizations():
+    """Detector coverage: a fleet under the stochastic outage reports
+    per-trial realized (start, end, cluster) windows in the phase row,
+    and the round telemetry shows fault-blocked queries inside — and
+    only around — each trial's own realized window."""
+    cfg = _region_cfg()
+    res = fleet.run_fleet("avalanche", cfg, fleet=4, n_nodes=16,
+                          n_txs=4, n_rounds=10, seed=5)
+    rz = res.realizations()
+    assert set(rz) == {"region"}          # no cuts/spikes scheduled
+    assert len(rz["region"]) == 4
+    blocked = np.asarray(
+        jax.tree.leaves({"b": res.telemetry.partition_blocked})[0])
+    for trial, events in enumerate(rz["region"]):
+        (start, end, cluster), = events
+        assert 2 <= start <= 4 and start + 2 <= end <= start + 3
+        assert 1 <= cluster <= 2
+        assert blocked[trial, start:min(end, 10)].sum() > 0
+        assert blocked[trial, :start].sum() == 0
+
+
+# --- stake_zipf_s phase axis (PR 10: the committee-concentration
+# sweep axis).
+
+
+def test_phase_grid_stake_axis_validation():
+    pts = fleet.phase_points({"stake_zipf_s": [0.5, 1.0, 2.0]})
+    assert [p["stake_zipf_s"] for p in pts] == [0.5, 1.0, 2.0]
+    # inert without the zipf mode: rejected at the sweep level
+    with pytest.raises(ValueError, match="stake_mode set to 'zipf'"):
+        fleet.run_phase_grid("avalanche", AvalancheConfig(),
+                             {"stake_zipf_s": [1.0, 2.0]}, fleet=2,
+                             n_nodes=8, n_txs=4, n_rounds=4)
+    # a snowball fleet under stake is inert (uniform sampling)
+    with pytest.raises(ValueError, match="uniformly"):
+        fleet.run_fleet("snowball",
+                        AvalancheConfig(stake_mode="uniform"),
+                        fleet=2, n_nodes=8, n_rounds=4)
+
+
+@pytest.mark.slow
+def test_phase_grid_stake_axis_sweeps_concentration():
+    base = AvalancheConfig(stake_mode="zipf")
+    rows = fleet.run_phase_grid("avalanche", base,
+                                {"stake_zipf_s": [0.5, 2.0]}, fleet=8,
+                                n_nodes=24, n_txs=4, n_rounds=150,
+                                seed=3)
+    assert [r["point"]["stake_zipf_s"] for r in rows] == [0.5, 2.0]
+    for r in rows:
+        assert "zipf-stake" in r["tag"]
+        assert 0.0 <= r["p_settled"] <= 1.0
+
+
+def test_run_fleet_rejects_inert_node_registry():
+    # No fleet model runs the node-stream scheduler; under the registry
+    # av.init skips the stake fold, so the trials would be mislabeled.
+    with pytest.raises(ValueError, match="node-stream"):
+        fleet.run_fleet(
+            "avalanche",
+            AvalancheConfig(stake_mode="zipf", registry_nodes=64,
+                            active_nodes=16),
+            fleet=2, n_nodes=16, n_txs=4, n_rounds=4)

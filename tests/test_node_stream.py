@@ -1,0 +1,272 @@
+"""Node-axis streaming scheduler tests (models/node_stream): window
+invariants, churn rotation semantics, the dense-vs-sharded working-set
+parity pin (the PR 10 acceptance criterion), and the CLI surface."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_avalanche_tpu.config import AvalancheConfig
+from go_avalanche_tpu.models import avalanche as av
+from go_avalanche_tpu.models import node_stream as ns
+from go_avalanche_tpu.ops import inflight
+from go_avalanche_tpu.ops import voterecord as vr
+
+
+def _cfg(**kw):
+    base = dict(stake_mode="zipf", registry_nodes=24, active_nodes=8)
+    base.update(kw)
+    return AvalancheConfig(**base)
+
+
+def test_init_window_invariants():
+    cfg = _cfg()
+    state = ns.init(jax.random.key(1), 6, cfg)
+    slot = np.asarray(state.slot_node)
+    res = np.asarray(state.resident)
+    assert slot.shape == (8,)
+    assert len(set(slot.tolist())) == 8          # distinct registry ids
+    assert res.sum() == 8
+    assert res[slot].all()                       # slot map == residency
+    # Row propensities are the residents' REGISTRY stakes, not a
+    # positional window realization.
+    np.testing.assert_allclose(
+        np.asarray(state.sim.latency_weight),
+        np.asarray(state.stake)[slot], rtol=0)
+    assert state.sim.records.votes.shape == (8, 6)
+
+
+def test_init_requires_registry():
+    with pytest.raises(ValueError, match="registry_nodes"):
+        ns.init(jax.random.key(0), 4, AvalancheConfig())
+
+
+def test_churn_keeps_window_full_and_rotates():
+    cfg = _cfg(node_churn_rate=0.5)
+    state = ns.init(jax.random.key(2), 4, cfg)
+    before = np.asarray(state.slot_node)
+    total_swaps = 0
+    for _ in range(6):
+        state, swapped = jax.jit(ns.churn, static_argnames="cfg")(
+            state, cfg)
+        total_swaps += int(swapped)
+        slot = np.asarray(state.slot_node)
+        res = np.asarray(state.resident)
+        assert res.sum() == 8                    # window always full
+        assert len(set(slot.tolist())) == 8
+        assert res[slot].all()
+        np.testing.assert_allclose(
+            np.asarray(state.sim.latency_weight),
+            np.asarray(state.stake)[slot], rtol=0)
+    assert total_swaps > 0
+    assert total_swaps == int(state.churned_in) == int(state.churned_out)
+    assert (np.asarray(state.slot_node) != before).any()
+
+
+def test_churn_retires_departing_records_and_seeds_arrivals():
+    cfg = _cfg(node_churn_rate=1.0, registry_nodes=32,
+               active_nodes=8)
+    pref = jnp.asarray([True, False, True], jnp.bool_)
+    state = ns.init(jax.random.key(3), 3, cfg, init_pref=pref)
+    # Dirty the window so fresh rows are distinguishable.
+    dirty = state.sim.records._replace(
+        confidence=jnp.full_like(state.sim.records.confidence, 77))
+    state = state._replace(sim=state.sim._replace(records=dirty))
+    new_state, swapped = ns.churn(state, cfg)
+    assert int(swapped) > 0
+    swap = (np.asarray(new_state.slot_node)
+            != np.asarray(state.slot_node))
+    fresh = np.asarray(vr.init_state(pref[None, :]).confidence)[0]
+    conf = np.asarray(new_state.sim.records.confidence)
+    # Swapped rows adopted the registry prior; survivors kept state.
+    np.testing.assert_array_equal(conf[swap],
+                                  np.broadcast_to(fresh, conf[swap].shape))
+    assert (conf[~swap] == 77).all()
+    # Byzantine follows the registry id, not the row.
+    r = cfg.registry_nodes
+    n_byz = int(round(cfg.byzantine_fraction * r))
+    np.testing.assert_array_equal(
+        np.asarray(new_state.sim.byzantine),
+        np.asarray(new_state.slot_node) < n_byz)
+
+
+def test_churn_zero_is_statically_absent():
+    cfg = _cfg()
+    state = ns.init(jax.random.key(4), 4, cfg)
+    out, swapped = ns.churn(state, cfg)
+    assert out is state and int(swapped) == 0
+
+
+def test_churn_zero_round_matches_plain_window_sim():
+    """With churn off, the node-stream inner round IS the plain [W, T]
+    sim on the residents' planes — one round must agree bit-for-bit."""
+    cfg = _cfg()
+    state = ns.init(jax.random.key(5), 4, cfg)
+    twin = av.init(state.sim.key, 8, 4, cfg)._replace(
+        latency_weight=state.sim.latency_weight,
+        byzantine=state.sim.byzantine)
+    stepped, _ = ns.step(state, cfg)
+    twin_stepped, _ = av.round_step(twin, cfg)
+    np.testing.assert_array_equal(
+        np.asarray(stepped.sim.records.confidence),
+        np.asarray(twin_stepped.records.confidence))
+
+
+def test_run_scan_summary_and_full_residency():
+    cfg = _cfg(node_churn_rate=0.25)
+    state = ns.init(jax.random.key(6), 4, cfg)
+    final, tel = jax.jit(ns.run_scan, static_argnames=("cfg",
+                                                       "n_rounds"))(
+        state, cfg, 8)
+    summary = ns.window_summary(final, cfg)
+    assert summary["resident_count"] == 8
+    assert 0.0 < summary["resident_stake_fraction"] <= 1.0
+    assert summary["churned_in"] == summary["churned_out"]
+    assert int(np.asarray(tel.departed).sum()) == summary["churned_in"]
+    assert np.asarray(tel.round.polls).shape == (8,)
+
+
+def test_high_stake_nodes_dominate_residency():
+    # Zipf s=2 over 24 ids: id 0 holds ~64% of the mass — across a
+    # churned run it should be resident essentially always.
+    cfg = _cfg(stake_zipf_s=2.0, node_churn_rate=0.5)
+    state = ns.init(jax.random.key(7), 2, cfg)
+    rich = poor = rounds = 0
+    for _ in range(12):
+        state, _ = jax.jit(ns.step, static_argnames="cfg")(state, cfg)
+        res = np.asarray(state.resident)
+        rich += int(res[0])
+        poor += int(res[23])
+        rounds += 1
+    # Id 0 holds ~64% of the zipf-2 mass; id 23 ~0.1%.  A departed
+    # rich node re-enters almost immediately, a poor one almost never.
+    assert rich / rounds > 0.6
+    assert rich > poor
+
+
+def test_clear_rows_drops_departed_rows_pending_updates():
+    cfg = AvalancheConfig(latency_mode="fixed", latency_rounds=2,
+                          time_step_s=1.0, request_timeout_s=5.0)
+    ring = inflight.init_ring(cfg, 4, 8)
+    polled = jnp.ones((4, 8), jnp.bool_)
+    # Row i polls peers (i+1) % 4 on every draw: row 2 polls the
+    # departing row 3, rows 0/1/3 poll surviving peers.
+    peers = jnp.broadcast_to(((jnp.arange(4) + 1) % 4)[:, None],
+                             (4, 8)).astype(jnp.int32)
+    ring = inflight.enqueue(ring, jnp.int32(0), peers,
+                            jnp.full((4, 8), 2, jnp.int32),
+                            jnp.ones((4, 8), jnp.bool_),
+                            jnp.zeros((4, 8), jnp.bool_), polled)
+    rows = jnp.asarray([True, False, False, True])
+    cleared = inflight.clear_rows(ring, rows, peer_rows=rows)
+    p = np.asarray(cleared.polled)
+    assert not p[:, 0].any() and not p[:, 3].any()
+    assert p[0, 1].all() and p[0, 2].all()
+    resp = np.asarray(cleared.responded)
+    assert not resp[:, [0, 3]].any()      # departed QUERIERS cleared
+    # Departed rows as polled PEERS: row 2 polled row 3 (swapped) —
+    # its entries must deliver absence, never the replacement's vote;
+    # row 1 polled row 2 (surviving) and keeps its responded bits.
+    assert not resp[0, 2].any()
+    assert resp[0, 1].all()
+    assert inflight.clear_rows(None, rows) is None
+    # Packed (coalesced) layout clears the same rows.
+    cfg_c = AvalancheConfig(latency_mode="fixed", latency_rounds=2,
+                            time_step_s=1.0, request_timeout_s=5.0,
+                            inflight_engine="coalesced")
+    ring_c = inflight.init_ring(cfg_c, 4, 8)
+    ring_c = inflight.enqueue(ring_c, jnp.int32(0),
+                              jnp.zeros((4, 8), jnp.int32),
+                              jnp.full((4, 8), 2, jnp.int32),
+                              jnp.ones((4, 8), jnp.bool_),
+                              jnp.zeros((4, 8), jnp.bool_), polled)
+    pc = np.asarray(inflight.clear_rows(ring_c, rows).polled)
+    assert not pc[:, 0].any() and not pc[:, 3].any()
+    assert pc[0, 1].any()
+
+
+def test_dense_vs_sharded_working_set_parity():
+    """The acceptance pin: dense and sharded node-stream trajectories
+    agree LEAF-EXACT on the working-set window — slot_node, resident,
+    the stake plane, the churn counters, and the row-propensity plane
+    (the inner round's per-shard PRNG streams differ by design)."""
+    from go_avalanche_tpu.parallel import sharded_node_stream as sns
+    from go_avalanche_tpu.parallel.mesh import make_mesh
+
+    cfg = _cfg(node_churn_rate=0.3)
+    dense, dtel = jax.jit(ns.run_scan, static_argnames=("cfg",
+                                                        "n_rounds"))(
+        ns.init(jax.random.key(1), 8, cfg), cfg, 8)
+    mesh = make_mesh(n_node_shards=4, n_tx_shards=2)
+    sharded_state = sns.shard_node_stream_state(
+        ns.init(jax.random.key(1), 8, cfg), mesh)
+    shard, stel = sns.run_scan_sharded_node_stream(mesh, sharded_state,
+                                                   cfg, n_rounds=8)
+    for leaf in ("slot_node", "resident", "stake", "churned_in",
+                 "churned_out"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(dense, leaf)),
+            np.asarray(getattr(shard, leaf)), err_msg=leaf)
+    np.testing.assert_array_equal(
+        np.asarray(dense.sim.latency_weight),
+        np.asarray(shard.sim.latency_weight))
+    np.testing.assert_array_equal(np.asarray(dtel.departed),
+                                  np.asarray(stel.departed))
+    np.testing.assert_array_equal(np.asarray(dtel.resident_stake),
+                                  np.asarray(stel.resident_stake))
+    assert int(dense.churned_in) > 0      # the parity exercised churn
+
+
+# --- CLI surface (run_sim --model node_stream + parser rejections).
+
+def test_cli_node_stream(capsys):
+    from go_avalanche_tpu.run_sim import main
+
+    result = main(["--model", "node_stream", "--txs", "6",
+                   "--registry-nodes", "24", "--active-nodes", "8",
+                   "--stake-mode", "zipf", "--node-churn-rate", "0.2",
+                   "--max-rounds", "6", "--json"])
+    assert result["registry_nodes"] == 24
+    assert result["active_nodes"] == 8
+    assert result["nodes"] == 8
+    assert result["resident_count"] == 8
+    assert result["churned_in"] == result["churned_out"]
+
+
+def test_cli_node_stream_parser_rejections():
+    from go_avalanche_tpu.run_sim import main
+
+    for argv in (
+            # node_stream without the registry knobs
+            ["--model", "node_stream", "--stake-mode", "zipf"],
+            # registry knobs on another model (silently inert)
+            ["--model", "avalanche", "--registry-nodes", "16"],
+            ["--model", "backlog", "--node-churn-rate", "0.5"],
+            # stake on a uniform-sampling model (silently inert)
+            ["--model", "snowball", "--stake-mode", "zipf"],
+            # malformed explicit vector
+            ["--model", "avalanche", "--stake-mode", "explicit",
+             "--stake-weights", "1,a,3"],
+            # registry without a stake mode (config rejection at parser)
+            ["--model", "node_stream", "--registry-nodes", "24",
+             "--active-nodes", "8"],
+            # stake_zipf_s phase axis without zipf mode
+            ["--model", "avalanche", "--fleet", "4", "--phase-grid",
+             '{"stake_zipf_s": [1.0, 2.0]}'],
+    ):
+        with pytest.raises(SystemExit):
+            main(argv)
+
+
+@pytest.mark.slow
+def test_cli_node_stream_mesh(capsys):
+    from go_avalanche_tpu.run_sim import main
+
+    result = main(["--model", "node_stream", "--txs", "8",
+                   "--registry-nodes", "24", "--active-nodes", "8",
+                   "--stake-mode", "uniform", "--node-churn-rate",
+                   "0.3", "--max-rounds", "6", "--mesh", "4,2",
+                   "--json"])
+    assert result["resident_count"] == 8
+    assert result["churned_in"] > 0

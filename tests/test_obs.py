@@ -251,3 +251,55 @@ def test_run_sim_metrics_and_watchdog(tmp_path):
         (tmp_path / "rs.jsonl.manifest.json").read_text())
     assert manifest["model"] == "avalanche"
     assert manifest["config"]["metrics_every"] == 3
+
+
+@pytest.mark.parametrize("cfg,expected", [
+    (AvalancheConfig(stake_mode="uniform"), ", uniform-stake"),
+    (AvalancheConfig(stake_mode="zipf", stake_zipf_s=1.5),
+     ", zipf-stake1.5"),
+    (AvalancheConfig(stake_mode="zipf", n_clusters=4),
+     ", zipf-stake1, hier4"),
+    (AvalancheConfig(stake_mode="uniform", registry_nodes=1024,
+                     active_nodes=128),
+     ", uniform-stake, registry1024/128"),
+    (AvalancheConfig(n_clusters=2, arrival_mode="poisson",
+                     arrival_rate=8.0,
+                     arrival_cluster_weights=(4.0, 0.5)),
+     ", poisson-arrival8, arrival-skew"),
+])
+def test_tag_stake_and_skew_fragments_pinned(cfg, expected):
+    """PR 10 fragments: stake / hierarchical-engine / registry /
+    arrival-skew — same contract as the PR 5 pins (the tag is the
+    archived delta chains' join key)."""
+    assert obs.tag_from_config(cfg) == expected
+
+
+def test_sink_tap_preserves_float_fields(tmp_path):
+    """The in-graph tap must not truncate float telemetry (the PR 10
+    node-stream `resident_stake` fraction read 0 under the old
+    every-field int() cast); integer counters stay ints."""
+    import json
+
+    import jax
+    import jax.numpy as jnp
+
+    from go_avalanche_tpu.obs import sink as obs_sink
+
+    path = tmp_path / "f.jsonl"
+    cfg = AvalancheConfig(metrics_every=1)
+
+    class Tel(tuple):
+        _fields = ("frac", "count")
+        frac = property(lambda s: s[0])
+        count = property(lambda s: s[1])
+
+    def emit(r):
+        obs_sink.emit_round(cfg, r, Tel((jnp.float32(0.625),
+                                         jnp.int32(7))))
+        return r
+
+    with obs.metrics_sink(path):
+        jax.jit(emit)(jnp.int32(0))
+    row = json.loads(path.read_text().splitlines()[0])
+    assert row["frac"] == 0.625 and isinstance(row["frac"], float)
+    assert row["count"] == 7 and isinstance(row["count"], int)

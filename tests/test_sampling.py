@@ -295,3 +295,67 @@ def test_clustered_locality_partition_splits_decisions():
         split_txs[loc] = int((~unanimous).sum())
     assert split_txs[0.5] == 0, split_txs          # mixed draws: one answer
     assert split_txs[0.98] > 0, split_txs          # partition-like: split
+
+
+# --- sample_peers_clustered degenerate shapes (PR 10 satellite):
+# C-not-dividing-N straddle boundaries, locality corners, and a
+# chi-square draw-frequency check against the analytic cluster mass.
+
+
+def test_clustered_full_locality_non_divisible_straddle():
+    """C does not divide N: contiguous blocks are uneven (sizes differ
+    by one) — with locality 1.0 every draw must still land in the
+    drawing node's OWN cluster_of block, including the boundary rows
+    of the straddled sizes."""
+    from go_avalanche_tpu.ops.sampling import sample_peers_clustered
+
+    for n, c in ((13, 4), (30, 7), (9, 4)):
+        p = np.asarray(sample_peers_clustered(
+            jax.random.key(5), jnp.ones((n,)), n, 8, c, 1.0))
+        cl = np.arange(n) * c // n
+        assert (cl[p] == cl[:, None]).all(), (n, c)
+        assert (p >= 0).all() and (p < n).all()
+
+
+def test_clustered_zero_locality_never_stays_home():
+    """locality == 0.0: the own-cluster weight row is exactly zero, so
+    no draw may land in the drawing node's own cluster — the inverse
+    corner of the locality=1.0 pin, on a non-divisible shape too."""
+    from go_avalanche_tpu.ops.sampling import sample_peers_clustered
+
+    for n, c in ((48, 6), (13, 4)):
+        p = np.asarray(sample_peers_clustered(
+            jax.random.key(6), jnp.ones((n,)), n, 8, c, 0.0))
+        cl = np.arange(n) * c // n
+        assert not (cl[p] == cl[:, None]).any(), (n, c)
+
+
+def test_clustered_draw_frequency_chi_square_matches_mass():
+    """Fixed-key chi-square: the per-cluster draw frequencies of one
+    source cluster's rows must match the analytic cluster mass —
+    locality * (own block weight share) for home, spread * share for
+    the rest — on an UNEVEN (C does not divide N) partition where the
+    block-size asymmetry shows up in the masses themselves."""
+    from go_avalanche_tpu.ops.sampling import sample_peers_clustered
+
+    n, c, k, loc = 26, 4, 8, 0.7
+    cl = np.arange(n) * c // n
+    sizes = np.bincount(cl, minlength=c).astype(float)
+    draws = []
+    for seed in range(40):
+        draws.append(np.asarray(sample_peers_clustered(
+            jax.random.key(seed), jnp.ones((n,)), n, k, c, loc)))
+    p = np.concatenate(draws, axis=1)          # [n, 40*k]
+    spread = (1.0 - loc) / (c - 1)
+    for source in range(c):
+        rows = p[cl == source].ravel()
+        counts = np.bincount(cl[rows], minlength=c).astype(float)
+        # Analytic mass: per-cluster factor x block weight (uniform
+        # base weights => proportional to block SIZE), renormalized.
+        factor = np.full(c, spread)
+        factor[source] = loc
+        expect = factor * sizes
+        expect = expect / expect.sum() * counts.sum()
+        chi2 = ((counts - expect) ** 2 / expect).sum()
+        # 3 dof; P(chi2 > 16.3) ~ 0.001 — fixed keys, so deterministic.
+        assert chi2 < 16.3, (source, chi2, counts, expect)

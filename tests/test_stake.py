@@ -1,0 +1,205 @@
+"""Stake subsystem tests: distributions, the hierarchical sampler's
+flat-CDF bit-parity (the PR 10 acceptance pin), committee statistics,
+and the config's inert-knob rejections."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_avalanche_tpu import stake
+from go_avalanche_tpu.config import AvalancheConfig
+from go_avalanche_tpu.models import avalanche as av
+from go_avalanche_tpu.ops import voterecord as vr
+from go_avalanche_tpu.ops.sampling import (
+    draw_peers,
+    sample_peers_hierarchical,
+    sample_peers_weighted,
+)
+
+
+# --- node_stake: the jit-static realization.
+
+def test_node_stake_off_is_statically_absent():
+    assert stake.node_stake(AvalancheConfig(), 16) is None
+
+
+def test_node_stake_uniform_and_zipf_values():
+    cfg = AvalancheConfig(stake_mode="uniform")
+    np.testing.assert_array_equal(np.asarray(stake.node_stake(cfg, 4)),
+                                  np.ones(4, np.float32))
+    cfg = AvalancheConfig(stake_mode="zipf", stake_zipf_s=2.0)
+    s = np.asarray(stake.node_stake(cfg, 4))
+    np.testing.assert_allclose(s, [1.0, 1 / 4, 1 / 9, 1 / 16], rtol=1e-6)
+    assert (np.diff(s) < 0).all()          # id 0 richest
+
+
+def test_node_stake_explicit_vector_and_length_mismatch():
+    cfg = AvalancheConfig(stake_mode="explicit",
+                          stake_weights=(3.0, 1.0, 2.0))
+    np.testing.assert_array_equal(np.asarray(stake.node_stake(cfg, 3)),
+                                  [3.0, 1.0, 2.0])
+    with pytest.raises(ValueError, match="one stake per node"):
+        stake.node_stake(cfg, 5)
+
+
+@pytest.mark.parametrize("bad,match", [
+    (dict(stake_mode="bogus"), "stake_mode"),
+    (dict(stake_zipf_s=2.0), "only read by stake_mode 'zipf'"),
+    (dict(stake_mode="zipf", stake_zipf_s=0.0), "positive finite"),
+    (dict(stake_mode="explicit"), "needs a stake_weights"),
+    (dict(stake_weights=(1.0,)), "only read by stake_mode 'explicit'"),
+    (dict(stake_mode="explicit", stake_weights=()), "non-empty"),
+    (dict(stake_mode="explicit", stake_weights=(1.0, -1.0)),
+     "positive finite"),
+    (dict(stake_mode="explicit", stake_weights=(1.0, True)),
+     "positive finite"),
+    (dict(stake_mode="uniform", sample_with_replacement=False),
+     "sample_with_replacement"),
+    (dict(stake_mode="uniform", latency_mode="weighted",
+          latency_rounds=2, time_step_s=1.0, request_timeout_s=5.0),
+     "couple delay to stake"),
+    (dict(registry_nodes=10), "come together"),
+    (dict(stake_mode="uniform", registry_nodes=10, active_nodes=10),
+     "smaller than registry_nodes"),
+    (dict(registry_nodes=10, active_nodes=4), "needs a stake_mode"),
+    (dict(stake_mode="explicit", stake_weights=(1.0, 2.0),
+          registry_nodes=10, active_nodes=4),
+     "REGISTRY's stake vector"),
+    (dict(node_churn_rate=0.5), "only read by the node-stream"),
+    (dict(stake_mode="uniform", registry_nodes=10, active_nodes=4,
+          node_churn_rate=1.5), "node_churn_rate"),
+])
+def test_stake_config_rejections(bad, match):
+    with pytest.raises(ValueError, match=match):
+        AvalancheConfig(**bad)
+
+
+# --- hierarchical two-level sampler == flat stake CDF, bit for bit.
+
+@pytest.mark.parametrize("n,n_clusters", [
+    (64, 1), (64, 4), (64, 7),      # C | N and C does not divide N
+    (60, 7), (63, 7), (30, 4),      # uneven contiguous blocks
+])
+def test_hierarchical_matches_flat_cdf_bit_exact(n, n_clusters):
+    """The acceptance pin: the two-level draw is the SAME distribution
+    as the flat inverse-CDF — identical int32 ids on the same key,
+    including zero-weight holes and C-not-dividing-N block shapes."""
+    w = jax.random.uniform(jax.random.key(99), (n,)) + 0.01
+    w = w.at[n // 3].set(0.0).at[n - 1].set(0.0)
+    for seed in range(3):
+        key = jax.random.key(seed)
+        flat = sample_peers_weighted(key, w, 29, 8)
+        hier = sample_peers_hierarchical(key, w, 29, 8, n_clusters)
+        np.testing.assert_array_equal(np.asarray(flat),
+                                      np.asarray(hier))
+
+
+def test_hierarchical_never_draws_zero_weight():
+    w = jnp.ones((28,)).at[5].set(0.0).at[20].set(0.0)
+    p = np.asarray(sample_peers_hierarchical(jax.random.key(2), w,
+                                             512, 8, 7))
+    assert not np.isin(p, [5, 20]).any()
+    assert (p >= 0).all() and (p < 28).all()
+
+
+def test_hierarchical_whole_zero_cluster_is_skipped():
+    # Cluster 1 of 4 (ids 8..15) carries zero mass: never drawn, and
+    # the parity with the flat CDF still holds on the same key.
+    w = jnp.ones((32,)).at[8:16].set(0.0)
+    key = jax.random.key(11)
+    hier = np.asarray(sample_peers_hierarchical(key, w, 256, 8, 4))
+    assert not ((hier >= 8) & (hier < 16)).any()
+    np.testing.assert_array_equal(
+        hier, np.asarray(sample_peers_weighted(key, w, 256, 8)))
+
+
+def test_draw_peers_stake_dispatch_uses_weighted_machinery():
+    """With stake on, draw_peers runs the flat weighted CDF over the
+    (stake-folded) latency_weight plane — and the clustered config
+    switches only the ENGINE, not the distribution."""
+    key = jax.random.key(5)
+    lw = jnp.linspace(2.0, 0.5, 24)       # a stake-folded plane
+    alive = jnp.ones((24,), jnp.bool_)
+    cfg = AvalancheConfig(stake_mode="uniform")
+    peers, self_draw = draw_peers(key, cfg, lw, alive, 24)
+    direct = sample_peers_weighted(key, lw, 24, cfg.k)
+    np.testing.assert_array_equal(np.asarray(peers), np.asarray(direct))
+    assert self_draw is not None          # weighted family abstains
+    cfg_h = AvalancheConfig(stake_mode="uniform", n_clusters=4)
+    peers_h, _ = draw_peers(key, cfg_h, lw, alive, 24)
+    np.testing.assert_array_equal(np.asarray(peers_h),
+                                  np.asarray(direct))
+
+
+def test_stake_folds_into_init_propensity_plane():
+    cfg = AvalancheConfig(stake_mode="zipf", stake_zipf_s=1.0)
+    state = av.init(jax.random.key(0), 8, 4, cfg)
+    np.testing.assert_allclose(
+        np.asarray(state.latency_weight),
+        1.0 / np.arange(1, 9, dtype=np.float32), rtol=1e-6)
+    # off: the plane stays uniform (the weightless pre-stake path).
+    state0 = av.init(jax.random.key(0), 8, 4, AvalancheConfig())
+    np.testing.assert_array_equal(np.asarray(state0.latency_weight),
+                                  np.ones(8, np.float32))
+
+
+def test_committee_draw_frequency_tracks_stake():
+    # Node 0 holds ~half the total zipf-2 mass at n=16; its draw
+    # frequency must track its stake share.
+    cfg = AvalancheConfig(stake_mode="zipf", stake_zipf_s=2.0)
+    s = np.asarray(stake.node_stake(cfg, 16))
+    share = s[0] / s.sum()
+    state = av.init(jax.random.key(0), 16, 2, cfg)
+    hits = total = 0
+    for seed in range(24):
+        peers, _ = draw_peers(jax.random.key(seed), cfg,
+                              state.latency_weight, state.alive, 16)
+        p = np.asarray(peers)
+        hits += (p == 0).sum()
+        total += p.size
+    assert abs(hits / total - share) < 0.05
+
+
+def test_stake_network_converges_hierarchical():
+    # End-to-end: a zipf-staked clustered network still finalizes
+    # everything through the hierarchical committee engine.
+    cfg = AvalancheConfig(stake_mode="zipf", n_clusters=4)
+    state = av.init(jax.random.key(0), 48, 6, cfg)
+    final = av.run(state, cfg, max_rounds=300)
+    assert bool(vr.has_finalized(final.records.confidence).all())
+
+
+# --- draw_working_set: exact weighted sampling without replacement.
+
+def test_draw_working_set_distinct_and_masked():
+    s = jnp.asarray([5.0, 1.0, 0.0, 2.0, 3.0, 1.0])
+    ids, valid = stake.draw_working_set(jax.random.key(1), s, 4)
+    i = np.asarray(ids)
+    assert len(set(i.tolist())) == 4
+    assert 2 not in i.tolist()            # zero stake never drawn
+    assert np.asarray(valid).all()
+    # mask excludes entries like residency does
+    ids2, valid2 = stake.draw_working_set(
+        jax.random.key(1), s, 4,
+        mask=jnp.asarray([False, True, True, True, True, True]))
+    assert 0 not in np.asarray(ids2)[np.asarray(valid2)].tolist()
+
+
+def test_draw_working_set_valid_flags_exhausted_pool():
+    s = jnp.asarray([1.0, 2.0, 0.0, 0.0])
+    ids, valid = stake.draw_working_set(jax.random.key(3), s, 4)
+    v = np.asarray(valid)
+    assert v.sum() == 2                   # only two drawable entries
+    assert set(np.asarray(ids)[v].tolist()) == {0, 1}
+
+
+def test_draw_working_set_frequency_tracks_stake():
+    # P(id 0 in a 2-of-4 working set) under stakes (6,1,1,1): high-
+    # stake entries are resident far more often than uniform would be.
+    s = jnp.asarray([6.0, 1.0, 1.0, 1.0])
+    hit = 0
+    for seed in range(200):
+        ids, _ = stake.draw_working_set(jax.random.key(seed), s, 2)
+        hit += 0 in np.asarray(ids).tolist()
+    assert hit / 200 > 0.85               # uniform would sit at 0.5

@@ -563,3 +563,95 @@ def test_connector_submit_load_generator_loop():
             with pytest.raises(ProtocolError, match="streaming model"):
                 c.sim_init(16, 48, model="avalanche",
                            arrival_mode="poisson", arrival_rate=2.0)
+
+
+# --- per-cluster arrival skew (PR 10 satellite: hot regions compose
+# the schedule with the clustered topology).
+
+
+def test_arrival_cluster_weights_config_rejections():
+    base = dict(n_clusters=2, arrival_mode="poisson", arrival_rate=4.0)
+    with pytest.raises(ValueError, match="clustered topology"):
+        AvalancheConfig(arrival_mode="poisson", arrival_rate=4.0,
+                        arrival_cluster_weights=(1.0, 2.0))
+    with pytest.raises(ValueError, match="silently ignored"):
+        AvalancheConfig(n_clusters=2,
+                        arrival_cluster_weights=(1.0, 2.0))
+    with pytest.raises(ValueError, match="one rate multiplier per"):
+        AvalancheConfig(**base, arrival_cluster_weights=(1.0,))
+    with pytest.raises(ValueError, match="positive finite"):
+        AvalancheConfig(**base, arrival_cluster_weights=(1.0, -2.0))
+    with pytest.raises(ValueError, match="positive finite"):
+        AvalancheConfig(**base, arrival_cluster_weights=(1.0, True))
+    with pytest.raises(ValueError, match="never performs"):
+        AvalancheConfig(n_clusters=2, arrival_mode="external",
+                        arrival_cluster_weights=(1.0, 2.0))
+    # valid config normalizes to a tuple
+    cfg = AvalancheConfig(**base, arrival_cluster_weights=[2.0, 0.5])
+    assert cfg.arrival_cluster_weights == (2.0, 0.5)
+
+
+@pytest.mark.slow
+def test_arrival_cluster_skew_hot_region_drains_faster():
+    """The hot region's admission block arrives faster than the cold
+    one: with weights (hot, cold) the watermark crosses the half-way
+    boundary strictly sooner than with the mirrored (cold, hot)
+    weights on the SAME key — and the sequence is deterministic.
+    (Three bl.step compiles — rides the slow lane; the fast lane keeps
+    the static-absence, rejection and CLI pins.)"""
+    def rounds_to_half(weights):
+        cfg = AvalancheConfig(n_clusters=2, arrival_mode="poisson",
+                              arrival_rate=4.0,
+                              arrival_cluster_weights=weights,
+                              finalization_score=0x7FFE, gossip=False)
+        b = bl.make_backlog(jnp.arange(48, dtype=jnp.int32))
+        state = bl.init(jax.random.key(9), 8, 8, b, cfg)
+        step = jax.jit(bl.step, static_argnames="cfg")
+        for r in range(1, 64):
+            state, _ = step(state, cfg)
+            if int(jax.device_get(state.traffic.arrived_idx)) >= 24:
+                return r
+        return 64
+
+    hot_first = rounds_to_half((6.0, 0.25))
+    cold_first = rounds_to_half((0.25, 6.0))
+    assert hot_first < cold_first, (hot_first, cold_first)
+    assert rounds_to_half((6.0, 0.25)) == hot_first   # deterministic
+
+
+def test_arrival_cluster_skew_off_is_statically_absent():
+    """Without the weights the arrive() draw must not change: the skew
+    branch is statically absent (the flagship_traffic pin class)."""
+    cfg_plain = AvalancheConfig(arrival_mode="poisson", arrival_rate=3.0)
+    cfg_clustered = AvalancheConfig(n_clusters=2,
+                                    arrival_mode="poisson",
+                                    arrival_rate=3.0)
+    b = bl.make_backlog(jnp.arange(24, dtype=jnp.int32))
+    s1 = bl.init(jax.random.key(4), 8, 8, b, cfg_plain)
+    s2 = bl.init(jax.random.key(4), 8, 8, b, cfg_clustered)
+    t1, n1 = tf.arrive(s1.traffic, cfg_plain, jnp.int32(0),
+                       jnp.int32(0), 8)
+    t2, n2 = tf.arrive(s2.traffic, cfg_clustered, jnp.int32(0),
+                       jnp.int32(0), 8)
+    assert int(n1) == int(n2)
+    np.testing.assert_array_equal(np.asarray(t1.arrival_round),
+                                  np.asarray(t2.arrival_round))
+
+
+def test_run_sim_arrival_cluster_weights_parser():
+    from go_avalanche_tpu.run_sim import main
+
+    with pytest.raises(SystemExit):      # malformed CSV
+        main(["--model", "backlog", "--arrival-mode", "poisson",
+              "--arrival-rate", "2", "--clusters", "2",
+              "--arrival-cluster-weights", "1,x"])
+    with pytest.raises(SystemExit):      # inert without clusters
+        main(["--model", "backlog", "--arrival-mode", "poisson",
+              "--arrival-rate", "2",
+              "--arrival-cluster-weights", "1,2"])
+    result = main(["--model", "backlog", "--nodes", "8", "--txs", "24",
+                   "--slots", "8", "--clusters", "2",
+                   "--arrival-mode", "poisson", "--arrival-rate", "4",
+                   "--arrival-cluster-weights", "4,0.5",
+                   "--max-rounds", "200", "--json"])
+    assert result["settled_fraction"] > 0
