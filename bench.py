@@ -146,6 +146,7 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
           arrival: float | None = None, arrival_window: int = 1024,
           stake: str = "off", stake_clusters: int = 1,
           metrics: str | None = None, metrics_every: int = 0,
+          metrics_tap: str = "callback",
           profile: bool = False) -> dict:
     import contextlib
     import dataclasses
@@ -170,6 +171,18 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
         metrics_every = 0
     elif metrics_every == 0:
         metrics_every = 1
+    # `--metrics-tap trace` (the A/B lane): the same stride drives the
+    # on-device trace plane (obs/trace.py) instead of the io_callback —
+    # the timed program's tap cost becomes one dynamic_update_slice per
+    # emitted round.  The buffer is sized for EVERY sweep (warmup +
+    # repeats) so the donated state can chain without overrunning it,
+    # and the decoded rows stream to the sink after the timed loop.
+    tap_stride = metrics_every
+    trace_every = 0
+    if metrics and metrics_tap == "trace":
+        metrics_every = 0
+        trace_every = tap_stride
+    trace_rounds = n_rounds * (repeats + 1)
     if arrival is not None:
         # The live-traffic lane: the streaming backlog scheduler under
         # poisson arrival with closed-loop admission
@@ -184,7 +197,9 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
         window = min(arrival_window, n_txs)
         state, cfg = traffic_backlog_state(n_nodes, n_txs, window, k,
                                            rate=arrival,
-                                           metrics_every=metrics_every)
+                                           metrics_every=metrics_every,
+                                           trace_every=trace_every,
+                                           trace_rounds=trace_rounds)
     elif fleet is not None:
         # The in-graph tap's io_callback has no per-trial identity
         # under the fleet vmap (same rule as fleet.run_fleet); the CLI
@@ -210,6 +225,8 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
                                     timeout_rounds=timeout_rounds,
                                     inflight_engine=inflight,
                                     metrics_every=metrics_every,
+                                    trace_every=trace_every,
+                                    trace_rounds=trace_rounds,
                                     stake=stake,
                                     clusters=stake_clusters)
     if exchange != "fused":
@@ -242,7 +259,7 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
     else:
         run = flagship_program(cfg, n_rounds)
 
-    with sink_ctx:
+    with sink_ctx as sink:
         # Warm-up: compile + one executed sweep.
         state = run(state)
         _sync(state)
@@ -254,6 +271,14 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
             _sync(state)
             dt = time.perf_counter() - t0
             best_dt = dt if best_dt is None else min(best_dt, dt)
+
+        if trace_every and sink is not None:
+            # Decode the trace plane AFTER the timed loop (the whole
+            # point: the hot loop paid a memory write, not a callback).
+            from go_avalanche_tpu.obs import trace as obs_trace
+
+            buf = state.trace if arrival is None else state.sim.trace
+            obs_trace.write_trace(sink, buf)
 
     if metrics:
         # Provenance next to the trace: config, topology, pin hashes,
@@ -322,6 +347,7 @@ def _worker_main(args: argparse.Namespace) -> None:
                    arrival_window=args.arrival_window,
                    stake=args.stake, stake_clusters=args.stake_clusters,
                    metrics=args.metrics, metrics_every=args.metrics_every,
+                   metrics_tap=args.metrics_tap,
                    profile=args.profile)
     if args.nonce:
         # Echoed back so the parent can verify this line belongs to THIS
@@ -558,6 +584,22 @@ def main() -> None:
                         help="emit every N-th round (cfg.metrics_every); "
                              "defaults to 1 when --metrics is given, "
                              "0 (tap statically absent) otherwise")
+    parser.add_argument("--metrics-tap", choices=("callback", "trace"),
+                        default="callback",
+                        help="with --metrics: which tap feeds the sink "
+                             "at the --metrics-every stride.  "
+                             "'callback' = the io_callback flight "
+                             "recorder (PR 5; pinned as "
+                             "flagship_metrics).  'trace' = the "
+                             "on-device trace plane (obs/trace.py; "
+                             "pinned as flagship_trace): the timed "
+                             "loop pays one dynamic_update_slice per "
+                             "emitted round and the rows decode to "
+                             "the sink AFTER timing — the A/B that "
+                             "prices the callback's hot-loop cost.  "
+                             "Tags the metric ', metricsN' vs "
+                             "', traceN', so same-metric deltas never "
+                             "cross taps")
     parser.add_argument("--profile", action="store_true",
                         help="attach per-phase wall times (one eager round "
                              "under tracing.collect_phase_times) as a "
@@ -654,6 +696,18 @@ def main() -> None:
         # Reject here: the worker subprocess's ValueError would read as
         # an accelerator failure and spin the retry/fallback loop.
         parser.error("--metrics-every must be >= 0")
+    if args.metrics_tap == "trace" and not args.metrics:
+        parser.error("--metrics-tap trace requires --metrics (the "
+                     "decoded trace plane needs a sink)")
+    if (args.metrics_tap == "trace" and args.metrics
+            and args.metrics_every > args.rounds):
+        # Parser-level (the PR 5 rule): obs.trace.alloc would reject
+        # the inert stride in the WORKER, which the parent reads as an
+        # accelerator failure and spins the retry/fallback loop.
+        parser.error(f"--metrics-every ({args.metrics_every}) exceeds "
+                     f"--rounds ({args.rounds}) with --metrics-tap "
+                     f"trace: the stride must fit one timed sweep or "
+                     f"the trace plane samples nothing")
     if args.metrics and args.metrics_every == 0:
         args.metrics_every = 1
     elif args.metrics_every and not args.metrics:
@@ -678,7 +732,8 @@ def main() -> None:
         + ([f"--timeout-rounds={args.timeout_rounds}"]
            if args.timeout_rounds is not None else []) \
         + ([f"--metrics={args.metrics}",
-            f"--metrics-every={args.metrics_every}"]
+            f"--metrics-every={args.metrics_every}",
+            f"--metrics-tap={args.metrics_tap}"]
            if args.metrics else []) \
         + (["--profile"] if args.profile else [])
     size = [f"--nodes={args.nodes}", f"--txs={args.txs}",

@@ -50,6 +50,17 @@ entry):
                      fleet=1 spelling with an explicitly-empty
                      stochastic fault block lowers to the archived
                      `flagship` pin byte-identical;
+  flagship_trace   — the on-device trace plane on the coalesced async
+                     flagship (`bench.py --latency 2 --inflight-engine
+                     coalesced --metrics ... --metrics-tap trace`,
+                     cfg.trace_every=2: the state carries the [S, M]
+                     trace buffer and each emitted round is one
+                     dynamic_update_slice, PR 11) — the zero-callback
+                     observability on-path program.  The OFF path
+                     (trace_every=0 == every archived pin
+                     byte-identical, and flagship_trace with the plane
+                     forced off == the flagship_async_coalesced pin) is
+                     covered by `--verify-off-path`;
   flagship_traffic — the `bench.py --arrival` program: the streaming
                      backlog scheduler (`models/backlog.step`) under
                      live-traffic poisson arrival with closed-loop
@@ -118,6 +129,7 @@ def flagship_stablehlo(nodes: int, txs: int, rounds: int, k: int,
                        latency: int = 0,
                        inflight: str = "walk",
                        metrics_every: int = 0,
+                       trace_every: int = 0,
                        faults=None,
                        stake: str = "off",
                        clusters: int = 1) -> str:
@@ -141,7 +153,8 @@ def flagship_stablehlo(nodes: int, txs: int, rounds: int, k: int,
     from benchmarks.workload import flagship_config, flagship_state
 
     cfg = flagship_config(txs, k, latency, inflight_engine=inflight,
-                          metrics_every=metrics_every, stake=stake,
+                          metrics_every=metrics_every,
+                          trace_every=trace_every, stake=stake,
                           clusters=clusters)
     if exchange != "fused":
         cfg = dataclasses.replace(cfg, fused_exchange=False)
@@ -152,9 +165,14 @@ def flagship_stablehlo(nodes: int, txs: int, rounds: int, k: int,
 
         cfg = dataclasses.replace(cfg,
                                   fault_script=fault_script_from_json(faults))
+    # trace_every > 0: the state carries the [S, M] trace plane sized
+    # for the pinned program's scan horizon (obs/trace.py); 0 leaves
+    # the state — and therefore every archived pin — byte-identical.
     state_abs = jax.eval_shape(
         lambda: flagship_state(nodes, txs, k, latency,
-                               inflight_engine=inflight)[0])
+                               inflight_engine=inflight,
+                               trace_every=trace_every,
+                               trace_rounds=rounds)[0])
     return bench.flagship_program(cfg, rounds).lower(state_abs).as_text()
 
 
@@ -268,6 +286,9 @@ PROGRAMS = {
                     lambda w: fleet_stablehlo(**w)),
     "flagship_stake": (dict(FLAGSHIP, stake="zipf", clusters=4),
                        lambda w: flagship_stablehlo(**w)),
+    "flagship_trace": (dict(FLAGSHIP, latency=2, inflight="coalesced",
+                            trace_every=2),
+                       lambda w: flagship_stablehlo(**w)),
     "flagship_traffic": (dict(TRAFFIC),
                          lambda w: traffic_stablehlo(**w)),
     "streaming_step": (dict(STREAMING),
@@ -287,6 +308,7 @@ PROGRAM_BUILDERS = {
     "flagship_metrics": ("flagship_config", "flagship_state"),
     "flagship_faults": ("flagship_config", "flagship_state"),
     "flagship_stake": ("flagship_config", "flagship_state"),
+    "flagship_trace": ("flagship_config", "flagship_state"),
     "fleet_small": ("flagship_config", "fleet_flagship_state"),
     "flagship_traffic": ("traffic_config", "traffic_backlog_state"),
     "streaming_step": ("northstar_config", "northstar_state"),
@@ -400,15 +422,16 @@ def verify_off_path(platform: str, archive: dict | None = None) -> list:
             continue
         workload = dict(entry.get("workload") or PROGRAMS[name][0])
         workload["metrics_every"] = 0
+        workload["trace_every"] = 0
         workload["faults"] = []
         workload["stake"] = "off"
         current = program_hash(name, workload)
         if current != pinned:
             failures.append(
-                f"{name}: metrics-off empty-script stake-off program "
-                f"{current} != pinned {pinned} — the obs tap, the "
-                f"fault-script engine or the stake subsystem leaks "
-                f"into the off path")
+                f"{name}: metrics-off trace-off empty-script stake-off "
+                f"program {current} != pinned {pinned} — the obs tap, "
+                f"the trace plane, the fault-script engine or the "
+                f"stake subsystem leaks into the off path")
     for tapped, base, overrides, what in (
             ("flagship_metrics", "flagship", {"metrics_every": 0},
              "the tapped program differs from the untapped one by more "
@@ -419,7 +442,11 @@ def verify_off_path(platform: str, archive: dict | None = None) -> list:
             ("flagship_stake", "flagship",
              {"stake": "off", "clusters": 1},
              "the staked program differs from the weightless flagship "
-             "by more than the committee-draw engine")):
+             "by more than the committee-draw engine"),
+            ("flagship_trace", "flagship_async_coalesced",
+             {"trace_every": 0},
+             "the trace-plane program differs from the coalesced async "
+             "flagship by more than the trace tap")):
         on = archive.get("programs", {}).get(tapped)
         off = archive.get("programs", {}).get(base)
         if not (on and off and off.get("hashes", {}).get(platform)):

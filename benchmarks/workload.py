@@ -31,6 +31,7 @@ def flagship_config(txs: int, k: int = 8, latency: int = 0,
                     timeout_rounds: int | None = None,
                     inflight_engine: str = "walk",
                     metrics_every: int = 0,
+                    trace_every: int = 0,
                     stake: str = "off",
                     clusters: int = 1):
     """The flagship bench config alone — buildable without materializing
@@ -79,26 +80,34 @@ def flagship_config(txs: int, k: int = 8, latency: int = 0,
     return AvalancheConfig(finalization_score=0x7FFE, k=k, gossip=False,
                            max_element_poll=max(4096, txs),
                            metrics_every=metrics_every,
+                           trace_every=trace_every,
                            stake_mode=stake, n_clusters=clusters,
                            **async_kw)
 
 
 def flagship_state(nodes: int, txs: int, k: int = 8, latency: int = 0,
-                   **async_kw):
+                   trace_rounds: int = 0, **async_kw):
     """The `bench.py` flagship workload: (state, cfg) for sustained vote
     ingest on `models/avalanche.round_step`.
 
     One construction shared by `bench.py` (the throughput number) and
     `benchmarks/roofline.py` (the per-phase bandwidth anchor) so the two
     always measure the same program.  `async_kw` passes through to
-    `flagship_config` (latency_mode / timeout_rounds / inflight_engine).
+    `flagship_config` (latency_mode / timeout_rounds / inflight_engine /
+    trace_every).  With `trace_every > 0`, `trace_rounds` sizes the
+    on-device trace buffer attached to the state (the run horizon —
+    `bench.py` passes warmup + repeats so donated chaining never
+    overruns the plane).
     """
     import jax
 
     from go_avalanche_tpu.models import avalanche as av
 
     cfg = flagship_config(txs, k, latency, **async_kw)
-    return av.init(jax.random.key(0), nodes, txs, cfg), cfg
+    state = av.init(jax.random.key(0), nodes, txs, cfg)
+    if cfg.trace_every > 0:
+        state = av.with_trace(state, cfg, trace_rounds)
+    return state, cfg
 
 
 def fleet_flagship_state(fleet: int, nodes: int, txs: int, k: int = 8,
@@ -128,7 +137,7 @@ def fleet_flagship_state(fleet: int, nodes: int, txs: int, k: int = 8,
 
 
 def traffic_config(window: int, k: int = 8, rate: float = 24.0,
-                   metrics_every: int = 0):
+                   metrics_every: int = 0, trace_every: int = 0):
     """The `bench.py --arrival` lane's config: live-traffic poisson
     arrivals with closed-loop admission over the streaming backlog
     scheduler (`models/backlog`).  Unlike the flagship's unreachable
@@ -144,11 +153,13 @@ def traffic_config(window: int, k: int = 8, rate: float = 24.0,
                            arrival_mode="poisson",
                            arrival_rate=float(rate),
                            arrival_backpressure=(0.7, 0.95),
-                           metrics_every=metrics_every)
+                           metrics_every=metrics_every,
+                           trace_every=trace_every)
 
 
 def traffic_backlog_state(nodes: int, txs: int, window: int, k: int = 8,
-                          rate: float = 24.0, metrics_every: int = 0):
+                          rate: float = 24.0, metrics_every: int = 0,
+                          trace_every: int = 0, trace_rounds: int = 0):
     """The `bench.py --arrival` workload: (state, cfg) for the streaming
     backlog under live-traffic arrival — `txs` backlog entries (scores
     from the pinned score seed, like the north-star builder) streamed
@@ -160,12 +171,15 @@ def traffic_backlog_state(nodes: int, txs: int, window: int, k: int = 8,
 
     from go_avalanche_tpu.models import backlog as bl
 
-    cfg = traffic_config(window, k, rate, metrics_every)
+    cfg = traffic_config(window, k, rate, metrics_every, trace_every)
     scores = jax.random.randint(jax.random.key(_SCORE_SEED), (txs,), 0,
                                 _SCORE_MAX)
     backlog = bl.make_backlog(scores)
-    return bl.init(jax.random.key(_SIM_SEED), nodes, window, backlog,
-                   cfg), cfg
+    state = bl.init(jax.random.key(_SIM_SEED), nodes, window, backlog,
+                    cfg)
+    if cfg.trace_every > 0:
+        state = bl.with_trace(state, cfg, trace_rounds)
+    return state, cfg
 
 
 def northstar_config(window_sets: int, set_cap: int):

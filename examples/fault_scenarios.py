@@ -35,13 +35,16 @@ Scenarios (`--list` for the one-liners):
                       minority stalls — it can't reach quorum alone —
                       while the majority barely notices; after the heal
                       the minority catches up within one timeout.
-  monte_carlo       — the Monte-Carlo fleet (PR 7): a STOCHASTIC
-                      partition whose length (and split fraction) is
-                      drawn per trial from the init key
-                      (`cfg.fault_script` stochastic_partition ranges,
-                      `go_avalanche_tpu/fleet.py`), a whole fleet of
-                      sims vmapped into one program, each trial's recovery
-                      checked against ITS realized window
+  monte_carlo       — the Monte-Carlo fleet (PR 7, trace-backed since
+                      PR 11): a STOCHASTIC partition whose length (and
+                      split fraction) is drawn per trial from the init
+                      key (`cfg.fault_script` stochastic_partition
+                      ranges, `go_avalanche_tpu/fleet.py`), a whole
+                      fleet of sims vmapped into one program with the
+                      on-device trace plane on (`cfg.trace_every=1` —
+                      per-trial [F, S, M] round-by-round traces,
+                      obs/trace.py), each trial's recovery checked from
+                      ITS OWN trace against ITS realized window
                       (`FleetResult.cut_windows`) — ending in a printed
                       P(recovery) ± Wilson-CI verdict instead of one
                       anecdote, with a realized-length breakdown.
@@ -252,18 +255,22 @@ def run_monte_carlo(
     rounds [5, 10], LENGTH from [6, 28] rounds, split fraction from
     [0.35, 0.65] — realized independently per trial from the init key
     (`ops/inflight.draw_fault_params`), a fleet of whole sims vmapped
-    into one compiled program (`fleet.run_fleet`), and every trial's
-    recovery invariants checked against ITS OWN realized ``[start,
-    heal)`` window (`obs.check_recovery` on the fleet-stacked trace +
-    `FleetResult.cut_windows`).  The verdict is a POPULATION number:
+    into one compiled program (`fleet.run_fleet`) with the ON-DEVICE
+    TRACE PLANE on (`cfg.trace_every=1`, obs/trace.py — the vmap lifts
+    each trial's ``[S, M]`` buffer to per-trial ``[F, S, M]`` traces,
+    the tap the io_callback flight recorder could never provide under
+    vmap).  Every trial's recovery invariants are then checked against
+    ITS OWN realized ``[start, heal)`` window
+    (`obs.check_recovery(cfg, res.trace_records(),
+    windows=res.cut_windows)`).  The verdict is a POPULATION number:
     P(recovery) with a Wilson CI, plus the recovery rate bucketed by
     realized outage length — short cuts always heal, cuts approaching
     the horizon run out of rounds to drain their expiry tail.
 
-    With `metrics_path`, the fleet-stacked trace streams to that JSONL
-    file (per-round rows whose counters are per-trial LISTS — the
-    fleet-trace format, docs/observability.md) and the verdicts are
-    then checked FROM the file.
+    With `metrics_path`, the decoded fleet-stacked trace streams to
+    that JSONL file (per-round rows whose counters are per-trial
+    LISTS — the fleet-trace format, docs/observability.md) and the
+    verdicts are then checked FROM the file.
     """
     from go_avalanche_tpu import fleet as fl
     from go_avalanche_tpu import obs
@@ -276,10 +283,11 @@ def run_monte_carlo(
             ("stochastic_partition", (5, 10), (6, 28), (0.35, 0.65)),),
         time_step_s=1.0,
         request_timeout_s=float(timeout_rounds - 1),
+        trace_every=1,
     )
     res = fl.run_fleet("avalanche", cfg, fleet=fleet, n_nodes=nodes,
                        n_txs=txs, n_rounds=n_rounds, seed=seed)
-    records = fl.fleet_trace_records(res.telemetry, fleet)
+    records = res.trace_records()
 
     if metrics_path:
         with obs.metrics_sink(metrics_path,

@@ -425,6 +425,27 @@ class AvalancheConfig:
                                       #   they stream stacked telemetry
                                       #   host-side instead
                                       #   (obs.MetricsSink.write_stacked)
+    trace_every: int = 0              # on-device trace-plane stride
+                                      #   (go_avalanche_tpu/obs/trace.py):
+                                      #   every this-many rounds the
+                                      #   round/scheduler step writes its
+                                      #   flattened telemetry row into a
+                                      #   [S, M] int32 buffer carried IN
+                                      #   the sim state — one
+                                      #   dynamic_update_slice under a
+                                      #   round-mod lax.cond; no
+                                      #   callback, no host sync, legal
+                                      #   under shard_map (replicated
+                                      #   plane) and under the fleet
+                                      #   vmap ([F, S, M] per-trial
+                                      #   traces).  0 (default) =
+                                      #   statically absent: the state
+                                      #   carries no buffer and every
+                                      #   archived hlo pin is
+                                      #   byte-identical (the on path is
+                                      #   pinned as flagship_trace).
+                                      #   Decode: obs.trace
+                                      #   trace_records / write_trace
     stream_retire_cap: Optional[int] = None
                                       # streaming_dag scheduler: cap the
                                       #   set-slots retired+refilled per
@@ -795,6 +816,9 @@ class AvalancheConfig:
         if self.metrics_every < 0:
             raise ValueError("metrics_every must be >= 0 (0 disables the "
                              "in-graph metrics tap)")
+        if self.trace_every < 0:
+            raise ValueError("trace_every must be >= 0 (0 disables the "
+                             "on-device trace plane)")
         if self.stream_retire_cap is not None and self.stream_retire_cap < 1:
             raise ValueError("stream_retire_cap must be >= 1 (None "
                              "disables the cap)")
@@ -1288,6 +1312,20 @@ class AvalancheConfig:
                     raise ValueError(
                         f"rtt_matrix[{i}][{j}] must be a non-negative "
                         f"integer latency in rounds, got {entry!r}")
+
+
+def suppress_taps(cfg: AvalancheConfig) -> AvalancheConfig:
+    """The inner-round config a streaming scheduler passes to its
+    wrapped consensus round: BOTH telemetry taps zeroed (the io_callback
+    metrics tap and the on-device trace plane), so the scheduler emits /
+    writes exactly one record per round itself.  THE one spelling,
+    shared by the backlog / streaming_dag / node_stream schedulers and
+    their sharded twins — a drifted copy would double-emit rounds.
+    Returns `cfg` unchanged (same object — jit caches unaffected) when
+    no tap is on."""
+    if cfg.metrics_every == 0 and cfg.trace_every == 0:
+        return cfg
+    return dataclasses.replace(cfg, metrics_every=0, trace_every=0)
 
 
 DEFAULT_CONFIG = AvalancheConfig()

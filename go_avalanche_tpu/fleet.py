@@ -59,7 +59,12 @@ the config and shapes, never from traced values — pinned by the
 (tests/test_fleet.py, all three inflight engines, dense + sharded).
 `cfg.metrics_every` must be 0 here: the in-graph tap's io_callback
 has no per-trial identity under vmap (phase rows stream host-side
-through the sink instead).
+through the sink instead).  Round-by-round PER-TRIAL telemetry comes
+from the on-device trace plane instead (`cfg.trace_every > 0`,
+obs/trace.py): the vmap lifts each trial's ``[S, M]`` buffer to an
+``[F, S, M]`` stack (`FleetResult.trace` / `trace_records()`), which
+`obs.check_recovery` consumes for per-trial recovery verdicts against
+each trial's realized fault windows.
 """
 
 from __future__ import annotations
@@ -311,25 +316,35 @@ def _outcome_backlog(state, cfg: AvalancheConfig) -> TrialOutcome:
 def _compiled_fleet(model: str, cfg: AvalancheConfig, n_nodes: int,
                     n_txs: int, n_rounds: int, conflict_size: int,
                     yes_fraction: float, contested: bool, window: int):
-    """One jitted ``keys [F] -> (TrialOutcome [F], telemetry [F, R])``
-    program — the whole sim (init included) lives inside the vmap, so a
-    fleet is one compile and one dispatch per config point."""
+    """One jitted ``keys [F] -> (TrialOutcome [F], telemetry [F, R],
+    trace [F, S, M] | None)`` program — the whole sim (init included)
+    lives inside the vmap, so a fleet is one compile and one dispatch
+    per config point.  With `cfg.trace_every > 0` each trial carries
+    its own on-device trace plane (obs/trace.py) — the vmap lifts the
+    ``[S, M]`` buffer to PER-TRIAL ``[F, S, M]`` traces, which is what
+    the in-graph metrics tap could never do (an io_callback has no
+    per-trial identity under vmap)."""
 
     def trial(key):
         if model == "snowball":
             from go_avalanche_tpu.models import snowball as sb
 
-            state = sb.init(key, n_nodes, cfg, yes_fraction=yes_fraction)
+            state = sb.with_trace(
+                sb.init(key, n_nodes, cfg, yes_fraction=yes_fraction),
+                cfg, n_rounds)
             step, outcome = sb.round_step, _outcome_snowball
+            trace_of = lambda s: s.trace                    # noqa: E731
         elif model == "avalanche":
             from go_avalanche_tpu.models import avalanche as av
 
             init_pref = (av.contested_init_pref_from_key(key, n_nodes,
                                                          n_txs)
                          if contested else None)
-            state = av.init(key, n_nodes, n_txs, cfg,
-                            init_pref=init_pref)
+            state = av.with_trace(
+                av.init(key, n_nodes, n_txs, cfg, init_pref=init_pref),
+                cfg, n_rounds)
             step, outcome = av.round_step, _outcome_avalanche
+            trace_of = lambda s: s.trace                    # noqa: E731
         elif model == "backlog":
             from go_avalanche_tpu.models import backlog as bl
 
@@ -337,9 +352,11 @@ def _compiled_fleet(model: str, cfg: AvalancheConfig, n_nodes: int,
             # trials; only the sim/traffic key varies per trial.  A
             # final harvest pass records the last window's outcomes —
             # and their finality latencies — like `bl.run` does.
-            state = bl.init(key, n_nodes, window,
-                            bl.make_backlog(
-                                jnp.arange(n_txs, dtype=jnp.int32)), cfg)
+            state = bl.with_trace(
+                bl.init(key, n_nodes, window,
+                        bl.make_backlog(
+                            jnp.arange(n_txs, dtype=jnp.int32)), cfg),
+                cfg, n_rounds)
 
             def bl_step(s, c):
                 return bl.step(s, c)
@@ -349,21 +366,26 @@ def _compiled_fleet(model: str, cfg: AvalancheConfig, n_nodes: int,
                 return _outcome_backlog(final, c)
 
             step, outcome = bl_step, bl_outcome
+            trace_of = lambda s: s.sim.trace                # noqa: E731
         else:
             from go_avalanche_tpu.models import dag as dag_model
 
-            state = dag_model.init(
-                key, n_nodes,
-                jnp.arange(n_txs, dtype=jnp.int32) // conflict_size, cfg,
-                n_sets=n_txs // conflict_size, set_size=conflict_size)
+            state = dag_model.with_trace(
+                dag_model.init(
+                    key, n_nodes,
+                    jnp.arange(n_txs, dtype=jnp.int32) // conflict_size,
+                    cfg, n_sets=n_txs // conflict_size,
+                    set_size=conflict_size),
+                cfg, n_rounds)
             step, outcome = dag_model.round_step, _outcome_dag
+            trace_of = lambda s: s.base.trace               # noqa: E731
 
         def body(s, _):
             new_s, tel = step(s, cfg)
             return new_s, tel
 
         final, tel = lax.scan(body, state, None, length=n_rounds)
-        return outcome(final, cfg), tel
+        return outcome(final, cfg), tel, trace_of(final)
 
     return jax.jit(jax.vmap(trial))
 
@@ -398,6 +420,12 @@ class FleetResult:
                                     #   latency (p50, p99, p999); the
                                     #   backlog model's traffic plane
     arrived: Optional[np.ndarray] = None  # int32 [F] units arrived
+    trace: Optional[object] = None  # per-trial trace plane
+                                    #   (obs.trace.TraceBuffer with
+                                    #   [F, S, M] data) when
+                                    #   cfg.trace_every > 0 — decode
+                                    #   with `trace_records()`; None
+                                    #   otherwise
     p_violation: float = 0.0
     violation_ci: Tuple[float, float] = (0.0, 0.0)
     p_settled: float = 0.0
@@ -449,6 +477,20 @@ class FleetResult:
                             "lat_p999_mean": None, "lat_p99_max": None})
             row["arrived_mean"] = round(float(self.arrived.mean()), 3)
         return row
+
+    def trace_records(self) -> List[Dict]:
+        """The fleet's per-trial trace plane decoded to FLEET-STACKED
+        records (per-round dicts whose counters are per-trial LISTS —
+        the format `obs.check_recovery` verdicts per trial on).  Rows
+        are ordered by construction; no re-sort needed."""
+        if self.trace is None:
+            raise ValueError(
+                "this fleet ran without the trace plane — set "
+                "cfg.trace_every > 0 to capture per-trial round-by-"
+                "round traces (obs/trace.py)")
+        from go_avalanche_tpu.obs import trace as trace_mod
+
+        return trace_mod.fleet_trace_records(self.trace)
 
     def realizations(self) -> Dict:
         """JSON-ready per-trial stochastic fault realizations for the
@@ -539,7 +581,7 @@ def run_fleet(
         raise ValueError(f"n_txs ({n_txs}) must divide by conflict_size "
                          f"({conflict_size})")
     keys = jax.random.split(jax.random.key(seed), fleet)
-    outcome, telemetry = _compiled_fleet(
+    outcome, telemetry, trace_buf = _compiled_fleet(
         model, cfg, int(n_nodes), int(n_txs), int(n_rounds),
         int(conflict_size), float(yes_fraction), bool(contested),
         int(window))(keys)
@@ -577,6 +619,8 @@ def run_fleet(
         cut_windows=cut_windows, cut_split=cut_split,
         spike_windows=spike_windows, region_windows=region_windows,
         lat_percentiles=lat_percentiles, arrived=arrived,
+        trace=(None if trace_buf is None
+               else jax.device_get(trace_buf)),
         p_violation=float(violations.mean()),
         violation_ci=wilson_interval(int(violations.sum()), fleet),
         p_settled=float(settled.mean()),

@@ -37,6 +37,7 @@ from jax import lax
 
 from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG, VoteMode
 from go_avalanche_tpu.obs import sink as obs_sink
+from go_avalanche_tpu.obs import trace as obs_trace
 from go_avalanche_tpu.ops import adversary, exchange, inflight
 from go_avalanche_tpu.ops import voterecord as vr
 from go_avalanche_tpu.ops.bitops import pack_bool_plane, popcount8
@@ -99,6 +100,15 @@ class AvalancheSimState(NamedTuple):
                                  # script schedules stochastic events;
                                  # None = statically absent (every
                                  # archived hlo pin unchanged)
+    trace: Optional[obs_trace.TraceBuffer] = None
+                                 # on-device trace plane
+                                 # (obs/trace.py): an [S, M] int32 row
+                                 # buffer the round writes its
+                                 # telemetry into at round % stride ==
+                                 # 0 — attach with `with_trace` when
+                                 # cfg.trace_every > 0; None = the
+                                 # zero-trace path, statically absent
+                                 # (every archived hlo pin unchanged)
 
 
 class SimTelemetry(NamedTuple):
@@ -128,6 +138,20 @@ class SimTelemetry(NamedTuple):
                                # active partition (they will expire)
     gossip_writes: jax.Array   # int32 — (node, target) pairs the gossip
                                # scatter marked heard this round
+
+
+# The flagship round's trace-plane column manifest: exactly the
+# SimTelemetry fields, in JSONL flattening order (all int32 counters).
+TRACE_COLUMNS = obs_trace.columns_from_fields(SimTelemetry._fields)
+
+
+def with_trace(state: AvalancheSimState, cfg: AvalancheConfig,
+               n_rounds: int) -> AvalancheSimState:
+    """Attach the on-device trace plane for an `n_rounds`-horizon run
+    (no-op when `cfg.trace_every == 0`); also the DAG round's buffer —
+    it emits the same `SimTelemetry` columns."""
+    return state._replace(trace=obs_trace.alloc(cfg, n_rounds,
+                                                TRACE_COLUMNS))
 
 
 def contested_init_pref(seed: int, n_nodes: int, n_txs: int) -> jax.Array:
@@ -469,6 +493,8 @@ def round_step(
         key=k_next,
         inflight=ring,
         fault_params=state.fault_params,
+        trace=obs_trace.write_round(state.trace, cfg, state.round,
+                                    telemetry),
     )
     return new_state, telemetry
 

@@ -28,7 +28,6 @@ Design notes (TPU-first):
 
 from __future__ import annotations
 
-import dataclasses
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -36,9 +35,14 @@ import jax.numpy as jnp
 from jax import lax
 
 from go_avalanche_tpu import traffic as tf
-from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
+from go_avalanche_tpu.config import (
+    AvalancheConfig,
+    DEFAULT_CONFIG,
+    suppress_taps,
+)
 from go_avalanche_tpu.models import avalanche as av
 from go_avalanche_tpu.obs import sink as obs_sink
+from go_avalanche_tpu.obs import trace as obs_trace
 from go_avalanche_tpu.ops import inflight
 from go_avalanche_tpu.ops import voterecord as vr
 
@@ -288,6 +292,28 @@ class BacklogTelemetry(NamedTuple):
                           #   schema) when arrivals are off
 
 
+def trace_columns(cfg: AvalancheConfig) -> tuple:
+    """The scheduler's trace-plane column manifest: the inner round's
+    `SimTelemetry` fields, the scheduler stats, then the traffic fields
+    when the arrival plane is on — exactly the JSONL flattening order
+    of `BacklogTelemetry`."""
+    groups = [av.SimTelemetry._fields,
+              ("retired", "occupied", "backlog_left")]
+    if cfg.arrivals_enabled():
+        groups.append(tf.TrafficTelemetry._fields)
+    return obs_trace.columns_from_fields(*groups)
+
+
+def with_trace(state: BacklogSimState, cfg: AvalancheConfig,
+               n_rounds: int) -> BacklogSimState:
+    """Attach the on-device trace plane (obs/trace.py) — the SCHEDULER
+    owns it (full `BacklogTelemetry` rows; the inner round's write is
+    suppressed, mirroring the metrics tap).  No-op when
+    `cfg.trace_every == 0`."""
+    return state._replace(sim=state.sim._replace(
+        trace=obs_trace.alloc(cfg, n_rounds, trace_columns(cfg))))
+
+
 def step(
     state: BacklogSimState,
     cfg: AvalancheConfig = DEFAULT_CONFIG,
@@ -300,7 +326,10 @@ def step(
     counters, retire/occupancy stats, and the traffic plane's
     finality-latency percentiles — and suppresses the inner round's own
     emission so each round writes exactly one JSONL line
-    (docs/observability.md).
+    (docs/observability.md).  The on-device trace plane
+    (`cfg.trace_every > 0`, obs/trace.py) follows the same contract:
+    the scheduler writes the full record into `sim.trace`, the inner
+    round's write is suppressed.
     """
     round_val = state.sim.round
     arrivals = jnp.int32(0)
@@ -311,10 +340,7 @@ def step(
             state.slot_tx.shape[0])
         state = state._replace(traffic=new_traffic)
     state, retired = _retire_and_refill(state, cfg)
-    inner_cfg = (cfg if cfg.metrics_every == 0
-                 else dataclasses.replace(cfg, metrics_every=0))
-    new_sim, round_tel = av.round_step(state.sim, inner_cfg)
-    new_state = state._replace(sim=new_sim)
+    new_sim, round_tel = av.round_step(state.sim, suppress_taps(cfg))
     tel = BacklogTelemetry(
         round=round_tel,
         retired=retired,
@@ -324,7 +350,9 @@ def step(
                  else tf.traffic_telemetry(state.traffic, arrivals)),
     )
     obs_sink.emit_round(cfg, round_val, tel)
-    return new_state, tel
+    new_sim = new_sim._replace(
+        trace=obs_trace.write_round(new_sim.trace, cfg, round_val, tel))
+    return state._replace(sim=new_sim), tel
 
 
 def drained(state: BacklogSimState,

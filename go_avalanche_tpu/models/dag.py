@@ -36,6 +36,7 @@ from jax import lax
 from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
 from go_avalanche_tpu.models import avalanche as av
 from go_avalanche_tpu.obs import sink as obs_sink
+from go_avalanche_tpu.obs import trace as obs_trace
 from go_avalanche_tpu.ops import adversary, exchange, inflight
 from go_avalanche_tpu.ops import voterecord as vr
 from go_avalanche_tpu.ops.bitops import pack_bool_plane
@@ -155,6 +156,17 @@ def init(
                    scores=scores, track_finality=track_finality)
     return DagSimState(base=base, conflict_set=conflict_set, n_sets=n_sets,
                        set_size=set_size)
+
+
+def with_trace(state: DagSimState, cfg: AvalancheConfig,
+               n_rounds: int) -> DagSimState:
+    """Attach the on-device trace plane (obs/trace.py) for an
+    `n_rounds`-horizon run — the DAG round emits `SimTelemetry`, so the
+    buffer is the flagship manifest on the base state.  No-op when
+    `cfg.trace_every == 0`."""
+    return dataclasses.replace(state,
+                               base=av.with_trace(state.base, cfg,
+                                                  n_rounds))
 
 
 def preferred_in_set(
@@ -346,6 +358,8 @@ def round_step(
         key=k_next,
         inflight=ring,
         fault_params=base.fault_params,
+        trace=obs_trace.write_round(base.trace, cfg, base.round,
+                                    telemetry),
     )
     return DagSimState(new_base, state.conflict_set, state.n_sets,
                        state.set_size), telemetry

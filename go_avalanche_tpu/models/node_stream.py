@@ -46,7 +46,6 @@ per-shard PRNG streams differ by design, like every sharded model).
 
 from __future__ import annotations
 
-import dataclasses
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -54,9 +53,14 @@ import jax.numpy as jnp
 from jax import lax
 
 from go_avalanche_tpu import stake as stake_mod
-from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
+from go_avalanche_tpu.config import (
+    AvalancheConfig,
+    DEFAULT_CONFIG,
+    suppress_taps,
+)
 from go_avalanche_tpu.models import avalanche as av
 from go_avalanche_tpu.obs import sink as obs_sink
+from go_avalanche_tpu.obs import trace as obs_trace
 from go_avalanche_tpu.ops import inflight
 from go_avalanche_tpu.ops import voterecord as vr
 
@@ -95,6 +99,23 @@ class NodeStreamTelemetry(NamedTuple):
     resident_stake: jax.Array  # float32 — fraction of total registry
                                #   stake currently resident (the
                                #   committee's voting-power coverage)
+
+
+# The node-stream scheduler's trace-plane column manifest: the inner
+# round's counters plus the registry stats — `resident_stake` is the
+# repo's one FLOAT telemetry column (stored bitcast, obs/trace.py).
+TRACE_COLUMNS = obs_trace.columns_from_fields(
+    av.SimTelemetry._fields, ("departed", "resident_stake"),
+    floats=frozenset({"resident_stake"}))
+
+
+def with_trace(state: "NodeStreamState", cfg: AvalancheConfig,
+               n_rounds: int) -> "NodeStreamState":
+    """Attach the on-device trace plane (obs/trace.py) — the SCHEDULER
+    owns it (full `NodeStreamTelemetry` rows); no-op when
+    `cfg.trace_every == 0`."""
+    return state._replace(sim=state.sim._replace(
+        trace=obs_trace.alloc(cfg, n_rounds, TRACE_COLUMNS)))
 
 
 def _registry_byzantine(cfg: AvalancheConfig, r: int) -> jax.Array:
@@ -280,10 +301,7 @@ def step(
     """
     round_val = state.sim.round
     state, swapped = churn(state, cfg)
-    inner_cfg = (cfg if cfg.metrics_every == 0
-                 else dataclasses.replace(cfg, metrics_every=0))
-    new_sim, round_tel = av.round_step(state.sim, inner_cfg)
-    new_state = state._replace(sim=new_sim)
+    new_sim, round_tel = av.round_step(state.sim, suppress_taps(cfg))
     total = state.stake.sum()
     tel = NodeStreamTelemetry(
         round=round_tel,
@@ -292,7 +310,9 @@ def step(
                         / jnp.maximum(total, jnp.float32(1e-38))),
     )
     obs_sink.emit_round(cfg, round_val, tel)
-    return new_state, tel
+    new_sim = new_sim._replace(
+        trace=obs_trace.write_round(new_sim.trace, cfg, round_val, tel))
+    return state._replace(sim=new_sim), tel
 
 
 def run_scan(

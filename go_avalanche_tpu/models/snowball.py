@@ -29,6 +29,7 @@ from jax import lax
 
 from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG, VoteMode
 from go_avalanche_tpu.obs import sink as obs_sink
+from go_avalanche_tpu.obs import trace as obs_trace
 from go_avalanche_tpu.ops import adversary, inflight, voterecord as vr
 from go_avalanche_tpu.ops.sampling import sample_peers_uniform
 
@@ -49,6 +50,10 @@ class SnowballState(NamedTuple):
                                   # realized stochastic fault parameters
                                   # (draw_fault_params); present iff the
                                   # script schedules stochastic events
+    trace: Optional[obs_trace.TraceBuffer] = None
+                                  # on-device trace plane (obs/trace.py);
+                                  # attach with `with_trace` — None =
+                                  # statically absent
 
 
 class RoundTelemetry(NamedTuple):
@@ -67,6 +72,18 @@ class RoundTelemetry(NamedTuple):
     ring_occupancy: jax.Array  # int32 — entries in flight after the round
     partition_blocked: jax.Array  # int32 — this round's draws cut by the
                               # active partition
+
+
+# The snowball round's trace-plane column manifest (all int32).
+TRACE_COLUMNS = obs_trace.columns_from_fields(RoundTelemetry._fields)
+
+
+def with_trace(state: SnowballState, cfg: AvalancheConfig,
+               n_rounds: int) -> SnowballState:
+    """Attach the on-device trace plane (obs/trace.py) for an
+    `n_rounds`-horizon run; no-op when `cfg.trace_every == 0`."""
+    return state._replace(trace=obs_trace.alloc(cfg, n_rounds,
+                                                TRACE_COLUMNS))
 
 
 def init(
@@ -205,6 +222,8 @@ def round_step(
         key=k_next,
         inflight=ring,
         fault_params=state.fault_params,
+        trace=obs_trace.write_round(state.trace, cfg, state.round,
+                                    telemetry),
     )
     return new_state, telemetry
 

@@ -49,11 +49,16 @@ import jax.numpy as jnp
 from jax import lax
 
 from go_avalanche_tpu import traffic as tf
-from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
+from go_avalanche_tpu.config import (
+    AvalancheConfig,
+    DEFAULT_CONFIG,
+    suppress_taps,
+)
 from go_avalanche_tpu.models import avalanche as av
 from go_avalanche_tpu.models import dag as dag_model
 from go_avalanche_tpu.models.backlog import NO_TX
 from go_avalanche_tpu.obs import sink as obs_sink
+from go_avalanche_tpu.obs import trace as obs_trace
 from go_avalanche_tpu.ops import inflight
 from go_avalanche_tpu.ops import voterecord as vr
 
@@ -416,6 +421,26 @@ class StreamingDagTelemetry(NamedTuple):
                               #   JSONL schema) when arrivals are off
 
 
+def trace_columns(cfg: AvalancheConfig) -> tuple:
+    """The set-scheduler's trace-plane column manifest — the JSONL
+    flattening order of `StreamingDagTelemetry`."""
+    groups = [av.SimTelemetry._fields,
+              ("retired_sets", "occupied_sets", "backlog_left")]
+    if cfg.arrivals_enabled():
+        groups.append(tf.TrafficTelemetry._fields)
+    return obs_trace.columns_from_fields(*groups)
+
+
+def with_trace(state: StreamingDagState, cfg: AvalancheConfig,
+               n_rounds: int) -> StreamingDagState:
+    """Attach the on-device trace plane (obs/trace.py) — the SCHEDULER
+    owns it, the inner conflict round's write is suppressed (the
+    backlog scheduler's contract).  No-op when `cfg.trace_every == 0`."""
+    return state._replace(dag=dataclasses.replace(
+        state.dag, base=state.dag.base._replace(
+            trace=obs_trace.alloc(cfg, n_rounds, trace_columns(cfg)))))
+
+
 def step(
     state: StreamingDagState,
     cfg: AvalancheConfig = DEFAULT_CONFIG,
@@ -426,7 +451,8 @@ def step(
     With the in-graph metrics tap on the SCHEDULER emits the full
     `StreamingDagTelemetry` record and suppresses the inner round's own
     emission, so each round writes exactly one JSONL line
-    (docs/observability.md) — same contract as `models/backlog.step`.
+    (docs/observability.md) — same contract as `models/backlog.step`,
+    and the same for the on-device trace plane (`cfg.trace_every > 0`).
     """
     round_val = state.dag.base.round
     arrivals = jnp.int32(0)
@@ -437,9 +463,7 @@ def step(
             state.slot_set.shape[0])
         state = state._replace(traffic=new_traffic)
     state, retired = _retire_and_refill(state, cfg)
-    inner_cfg = (cfg if cfg.metrics_every == 0
-                 else dataclasses.replace(cfg, metrics_every=0))
-    new_dag, round_tel = dag_model.round_step(state.dag, inner_cfg)
+    new_dag, round_tel = dag_model.round_step(state.dag, suppress_taps(cfg))
     tel = StreamingDagTelemetry(
         round=round_tel,
         retired_sets=retired,
@@ -449,6 +473,9 @@ def step(
                  else tf.traffic_telemetry(state.traffic, arrivals)),
     )
     obs_sink.emit_round(cfg, round_val, tel)
+    new_dag = dataclasses.replace(new_dag, base=new_dag.base._replace(
+        trace=obs_trace.write_round(new_dag.base.trace, cfg, round_val,
+                                    tel)))
     return state._replace(dag=new_dag), tel
 
 

@@ -9,6 +9,13 @@ The observability layer (docs/observability.md):
   * `manifest` — run-manifest writer (config, jax/device topology,
                  hlo-pin hashes, git sha) emitted next to every metrics
                  file by `bench.py` and `run_sim.py`;
+  * `trace`    — the on-device trace plane (PR 11): a `TraceBuffer`
+                 ``[S, M]`` pytree carried in the sim state and written
+                 in-graph via one `dynamic_update_slice` per emitted
+                 round — the zero-callback tap that works under
+                 `shard_map` and under the fleet vmap (per-trial
+                 ``[F, S, M]`` traces), decoded to the same JSONL
+                 schema;
   * `tags`     — `tag_from_config`: the one metric-tag spelling shared
                  by bench, roofline and the sink;
   * `watchdog` — opt-in invariant checks (`run_sim --check-invariants`)
@@ -36,10 +43,17 @@ from go_avalanche_tpu.obs.recovery import (  # noqa: F401
     verify_recovery_fleet,
 )
 from go_avalanche_tpu.obs.tags import tag_from_config  # noqa: F401
+from go_avalanche_tpu.obs.trace import (  # noqa: F401
+    TraceBuffer,
+    fleet_trace_records,
+    trace_records,
+    write_trace,
+)
 from go_avalanche_tpu.obs.watchdog import (  # noqa: F401
     InvariantViolation,
     Watchdog,
     check_records,
     check_ring,
     check_ring_cut,
+    check_trace,
 )

@@ -89,9 +89,28 @@ def manifest_dict(cfg=None, extra: Optional[dict] = None) -> dict:
     }
     if cfg is not None:
         manifest["config"] = _config_dict(cfg)
+        manifest["tap"] = _tap_dict(cfg)
     if extra:
         manifest.update(extra)
     return manifest
+
+
+def _tap_dict(cfg) -> dict:
+    """Which telemetry tap(s) the run's config selected, with strides —
+    a trace file's consumer must know whether its rows came from the
+    io_callback tap or the on-device trace plane (obs/trace.py) and at
+    what stride, without re-deriving it from the config dump."""
+    metrics = getattr(cfg, "metrics_every", 0)
+    trace = getattr(cfg, "trace_every", 0)
+    if metrics > 0 and trace > 0:
+        kind = "callback+trace"
+    elif trace > 0:
+        kind = "trace"
+    elif metrics > 0:
+        kind = "callback"
+    else:
+        kind = "none"
+    return {"kind": kind, "metrics_every": metrics, "trace_every": trace}
 
 
 def _jaxlib_version() -> Optional[str]:

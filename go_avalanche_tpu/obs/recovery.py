@@ -56,6 +56,12 @@ class RecoveryViolation(AssertionError):
     """A recovery invariant of the fault script failed on the trace."""
 
 
+def jnp_ndim(x) -> int:
+    """ndim of a device or host array without importing jax eagerly at
+    module load (recovery is importable in stripped environments)."""
+    return len(getattr(x, "shape", ()))
+
+
 @dataclasses.dataclass
 class RecoveryReport:
     """Outcome of `verify_recovery`: the machine-checked verdict plus
@@ -345,6 +351,17 @@ def check_recovery(
     """
     if isinstance(trace, (str, Path)):
         trace = load_trace(trace)
+    elif hasattr(trace, "columns") and hasattr(trace, "stride"):
+        # An on-device TraceBuffer (obs/trace.py): decode directly —
+        # rows are ordered by construction (slot index == round //
+        # stride), so no unordered-io_callback re-sort is needed; a
+        # fleet-vmapped [F, S, M] buffer decodes to the fleet-stacked
+        # record format and takes the per-trial verdict path below.
+        from go_avalanche_tpu.obs import trace as trace_mod
+
+        trace = (trace_mod.fleet_trace_records(trace)
+                 if jnp_ndim(trace.data) == 3
+                 else trace_mod.trace_records(trace))
     if is_fleet_trace(trace):
         return verify_recovery_fleet(cfg, trace,
                                      occupancy_slack=occupancy_slack,

@@ -92,19 +92,27 @@ class MetricsSink:
             self.records_written += 1
 
     def write_stacked(self, telemetry, every: int = 1,
-                      start_round: int = 0) -> int:
+                      start_round: int = 0, round_stride: int = 1) -> int:
         """Stream a `run_scan`'s stacked telemetry pytree: one transfer
         (`jax.device_get` on the whole tree — see
         `utils.metrics.telemetry_summary`), then one line per `every`-th
-        round.  Returns the number of records written."""
+        round.  Returns the number of records written.
+
+        `round_stride` maps entry index -> round number (``round =
+        start_round + index * round_stride``): 1 (default) for per-round
+        stacks, the trace stride for a decoded trace-plane buffer whose
+        entries are already strided samples (`obs.trace.write_trace`).
+        """
         if every < 1:
             raise ValueError("every must be >= 1")
+        if round_stride < 1:
+            raise ValueError("round_stride must be >= 1")
         host = jax.device_get(telemetry)
         flat = _flatten_telemetry(host, {})
         n = int(next(iter(flat.values())).shape[0])
         wrote = 0
         for r in range(0, n, every):
-            self.write({"round": start_round + r,
+            self.write({"round": start_round + r * round_stride,
                         **{k: _scalar(np.asarray(v[r])) for k, v in
                            flat.items()}})
             wrote += 1

@@ -48,6 +48,10 @@ fixed order:
                                region per-cluster rate multipliers)
     ", metrics{N}"             cfg.metrics_every > 0 (the in-graph tap
                                changes the timed program)
+    ", trace{N}"               cfg.trace_every > 0 (the on-device
+                               trace plane changes the timed program —
+                               one dynamic_update_slice per emitted
+                               round; obs/trace.py)
 """
 
 from __future__ import annotations
@@ -106,4 +110,6 @@ def tag_from_config(cfg: AvalancheConfig) -> str:
             tag += ", arrival-skew"
     if cfg.metrics_every > 0:
         tag += f", metrics{cfg.metrics_every}"
+    if cfg.trace_every > 0:
+        tag += f", trace{cfg.trace_every}"
     return tag

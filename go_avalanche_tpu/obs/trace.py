@@ -1,0 +1,304 @@
+"""On-device trace plane: per-round telemetry as a memory write, not a
+host round-trip.
+
+The flight recorder's in-graph tap (`obs/sink.emit_round`, PR 5)
+streams telemetry through an `io_callback` — measured at ~15-25% CPU
+hot-loop cost, forbidden under `shard_map`, and forced off under the
+Monte-Carlo fleet vmap because a callback has no per-trial identity
+there.  This module is the tap whose cost is ONE `dynamic_update_slice`
+into a donated on-device buffer:
+
+  * `TraceBuffer` — a ``[S, M]`` int32 plane carried IN the sim state
+    (S = ceil(rounds / stride) slots, M = the flattened telemetry
+    column count) plus a write cursor.  The COLUMN MANIFEST (ordered
+    ``(name, kind)`` pairs, kind ``"i"``/``"f"``) and the stride ride
+    as static pytree aux data, so decode is schema-pinned: a write
+    whose telemetry does not match the manifest fails at trace time,
+    and the decoder can never mislabel a column.
+  * `write_round` — called by every dense round/scheduler step AFTER
+    its telemetry is assembled.  `cfg.trace_every == 0` (default) or a
+    ``None`` buffer returns before any tracing: the compiled program is
+    byte-identical to the pre-trace one (`hlo_pin --verify-off-path`).
+    Otherwise one `lax.cond`-gated `dynamic_update_slice` lands the
+    round's row at slot ``round // stride`` — no callback, no host
+    sync, legal under `shard_map` (the counters are psum-replicated,
+    so the plane stays replicated) and under `vmap` (the fleet lifts
+    it to ``[F, S, M]`` per-trial traces).
+  * decode — `trace_records` / `fleet_trace_records` rebuild the
+    existing JSONL record schema on the host (rows ORDERED by
+    construction — no unordered-io_callback re-sort), and
+    `write_trace` streams a buffer through the one JSONL writer
+    (`MetricsSink.write_stacked`), so trace-plane files and
+    callback-tap files are bit-identical on the same run
+    (tests/test_trace.py).
+
+Float columns (e.g. the node-stream `resident_stake` fraction) are
+stored BITCAST to int32 (`lax.bitcast_convert_type`) and bitcast back
+at decode — bit-exact round-trip, one buffer dtype.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from go_avalanche_tpu.config import AvalancheConfig
+
+Columns = Tuple[Tuple[str, str], ...]
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class TraceBuffer:
+    """The on-device trace plane; carried as a sim-state leaf.
+
+    `columns` / `stride` are STATIC pytree aux data (like
+    `DagSimState.n_sets`): two buffers with different manifests are
+    different pytree structures, so a decode can never read slot bytes
+    under the wrong schema.
+    """
+
+    data: jax.Array    # int32 [S, M] (fleet-vmapped: [F, S, M]);
+                       #   untouched slots stay zero (watchdog-checked)
+    cursor: jax.Array  # int32 — slots written so far; the next write
+                       #   lands at slot round // stride == cursor
+    columns: Columns   # static ordered (name, kind) manifest;
+                       #   kind "i" = int32, "f" = float32 (bitcast)
+    stride: int        # static = cfg.trace_every
+
+    def tree_flatten(self):
+        return (self.data, self.cursor), (self.columns, self.stride)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], *aux)
+
+
+def enabled(cfg: AvalancheConfig) -> bool:
+    """True when the trace plane is configured on."""
+    return getattr(cfg, "trace_every", 0) > 0
+
+
+def slots_for(n_rounds: int, stride: int) -> int:
+    """ceil(n_rounds / stride): rounds ``r`` in ``[0, n_rounds)`` with
+    ``r % stride == 0`` — exactly the slots a full run writes."""
+    return -(-int(n_rounds) // int(stride))
+
+
+def columns_from_fields(*field_groups: Sequence[str],
+                        floats: frozenset = frozenset()) -> Columns:
+    """Build a column manifest from ordered field-name groups (the
+    telemetry NamedTuples' `_fields`, concatenated in the same order
+    `sink._flatten_telemetry` flattens them).  Names in `floats` get
+    kind ``"f"`` (bitcast storage); everything else is an int32
+    counter."""
+    cols = []
+    for fields in field_groups:
+        for name in fields:
+            cols.append((name, "f" if name in floats else "i"))
+    return tuple(cols)
+
+
+def alloc(cfg: AvalancheConfig, n_rounds: int,
+          columns: Columns) -> Optional[TraceBuffer]:
+    """A fresh zeroed buffer for a `n_rounds`-horizon run; ``None``
+    (statically absent — every archived hlo pin byte-identical) when
+    `cfg.trace_every == 0`.
+
+    Rejects the inert ``rounds < stride`` combo (mirrored at the
+    `run_sim` parser): such a run would only ever sample round 0 while
+    its tag claims a strided trace.
+    """
+    if not enabled(cfg):
+        return None
+    stride = cfg.trace_every
+    if n_rounds < stride:
+        raise ValueError(
+            f"trace_every={stride} exceeds the run horizon "
+            f"({n_rounds} rounds): only round 0 would ever be sampled "
+            f"— lower the stride or lengthen the run")
+    s = slots_for(n_rounds, stride)
+    return TraceBuffer(
+        data=jnp.zeros((s, len(columns)), jnp.int32),
+        cursor=jnp.int32(0),
+        columns=tuple(columns),
+        stride=int(stride),
+    )
+
+
+def _flat_items(telemetry) -> List[Tuple[str, jax.Array]]:
+    """Ordered (leaf name, value) pairs — the one flattening shared
+    with the JSONL sink (`sink._flatten_telemetry`), so the trace
+    plane's column order IS the JSONL schema's field order."""
+    from go_avalanche_tpu.obs.sink import _flatten_telemetry
+
+    return list(_flatten_telemetry(telemetry, {}).items())
+
+
+def write_round(buf: Optional[TraceBuffer], cfg: AvalancheConfig,
+                round_, telemetry) -> Optional[TraceBuffer]:
+    """The in-graph trace tap (call from a round/scheduler step, AFTER
+    the round's telemetry is assembled).
+
+    Statically absent — returns before any tracing — when the buffer is
+    ``None`` or `cfg.trace_every == 0` (a scheduler suppresses its
+    inner round's write by passing a trace-zeroed inner cfg, exactly
+    like the metrics tap).  Otherwise encodes the flattened telemetry
+    row (floats bitcast to int32) and lands it at slot
+    ``round // stride`` under a round-mod `lax.cond`.  The column
+    manifest is CHECKED here: telemetry whose flattened fields drift
+    from the buffer's manifest fails at trace time, not at decode.
+    """
+    if buf is None or not enabled(cfg):
+        return buf
+    items = _flat_items(telemetry)
+    names = tuple(name for name, _ in items)
+    if names != tuple(name for name, _ in buf.columns):
+        raise ValueError(
+            f"trace column manifest mismatch: buffer carries "
+            f"{[n for n, _ in buf.columns]}, telemetry flattens to "
+            f"{list(names)} — allocate the buffer from the same "
+            f"telemetry schema the step emits")
+    vals = []
+    for (name, kind), (_, v) in zip(buf.columns, items):
+        v = jnp.asarray(v)
+        if kind == "f":
+            vals.append(lax.bitcast_convert_type(v.astype(jnp.float32),
+                                                 jnp.int32))
+        else:
+            if jnp.issubdtype(v.dtype, jnp.floating):
+                raise ValueError(
+                    f"trace column {name!r} is declared an int32 "
+                    f"counter but the telemetry leaf is "
+                    f"{v.dtype}-valued — declare it in the manifest's "
+                    f"float set or the decode would misread its bits")
+            vals.append(v.astype(jnp.int32))
+    row = jnp.stack(vals)                                   # [M]
+    stride = buf.stride
+    round_ = jnp.asarray(round_, jnp.int32)
+    slot = round_ // stride
+
+    def _write(b: TraceBuffer) -> TraceBuffer:
+        data = lax.dynamic_update_slice(b.data, row[None, :],
+                                        (slot, jnp.int32(0)))
+        return TraceBuffer(data, b.cursor + 1, b.columns, b.stride)
+
+    if stride == 1:
+        # Statically every round: no branch to trace (the round-mod
+        # predicate would be constant-true, but only the Python level
+        # knows that).
+        return _write(buf)
+    return lax.cond(jnp.mod(round_, stride) == 0, _write,
+                    lambda b: b, buf)
+
+
+def replicated_spec(buf: Optional[TraceBuffer]):
+    """The sharded drivers' PartitionSpec mirror of a buffer: the
+    counters are psum-replicated before the write, so the whole plane
+    replicates (`P()`) across every mesh axis — matching aux so the
+    spec tree and the value tree unflatten identically."""
+    if buf is None:
+        return None
+    from jax.sharding import PartitionSpec as P
+
+    return TraceBuffer(data=P(), cursor=P(), columns=buf.columns,
+                       stride=buf.stride)
+
+
+# ------------------------------------------------------------- decode
+
+
+def _decode_columns(data: np.ndarray, columns: Columns) -> Dict:
+    """int32 slot rows -> {name: numpy column} with float columns
+    bitcast back to float32 (exact round-trip)."""
+    out = {}
+    for j, (name, kind) in enumerate(columns):
+        col = np.ascontiguousarray(data[..., j])
+        out[name] = col.view(np.float32) if kind == "f" else col
+    return out
+
+
+def _host(buf: TraceBuffer) -> TraceBuffer:
+    data, cursor = jax.device_get((buf.data, buf.cursor))
+    return TraceBuffer(np.asarray(data), np.asarray(cursor),
+                       buf.columns, buf.stride)
+
+
+def stacked_telemetry(buf: TraceBuffer):
+    """Decode a single-sim buffer to a flat telemetry-shaped namedtuple
+    of host arrays (one entry per WRITTEN slot, in slot order) — the
+    pytree `MetricsSink.write_stacked` streams."""
+    host = _host(buf)
+    if host.data.ndim != 2:
+        raise ValueError(
+            f"stacked_telemetry decodes a single sim's [S, M] buffer; "
+            f"got a {host.data.shape} plane (fleet traces decode via "
+            f"fleet_trace_records)")
+    n = int(host.cursor)
+    cols = _decode_columns(host.data[:n], host.columns)
+    tel_cls = collections.namedtuple("TraceTelemetry",
+                                     [n_ for n_, _ in host.columns])
+    return tel_cls(**cols)
+
+
+def write_trace(sink, buf: TraceBuffer) -> int:
+    """Stream a decoded buffer to a `MetricsSink` through the one JSONL
+    writer (`write_stacked`): one line per written slot, stamped with
+    its true round (``slot * stride``).  Returns lines written."""
+    return sink.write_stacked(stacked_telemetry(buf),
+                              round_stride=buf.stride)
+
+
+def trace_records(buf: TraceBuffer) -> List[Dict]:
+    """A single-sim buffer as flight-recorder records (the JSONL dict
+    schema, ordered by round BY CONSTRUCTION) — directly consumable by
+    `obs.recovery.check_recovery`."""
+    host = _host(buf)
+    if host.data.ndim != 2:
+        raise ValueError(
+            f"trace_records decodes a single sim's [S, M] buffer; got "
+            f"a {host.data.shape} plane (fleet traces decode via "
+            f"fleet_trace_records)")
+    n = int(host.cursor)
+    cols = _decode_columns(host.data[:n], host.columns)
+    return [{"round": s * host.stride,
+             **{name: _py(col[s]) for name, col in cols.items()}}
+            for s in range(n)]
+
+
+def fleet_trace_records(buf: TraceBuffer) -> List[Dict]:
+    """A fleet-vmapped ``[F, S, M]`` buffer as FLEET-STACKED records:
+    one dict per round whose values are per-trial LISTS — the format
+    `obs.recovery.check_recovery` dispatches on (per-trial verdict
+    vectors) and the fleet `--metrics` JSONL spelling
+    (docs/observability.md)."""
+    host = _host(buf)
+    if host.data.ndim != 3:
+        raise ValueError(
+            f"fleet_trace_records decodes an [F, S, M] fleet buffer; "
+            f"got a {host.data.shape} plane (single-sim traces decode "
+            f"via trace_records)")
+    cursors = set(int(c) for c in np.asarray(host.cursor).reshape(-1))
+    if len(cursors) != 1:
+        raise ValueError(
+            f"fleet trials wrote different slot counts {sorted(cursors)} "
+            f"— one fleet runs one horizon, so a divergent cursor means "
+            f"a corrupted trace")
+    n = cursors.pop()
+    cols = _decode_columns(host.data[:, :n, :], host.columns)
+    return [{"round": s * host.stride,
+             **{name: [_py(col[f, s]) for f in range(col.shape[0])]
+                for name, col in cols.items()}}
+            for s in range(n)]
+
+
+def _py(v):
+    """JSON-ready python scalar (the sink's `_scalar` convention)."""
+    v = np.asarray(v)
+    return float(v) if np.issubdtype(v.dtype, np.floating) else int(v)

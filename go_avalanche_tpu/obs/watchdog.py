@@ -192,8 +192,39 @@ def check_ring_cut(ring, cfg: AvalancheConfig, round_: int,
                 f"{_offenders(bad)}")
 
 
+def check_trace(trace, cfg: AvalancheConfig, round_: int) -> None:
+    """Trace-plane consistency (obs/trace.py; None buffer passes):
+
+      * the write cursor equals the number of emitted slots after
+        ``round_`` completed rounds — ``ceil(round_ / stride)``, i.e.
+        slot index == round // stride for every write (a drifted
+        cursor means a slot was skipped or double-written);
+      * every slot at or beyond the cursor is still ZERO (untouched
+        slots must stay zero, or the decode would report rounds that
+        never ran).
+    """
+    if trace is None:
+        return
+    stride = trace.stride
+    cursor = int(jax.device_get(trace.cursor))
+    expected = -(-int(round_) // stride)       # ceil(round / stride)
+    if cursor != expected:
+        raise InvariantViolation(
+            f"trace cursor {cursor} != ceil(round / stride) = "
+            f"ceil({round_} / {stride}) = {expected}: the trace plane "
+            f"skipped or double-wrote a slot")
+    data = np.asarray(jax.device_get(trace.data))
+    if cursor < data.shape[0]:
+        bad = (data[cursor:] != 0).any(axis=-1)
+        if bad.any():
+            raise InvariantViolation(
+                f"trace slots beyond the cursor ({cursor}) are "
+                f"non-zero — untouched slots must stay zero: "
+                f"{_offenders(bad)}")
+
+
 def _resolve(state):
-    """(records, ring, t, round) from any model's state pytree."""
+    """(records, ring, t, round, trace) from any model's state pytree."""
     if hasattr(state, "dag"):                  # StreamingDagState
         state = state.dag
     if hasattr(state, "sim"):                  # BacklogSimState
@@ -203,7 +234,8 @@ def _resolve(state):
     records = state.records
     t = records.votes.shape[1] if records.votes.ndim == 2 else None
     return (records, getattr(state, "inflight", None), t,
-            getattr(state, "round", None))
+            getattr(state, "round", None),
+            getattr(state, "trace", None))
 
 
 class Watchdog:
@@ -226,12 +258,13 @@ class Watchdog:
     def check(self, state) -> int:
         """Run every invariant against `state`; returns the finalized
         count.  Raises `InvariantViolation` on the first failure."""
-        records, ring, t, round_ = _resolve(state)
+        records, ring, t, round_, trace = _resolve(state)
         finalized = check_records(records, self.cfg)
         check_ring(ring, self.cfg, t=t, tx_shards=self.tx_shards)
         if round_ is not None:
             check_ring_cut(ring, self.cfg, int(jax.device_get(round_)),
                            n_global=int(records.votes.shape[0]))
+            check_trace(trace, self.cfg, int(jax.device_get(round_)))
         if (self.monotonic and self._prev_finalized is not None
                 and finalized < self._prev_finalized):
             raise InvariantViolation(

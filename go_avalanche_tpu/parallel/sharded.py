@@ -51,6 +51,7 @@ from go_avalanche_tpu.models.avalanche import (
     popcnt_plane,
     stamp_finality,
 )
+from go_avalanche_tpu.obs import trace as obs_trace
 from go_avalanche_tpu.ops import adversary, exchange, inflight
 from go_avalanche_tpu.ops import voterecord as vr
 from go_avalanche_tpu.ops.bitops import pack_bool_plane, unpack_bool_plane
@@ -60,7 +61,8 @@ from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS, shard_map
 
 def state_specs(track_finality: bool = True,
                 with_inflight: bool = False,
-                with_fault_params: bool = False) -> AvalancheSimState:
+                with_fault_params: bool = False,
+                trace_spec=None) -> AvalancheSimState:
     """PartitionSpecs for every leaf of `AvalancheSimState`.
 
     `track_finality=False` mirrors a state whose `finalized_at` leaf is
@@ -72,7 +74,11 @@ def state_specs(track_finality: bool = True,
     `with_fault_params=True` mirrors a state carrying realized
     stochastic fault parameters (`inflight.FaultParams`) — tiny
     per-event scalars, replicated everywhere so every shard sees the
-    SAME realized schedule the dense init drew.
+    SAME realized schedule the dense init drew.  `trace_spec` mirrors a
+    state carrying the on-device trace plane (obs/trace.py): pass
+    `obs.trace.replicated_spec(state.trace)` — the counters are psum'd
+    before the write, so the plane replicates (same static column/
+    stride aux as the value tree, or unflattening fails loudly).
     """
     inflight_specs = None
     if with_inflight:
@@ -106,6 +112,7 @@ def state_specs(track_finality: bool = True,
         key=P(),
         inflight=inflight_specs,
         fault_params=fault_specs,
+        trace=trace_spec,
     )
 
 
@@ -127,7 +134,8 @@ def shard_state(state: AvalancheSimState, mesh) -> AvalancheSimState:
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
         state, state_specs(state.finalized_at is not None,
                            state.inflight is not None,
-                           state.fault_params is not None))
+                           state.fault_params is not None,
+                           obs_trace.replicated_spec(state.trace)))
 
 
 def _global_minority_plane(prefs_local: jax.Array,
@@ -447,6 +455,12 @@ def _local_round(
         key=k_next,
         inflight=ring,
         fault_params=state.fault_params,
+        # Trace plane (obs/trace.py): the row is assembled from the
+        # psum'd counters above — identical on every shard, so the
+        # replicated [S, M] buffer stays replicated and decodes to the
+        # same rows the dense formula would produce for this trajectory.
+        trace=obs_trace.write_round(state.trace, cfg, state.round,
+                                    telemetry),
     )
     return new_state, telemetry
 
@@ -460,8 +474,10 @@ def _donate(donate: bool) -> tuple:
 
 def _shard_mapped(mesh, fn, track_finality: bool = True,
                   with_inflight: bool = False,
-                  with_fault_params: bool = False):
-    specs = state_specs(track_finality, with_inflight, with_fault_params)
+                  with_fault_params: bool = False,
+                  trace_spec=None):
+    specs = state_specs(track_finality, with_inflight, with_fault_params,
+                        trace_spec)
     tel_specs = SimTelemetry(*([P()] * len(SimTelemetry._fields)))
     return shard_map(fn, mesh=mesh, in_specs=(specs,),
                      out_specs=(specs, tel_specs), check_vma=False)
@@ -483,14 +499,17 @@ def make_sharded_round_step(mesh, cfg: AvalancheConfig = DEFAULT_CONFIG,
         track = state.finalized_at is not None
         asyncq = state.inflight is not None
         fparams = state.fault_params is not None
-        if (n_global, track, asyncq, fparams) not in cache:
-            cache[(n_global, track, asyncq, fparams)] = jax.jit(
+        traced = state.trace is not None
+        key = (n_global, track, asyncq, fparams, traced)
+        if key not in cache:
+            cache[key] = jax.jit(
                 _shard_mapped(
                     mesh, lambda s: _local_round(s, cfg, n_global, n_tx),
                     track_finality=track, with_inflight=asyncq,
-                    with_fault_params=fparams),
+                    with_fault_params=fparams,
+                    trace_spec=obs_trace.replicated_spec(state.trace)),
                 donate_argnums=_donate(donate))
-        return cache[(n_global, track, asyncq, fparams)](state)
+        return cache[key](state)
 
     return step
 
@@ -516,7 +535,8 @@ def run_scan_sharded(
         mesh, local_scan,
         track_finality=state.finalized_at is not None,
         with_inflight=state.inflight is not None,
-        with_fault_params=state.fault_params is not None),
+        with_fault_params=state.fault_params is not None,
+        trace_spec=obs_trace.replicated_spec(state.trace)),
         donate_argnums=_donate(donate))(state)
 
 
@@ -557,7 +577,8 @@ def run_sharded(
 
     specs = state_specs(state.finalized_at is not None,
                         state.inflight is not None,
-                        state.fault_params is not None)
+                        state.fault_params is not None,
+                        obs_trace.replicated_spec(state.trace))
     fn = shard_map(local_run, mesh=mesh, in_specs=(specs,),
                    out_specs=specs, check_vma=False)
     return jax.jit(fn, donate_argnums=_donate(donate))(state)

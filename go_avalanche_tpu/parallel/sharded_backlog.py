@@ -36,7 +36,11 @@ from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from go_avalanche_tpu import traffic as tf
-from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
+from go_avalanche_tpu.config import (
+    AvalancheConfig,
+    DEFAULT_CONFIG,
+    suppress_taps,
+)
 from go_avalanche_tpu.models import avalanche as av
 from go_avalanche_tpu.models.backlog import (
     NO_TX,
@@ -45,6 +49,7 @@ from go_avalanche_tpu.models.backlog import (
     BacklogSimState,
     BacklogTelemetry,
 )
+from go_avalanche_tpu.obs import trace as obs_trace
 from go_avalanche_tpu.ops import inflight
 from go_avalanche_tpu.ops import voterecord as vr
 from go_avalanche_tpu.parallel import sharded
@@ -63,11 +68,14 @@ def _traffic_specs(with_traffic: bool):
 def backlog_state_specs(track_finality: bool = True,
                         with_inflight: bool = False,
                         with_fault_params: bool = False,
-                        with_traffic: bool = False) -> BacklogSimState:
-    """PartitionSpecs for every leaf of `BacklogSimState`."""
+                        with_traffic: bool = False,
+                        trace_spec=None) -> BacklogSimState:
+    """PartitionSpecs for every leaf of `BacklogSimState`;
+    `trace_spec` mirrors the scheduler-owned trace plane (replicated —
+    `obs.trace.replicated_spec`)."""
     return BacklogSimState(
         sim=sharded.state_specs(track_finality, with_inflight,
-                                with_fault_params),
+                                with_fault_params, trace_spec),
         slot_tx=P(TXS_AXIS),
         slot_admit_round=P(TXS_AXIS),
         backlog=Backlog(score=P(), init_pref=P(), valid=P()),
@@ -89,7 +97,9 @@ def shard_backlog_state(state: BacklogSimState, mesh) -> BacklogSimState:
         state, backlog_state_specs(state.sim.finalized_at is not None,
                                    state.sim.inflight is not None,
                                    state.sim.fault_params is not None,
-                                   state.traffic is not None))
+                                   state.traffic is not None,
+                                   obs_trace.replicated_spec(
+                                       state.sim.trace)))
 
 
 def _merge_write(old, idx, value, b):
@@ -248,6 +258,7 @@ def _local_step(
     n_global: int,
     n_tx_shards: int,
 ) -> Tuple[BacklogSimState, BacklogTelemetry]:
+    round_val = state.sim.round
     arrivals = jnp.int32(0)
     if state.traffic is not None:
         # The draw is on replicated state with the GLOBAL occupancy
@@ -261,8 +272,11 @@ def _local_step(
                                           w_local * n_tx_shards)
         state = state._replace(traffic=new_traffic)
     state, retired = _local_retire_and_refill(state, cfg)
-    new_sim, round_tel = sharded._local_round(state.sim, cfg, n_global,
-                                              n_tx_shards)
+    # The scheduler owns the trace plane (models/backlog contract): the
+    # inner round runs trace-suppressed, the full scheduler record is
+    # written below from psum'd (replicated) counters.
+    new_sim, round_tel = sharded._local_round(state.sim, suppress_taps(cfg),
+                                              n_global, n_tx_shards)
     occupied = lax.psum((state.slot_tx != NO_TX).sum().astype(jnp.int32),
                         TXS_AXIS)
     tel = BacklogTelemetry(
@@ -273,15 +287,19 @@ def _local_step(
         traffic=(None if state.traffic is None
                  else tf.traffic_telemetry(state.traffic, arrivals)),
     )
+    new_sim = new_sim._replace(
+        trace=obs_trace.write_round(new_sim.trace, cfg, round_val, tel))
     return state._replace(sim=new_sim), tel
 
 
 def _shard_mapped(mesh, fn, with_tel=True, track_finality: bool = True,
                   with_inflight: bool = False,
                   with_fault_params: bool = False,
-                  with_traffic: bool = False):
+                  with_traffic: bool = False,
+                  trace_spec=None):
     specs = backlog_state_specs(track_finality, with_inflight,
-                                with_fault_params, with_traffic)
+                                with_fault_params, with_traffic,
+                                trace_spec)
     if with_tel:
         tel_specs = BacklogTelemetry(
             round=av.SimTelemetry(
@@ -310,13 +328,16 @@ def make_sharded_backlog_step(mesh, cfg: AvalancheConfig = DEFAULT_CONFIG,
         asyncq = state.sim.inflight is not None
         fparams = state.sim.fault_params is not None
         arriv = state.traffic is not None
-        key = (n_global, track, asyncq, fparams, arriv)
+        traced = state.sim.trace is not None
+        key = (n_global, track, asyncq, fparams, arriv, traced)
         if key not in cache:
             cache[key] = jax.jit(
                 _shard_mapped(
                     mesh, lambda s: _local_step(s, cfg, n_global, n_tx),
                     track_finality=track, with_inflight=asyncq,
-                    with_fault_params=fparams, with_traffic=arriv),
+                    with_fault_params=fparams, with_traffic=arriv,
+                    trace_spec=obs_trace.replicated_spec(
+                        state.sim.trace)),
                 donate_argnums=sharded._donate(donate))
         return cache[key](state)
 
@@ -345,7 +366,8 @@ def run_scan_sharded_backlog(
         track_finality=state.sim.finalized_at is not None,
         with_inflight=state.sim.inflight is not None,
         with_fault_params=state.sim.fault_params is not None,
-        with_traffic=state.traffic is not None),
+        with_traffic=state.traffic is not None,
+        trace_spec=obs_trace.replicated_spec(state.sim.trace)),
         donate_argnums=sharded._donate(donate))(state)
 
 
@@ -390,5 +412,6 @@ def run_sharded_backlog(
         track_finality=state.sim.finalized_at is not None,
         with_inflight=state.sim.inflight is not None,
         with_fault_params=state.sim.fault_params is not None,
-        with_traffic=state.traffic is not None),
+        with_traffic=state.traffic is not None,
+        trace_spec=obs_trace.replicated_spec(state.sim.trace)),
         donate_argnums=sharded._donate(donate))(state)

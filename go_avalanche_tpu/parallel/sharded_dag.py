@@ -40,6 +40,7 @@ from go_avalanche_tpu.config import (
 from go_avalanche_tpu.models import avalanche as av
 from go_avalanche_tpu.models import dag as dag_model
 from go_avalanche_tpu.models.dag import DagSimState
+from go_avalanche_tpu.obs import trace as obs_trace
 from go_avalanche_tpu.ops import adversary, exchange, inflight
 from go_avalanche_tpu.ops import voterecord as vr
 from go_avalanche_tpu.ops.bitops import pack_bool_plane
@@ -52,7 +53,8 @@ def dag_state_specs(n_sets: int,
                     set_size: Optional[int] = None,
                     track_finality: bool = True,
                     with_inflight: bool = False,
-                    with_fault_params: bool = False) -> DagSimState:
+                    with_fault_params: bool = False,
+                    trace_spec=None) -> DagSimState:
     """PartitionSpecs for every leaf of `DagSimState`.
 
     `n_sets` and `set_size` ride along as the pytree's static aux data so
@@ -61,11 +63,13 @@ def dag_state_specs(n_sets: int,
     is None (`models/avalanche.init`); `with_inflight=True` adds the
     async-query ring specs (`sharded.state_specs`);
     `with_fault_params=True` mirrors realized stochastic fault
-    parameters (replicated scalars).
+    parameters (replicated scalars); `trace_spec` mirrors the on-device
+    trace plane (replicated — `obs.trace.replicated_spec`).
     """
     return DagSimState(base=sharded.state_specs(track_finality,
                                                 with_inflight,
-                                                with_fault_params),
+                                                with_fault_params,
+                                                trace_spec),
                        conflict_set=P(TXS_AXIS), n_sets=n_sets,
                        set_size=set_size)
 
@@ -101,7 +105,9 @@ def shard_dag_state(state: DagSimState, mesh) -> DagSimState:
         state, dag_state_specs(state.n_sets, state.set_size,
                                state.base.finalized_at is not None,
                                state.base.inflight is not None,
-                               state.base.fault_params is not None))
+                               state.base.fault_params is not None,
+                               obs_trace.replicated_spec(
+                                   state.base.trace)))
 
 
 def _local_sets(conflict_set_local: jax.Array) -> jax.Array:
@@ -275,7 +281,11 @@ def _local_round(
         poll_order_inv=base.poll_order_inv, byzantine=base.byzantine,
         alive=alive, latency_weight=base.latency_weight,
         finalized_at=finalized_at, round=base.round + 1, key=k_next,
-        inflight=ring, fault_params=base.fault_params)
+        inflight=ring, fault_params=base.fault_params,
+        # Replicated trace plane: the row comes from the psum'd
+        # counters above, identical on every shard (obs/trace.py).
+        trace=obs_trace.write_round(base.trace, cfg, base.round,
+                                    telemetry))
     return DagSimState(new_base, state.conflict_set, state.n_sets,
                        state.set_size), telemetry
 
@@ -284,9 +294,10 @@ def _shard_mapped(mesh, n_sets: int, fn, tel: bool = True,
                   set_size: Optional[int] = None,
                   track_finality: bool = True,
                   with_inflight: bool = False,
-                  with_fault_params: bool = False):
+                  with_fault_params: bool = False,
+                  trace_spec=None):
     specs = dag_state_specs(n_sets, set_size, track_finality,
-                            with_inflight, with_fault_params)
+                            with_inflight, with_fault_params, trace_spec)
     if tel:
         tel_specs = av.SimTelemetry(*([P()] * len(av.SimTelemetry._fields)))
         out_specs = (specs, tel_specs)
@@ -309,14 +320,16 @@ def make_sharded_dag_round_step(mesh, cfg: AvalancheConfig = DEFAULT_CONFIG,
         key = (state.base.records.votes.shape[0], state.n_sets,
                state.set_size, state.base.finalized_at is not None,
                state.base.inflight is not None,
-               state.base.fault_params is not None)
+               state.base.fault_params is not None,
+               state.base.trace is not None)
         if key not in cache:
             n_global = key[0]
             cache[key] = jax.jit(_shard_mapped(
                 mesh, state.n_sets,
                 lambda s: _local_round(s, cfg, n_global, n_tx),
                 set_size=state.set_size, track_finality=key[3],
-                with_inflight=key[4], with_fault_params=key[5]),
+                with_inflight=key[4], with_fault_params=key[5],
+                trace_spec=obs_trace.replicated_spec(state.base.trace)),
                 donate_argnums=sharded._donate(donate))
         return cache[key](state)
 
@@ -374,5 +387,7 @@ def run_sharded_dag(
                        track_finality=state.base.finalized_at is not None,
                        with_inflight=state.base.inflight is not None,
                        with_fault_params=(state.base.fault_params
-                                          is not None))
+                                          is not None),
+                       trace_spec=obs_trace.replicated_spec(
+                           state.base.trace))
     return jax.jit(fn, donate_argnums=sharded._donate(donate))(state)

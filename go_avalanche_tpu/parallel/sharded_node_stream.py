@@ -29,7 +29,11 @@ from jax import lax
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
-from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
+from go_avalanche_tpu.config import (
+    AvalancheConfig,
+    DEFAULT_CONFIG,
+    suppress_taps,
+)
 from go_avalanche_tpu.models import avalanche as av
 from go_avalanche_tpu.models import node_stream as ns_model
 from go_avalanche_tpu.models.node_stream import (
@@ -37,6 +41,7 @@ from go_avalanche_tpu.models.node_stream import (
     NodeStreamTelemetry,
     _registry_byzantine,
 )
+from go_avalanche_tpu.obs import trace as obs_trace
 from go_avalanche_tpu.ops import inflight
 from go_avalanche_tpu.ops import voterecord as vr
 from go_avalanche_tpu.parallel import sharded
@@ -45,12 +50,15 @@ from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS, shard_map
 
 def node_stream_state_specs(track_finality: bool = True,
                             with_inflight: bool = False,
-                            with_fault_params: bool = False
+                            with_fault_params: bool = False,
+                            trace_spec=None,
                             ) -> NodeStreamState:
-    """PartitionSpecs for every leaf of `NodeStreamState`."""
+    """PartitionSpecs for every leaf of `NodeStreamState`;
+    `trace_spec` mirrors the scheduler-owned trace plane (replicated —
+    `obs.trace.replicated_spec`)."""
     return NodeStreamState(
         sim=sharded.state_specs(track_finality, with_inflight,
-                                with_fault_params),
+                                with_fault_params, trace_spec),
         slot_node=P(),      # replicated [W]: every shard needs the full
         resident=P(),       #   hosting map / residency for the churn
         stake=P(),          #   draw (registry metadata, ~MBs at 1M)
@@ -73,7 +81,9 @@ def shard_node_stream_state(state: NodeStreamState,
         state,
         node_stream_state_specs(state.sim.finalized_at is not None,
                                 state.sim.inflight is not None,
-                                state.sim.fault_params is not None))
+                                state.sim.fault_params is not None,
+                                obs_trace.replicated_spec(
+                                    state.sim.trace)))
 
 
 def _local_churn(state: NodeStreamState,
@@ -143,9 +153,14 @@ def _local_step(
     n_global: int,
     n_tx_shards: int,
 ) -> Tuple[NodeStreamState, NodeStreamTelemetry]:
+    round_val = state.sim.round
     state, swapped = _local_churn(state, cfg)
-    new_sim, round_tel = sharded._local_round(state.sim, cfg, n_global,
-                                              n_tx_shards)
+    # Scheduler-owned trace plane (models/node_stream contract): the
+    # inner round runs trace-suppressed; the scheduler record (psum'd
+    # counters + replicated registry stats) is written below.
+    new_sim, round_tel = sharded._local_round(state.sim,
+                                              suppress_taps(cfg),
+                                              n_global, n_tx_shards)
     total = state.stake.sum()
     tel = NodeStreamTelemetry(
         round=round_tel,
@@ -153,14 +168,17 @@ def _local_step(
         resident_stake=(jnp.where(state.resident, state.stake, 0.0).sum()
                         / jnp.maximum(total, jnp.float32(1e-38))),
     )
+    new_sim = new_sim._replace(
+        trace=obs_trace.write_round(new_sim.trace, cfg, round_val, tel))
     return state._replace(sim=new_sim), tel
 
 
 def _shard_mapped(mesh, fn, with_tel=True, track_finality: bool = True,
                   with_inflight: bool = False,
-                  with_fault_params: bool = False):
+                  with_fault_params: bool = False,
+                  trace_spec=None):
     specs = node_stream_state_specs(track_finality, with_inflight,
-                                    with_fault_params)
+                                    with_fault_params, trace_spec)
     if with_tel:
         tel_specs = NodeStreamTelemetry(
             round=av.SimTelemetry(
@@ -195,5 +213,6 @@ def run_scan_sharded_node_stream(
         mesh, local_scan,
         track_finality=state.sim.finalized_at is not None,
         with_inflight=state.sim.inflight is not None,
-        with_fault_params=state.sim.fault_params is not None),
+        with_fault_params=state.sim.fault_params is not None,
+        trace_spec=obs_trace.replicated_spec(state.sim.trace)),
         donate_argnums=sharded._donate(donate))(state)

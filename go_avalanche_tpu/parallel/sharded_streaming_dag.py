@@ -43,7 +43,11 @@ from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from go_avalanche_tpu import traffic as tf
-from go_avalanche_tpu.config import AvalancheConfig, DEFAULT_CONFIG
+from go_avalanche_tpu.config import (
+    AvalancheConfig,
+    DEFAULT_CONFIG,
+    suppress_taps,
+)
 from go_avalanche_tpu.models import avalanche as av
 from go_avalanche_tpu.models import dag as dag_model
 from go_avalanche_tpu.models.streaming_dag import (
@@ -53,6 +57,7 @@ from go_avalanche_tpu.models.streaming_dag import (
     StreamingDagState,
     StreamingDagTelemetry,
 )
+from go_avalanche_tpu.obs import trace as obs_trace
 from go_avalanche_tpu.ops import inflight
 from go_avalanche_tpu.ops import voterecord as vr
 from go_avalanche_tpu.parallel import sharded, sharded_dag
@@ -66,11 +71,15 @@ def streaming_dag_state_specs(n_sets: int,
                               with_inflight: bool = False,
                               with_fault_params: bool = False,
                               with_traffic: bool = False,
+                              trace_spec=None,
                               ) -> StreamingDagState:
-    """PartitionSpecs for every leaf of `StreamingDagState`."""
+    """PartitionSpecs for every leaf of `StreamingDagState`;
+    `trace_spec` mirrors the scheduler-owned trace plane (replicated —
+    `obs.trace.replicated_spec`)."""
     return StreamingDagState(
         dag=sharded_dag.dag_state_specs(n_sets, set_size, track_finality,
-                                        with_inflight, with_fault_params),
+                                        with_inflight, with_fault_params,
+                                        trace_spec),
         slot_set=P(TXS_AXIS),
         slot_admit_round=P(TXS_AXIS),
         backlog=SetBacklog(score=P(), init_pref=P(), valid=P()),
@@ -109,7 +118,8 @@ def shard_streaming_dag_state(state: StreamingDagState,
             state.dag.base.finalized_at is not None,
             state.dag.base.inflight is not None,
             state.dag.base.fault_params is not None,
-            state.traffic is not None))
+            state.traffic is not None,
+            obs_trace.replicated_spec(state.dag.base.trace)))
 
 
 def _merge_rows(old, row_idx, rows, s_b):
@@ -355,6 +365,7 @@ def _local_step(
     n_global: int,
     n_tx_shards: int,
 ) -> Tuple[StreamingDagState, StreamingDagTelemetry]:
+    round_val = state.dag.base.round
     arrivals = jnp.int32(0)
     if state.traffic is not None:
         # Replicated draw with the GLOBAL set-slot occupancy — every
@@ -367,8 +378,12 @@ def _local_step(
                                           s_w_local * n_tx_shards)
         state = state._replace(traffic=new_traffic)
     state, retired = _local_retire_and_refill(state, cfg, c)
-    new_dag, round_tel = sharded_dag._local_round(state.dag, cfg, n_global,
-                                                  n_tx_shards)
+    # Scheduler-owned trace plane (models/streaming_dag contract): the
+    # inner conflict round runs trace-suppressed; the full scheduler
+    # record is written below from psum'd (replicated) counters.
+    new_dag, round_tel = sharded_dag._local_round(state.dag,
+                                                  suppress_taps(cfg),
+                                                  n_global, n_tx_shards)
     occupied = lax.psum((state.slot_set != NO_SET).sum().astype(jnp.int32),
                         TXS_AXIS)
     tel = StreamingDagTelemetry(
@@ -379,6 +394,9 @@ def _local_step(
         traffic=(None if state.traffic is None
                  else tf.traffic_telemetry(state.traffic, arrivals)),
     )
+    new_dag = dataclasses.replace(new_dag, base=new_dag.base._replace(
+        trace=obs_trace.write_round(new_dag.base.trace, cfg, round_val,
+                                    tel)))
     return state._replace(dag=new_dag), tel
 
 
@@ -386,10 +404,11 @@ def _shard_mapped(mesh, n_sets: int, fn, with_tel=True, set_size=None,
                   track_finality: bool = True,
                   with_inflight: bool = False,
                   with_fault_params: bool = False,
-                  with_traffic: bool = False):
+                  with_traffic: bool = False,
+                  trace_spec=None):
     specs = streaming_dag_state_specs(n_sets, set_size, track_finality,
                                       with_inflight, with_fault_params,
-                                      with_traffic)
+                                      with_traffic, trace_spec)
     if with_tel:
         tel_specs = StreamingDagTelemetry(
             round=av.SimTelemetry(*([P()] * len(av.SimTelemetry._fields))),
@@ -419,7 +438,8 @@ def make_sharded_streaming_dag_step(mesh,
                state.dag.base.finalized_at is not None,
                state.dag.base.inflight is not None,
                state.dag.base.fault_params is not None,
-               state.traffic is not None)
+               state.traffic is not None,
+               state.dag.base.trace is not None)
         if key not in cache:
             n_global = key[0]
             cache[key] = jax.jit(_shard_mapped(
@@ -427,7 +447,9 @@ def make_sharded_streaming_dag_step(mesh,
                 lambda s: _local_step(s, cfg, c, n_global, n_tx),
                 set_size=state.dag.set_size, track_finality=key[4],
                 with_inflight=key[5], with_fault_params=key[6],
-                with_traffic=key[7]),
+                with_traffic=key[7],
+                trace_spec=obs_trace.replicated_spec(
+                    state.dag.base.trace)),
                 donate_argnums=sharded._donate(donate))
         return cache[key](state)
 
@@ -478,7 +500,9 @@ def run_sharded_streaming_dag(
                        with_inflight=state.dag.base.inflight is not None,
                        with_fault_params=(state.dag.base.fault_params
                                           is not None),
-                       with_traffic=state.traffic is not None)
+                       with_traffic=state.traffic is not None,
+                       trace_spec=obs_trace.replicated_spec(
+                           state.dag.base.trace))
     return jax.jit(fn, donate_argnums=sharded._donate(donate))(state)
 
 
@@ -505,5 +529,6 @@ def run_scan_sharded_streaming_dag(
         track_finality=state.dag.base.finalized_at is not None,
         with_inflight=state.dag.base.inflight is not None,
         with_fault_params=state.dag.base.fault_params is not None,
-        with_traffic=state.traffic is not None),
+        with_traffic=state.traffic is not None,
+        trace_spec=obs_trace.replicated_spec(state.dag.base.trace)),
         donate_argnums=sharded._donate(donate))(state)

@@ -142,6 +142,7 @@ def build_config(args: argparse.Namespace) -> AvalancheConfig:
         inflight_engine=getattr(args, "inflight_engine", "walk"),
         metrics_every=(getattr(args, "metrics_every", 0)
                        if getattr(args, "metrics", None) else 0),
+        trace_every=getattr(args, "trace_every", 0),
     )
 
 
@@ -176,6 +177,7 @@ def run_snowball(args, cfg: AvalancheConfig) -> Dict:
 
     state = sb.init(jax.random.key(args.seed), args.nodes, cfg,
                     yes_fraction=args.yes_fraction)
+    state = sb.with_trace(state, cfg, args.max_rounds)
     out = {}
     if args.check_invariants:
         def settled(s, cfg):
@@ -187,6 +189,7 @@ def run_snowball(args, cfg: AvalancheConfig) -> Dict:
     else:
         state = jax.jit(sb.run, static_argnames=("cfg", "max_rounds"))(
             state, cfg, args.max_rounds)
+    out.update(_emit_trace(args, cfg, state.trace))
     fin = np.asarray(jax.device_get(
         vr.has_finalized(state.records.confidence, cfg)))
     pref = np.asarray(jax.device_get(
@@ -217,6 +220,45 @@ def _maybe_restore(path, state):
     return state
 
 
+def _emit_trace(args, cfg: AvalancheConfig, buf, fleet: bool = False
+                ) -> Dict:
+    """Decode a finished run's trace plane (obs/trace.py) and stream it
+    to its sink: `--trace-out` when given (its own file + manifest),
+    else the active `--metrics` sink.  Fleet buffers decode to the
+    fleet-stacked record format (per-trial lists).  Returns the result
+    keys to merge ({} when the run carried no trace)."""
+    if buf is None:
+        return {}
+    from go_avalanche_tpu import obs
+    from go_avalanche_tpu.obs import trace as obs_trace
+    from go_avalanche_tpu.obs.sink import active_sink
+
+    def _write(sink) -> int:
+        if fleet:
+            wrote = 0
+            for rec in obs_trace.fleet_trace_records(buf):
+                sink.write(rec)
+                wrote += 1
+            return wrote
+        return obs_trace.write_trace(sink, buf)
+
+    if args.trace_out:
+        with obs.metrics_sink(args.trace_out,
+                              tag=obs.tag_from_config(cfg)) as sink:
+            wrote = _write(sink)
+        obs.write_manifest(args.trace_out, cfg, extra={
+            "model": args.model,
+            "workload": {"nodes": args.nodes, "txs": args.txs,
+                         "max_rounds": args.max_rounds,
+                         "seed": args.seed},
+        })
+        return {"trace_records": wrote, "trace_file": args.trace_out}
+    sink = active_sink()
+    if sink is None:
+        return {}
+    return {"trace_records": _write(sink)}
+
+
 def run_avalanche(args, cfg: AvalancheConfig) -> Dict:
     from go_avalanche_tpu.models import avalanche as av
     from go_avalanche_tpu.ops import voterecord as vr
@@ -225,6 +267,7 @@ def run_avalanche(args, cfg: AvalancheConfig) -> Dict:
                  if args.contested else None)
     state = av.init(jax.random.key(args.seed), args.nodes, args.txs, cfg,
                     init_pref=init_pref)
+    state = av.with_trace(state, cfg, args.max_rounds)
     extra = {}
     if args.mesh:
         from go_avalanche_tpu.parallel import sharded
@@ -241,6 +284,7 @@ def run_avalanche(args, cfg: AvalancheConfig) -> Dict:
         # av.run jits itself (static cfg/max_rounds); donate frees the
         # double-buffered [N, T] planes — the init state is not reused.
         state = av.run(state, cfg, args.max_rounds, donate=True)
+    extra.update(_emit_trace(args, cfg, state.trace))
     fin = np.asarray(jax.device_get(
         vr.has_finalized(state.records.confidence, cfg)))
     out = {
@@ -259,6 +303,7 @@ def run_dag(args, cfg: AvalancheConfig) -> Dict:
 
     conflict_set = jnp.arange(args.txs, dtype=jnp.int32) // args.conflict_size
     state = dag.init(jax.random.key(args.seed), args.nodes, conflict_set, cfg)
+    state = dag.with_trace(state, cfg, args.max_rounds)
     extra = {}
     if args.mesh:
         from go_avalanche_tpu.parallel import sharded_dag
@@ -276,6 +321,7 @@ def run_dag(args, cfg: AvalancheConfig) -> Dict:
             state, cfg, args.max_rounds)
     from go_avalanche_tpu.ops import voterecord as vr
 
+    extra.update(_emit_trace(args, cfg, state.base.trace))
     conf = state.base.records.confidence
     fin_acc = np.asarray(jax.device_get(
         vr.has_finalized(conf, cfg) & vr.is_accepted(conf)))
@@ -345,6 +391,7 @@ def run_streaming_dag(args, cfg: AvalancheConfig) -> Dict:
         jnp.arange(args.txs, dtype=jnp.int32).reshape(n_sets, c))
     state = sdg.init(jax.random.key(args.seed), args.nodes, args.slots,
                      backlog, cfg)
+    state = sdg.with_trace(state, cfg, args.max_rounds)
     if args.mesh:
         from go_avalanche_tpu.parallel import sharded_streaming_dag as ssd
 
@@ -383,6 +430,7 @@ def run_streaming_dag(args, cfg: AvalancheConfig) -> Dict:
         "conflict_sets": n_sets,
         **sdg.resolution_summary(final),
         **tf.latency_percentiles(final.traffic),
+        **_emit_trace(args, cfg, final.dag.base.trace),
     }
     return out
 
@@ -395,6 +443,7 @@ def run_backlog(args, cfg: AvalancheConfig) -> Dict:
     b = bl.make_backlog(jnp.arange(args.txs, dtype=jnp.int32))
     state = bl.init(jax.random.key(args.seed), args.nodes, args.slots, b,
                     cfg)
+    state = bl.with_trace(state, cfg, args.max_rounds)
     if args.mesh:
         from go_avalanche_tpu.parallel import sharded_backlog
 
@@ -408,6 +457,7 @@ def run_backlog(args, cfg: AvalancheConfig) -> Dict:
             state, cfg, args.max_rounds)
     from go_avalanche_tpu import traffic as tf
 
+    trace_extra = _emit_trace(args, cfg, final.sim.trace)
     out = jax.device_get(final.outputs)
     settled = np.asarray(out.settled)
     latency = (np.asarray(out.settle_round)
@@ -421,6 +471,7 @@ def run_backlog(args, cfg: AvalancheConfig) -> Dict:
         "settle_latency_median": float(np.median(latency))
         if settled.any() else None,
         **tf.latency_percentiles(final.traffic),
+        **trace_extra,
     }
 
 
@@ -432,6 +483,7 @@ def run_node_stream(args, cfg: AvalancheConfig) -> Dict:
     from go_avalanche_tpu.models import node_stream as ns
 
     state = ns.init(jax.random.key(args.seed), args.txs, cfg)
+    state = ns.with_trace(state, cfg, args.max_rounds)
     if args.mesh:
         from go_avalanche_tpu.parallel import sharded_node_stream as sns
 
@@ -452,6 +504,7 @@ def run_node_stream(args, cfg: AvalancheConfig) -> Dict:
         "registry_nodes": cfg.registry_nodes,
         "active_nodes": cfg.active_nodes,
         **ns.window_summary(final, cfg),
+        **_emit_trace(args, cfg, final.sim.trace),
     }
 
 
@@ -485,6 +538,12 @@ def run_fleet_mode(args, cfg: AvalancheConfig) -> Dict:
         row["realizations"] = realized
     if sink is not None:
         sink.write({**row, "point": {}, "tag": obs.tag_from_config(cfg)})
+    if res.trace is not None:
+        # Per-trial round-by-round traces (the vmap-lifted [F, S, M]
+        # plane): fleet-stacked rows to the trace sink — --trace-out
+        # when given, else the phase-row sink (rows are distinguishable
+        # by their `round` key).
+        row.update(_emit_trace(args, cfg, res.trace, fleet=True))
     return row
 
 
@@ -833,6 +892,31 @@ def main(argv=None) -> Dict:
                              "defaults to 1 when --metrics is given, 0 "
                              "(tap statically absent — every hlo_pin "
                              "hash unchanged) otherwise")
+    parser.add_argument("--trace-every", type=int, default=0,
+                        metavar="N",
+                        help="on-device trace plane (cfg.trace_every, "
+                             "obs/trace.py): every N-th round the "
+                             "round/scheduler step writes its telemetry "
+                             "row into an [S, M] buffer carried in the "
+                             "sim state — one dynamic_update_slice, no "
+                             "io_callback, so it works with --mesh "
+                             "(replicated plane) and --fleet (per-trial "
+                             "[F, S, M] traces).  Decoded host-side "
+                             "after the run to the same JSONL schema "
+                             "as --metrics-every, into --trace-out if "
+                             "given, else the --metrics sink.  0 "
+                             "(default) = statically absent (every "
+                             "hlo_pin hash unchanged)")
+    parser.add_argument("--trace-out", type=str, default=None,
+                        metavar="PATH",
+                        help="with --trace-every: decode the trace "
+                             "plane to this JSONL file (+ manifest) "
+                             "instead of the --metrics sink.  REQUIRED "
+                             "when --metrics-every is also nonzero — "
+                             "each tap writes one line per round, and "
+                             "an interleaved mix in one file would "
+                             "carry duplicate rounds under one "
+                             "manifest")
     parser.add_argument("--check-invariants", action="store_true",
                         help="debug mode (obs/watchdog.py): step the sim "
                              "one jitted round at a time and assert the "
@@ -995,19 +1079,62 @@ def main(argv=None) -> Dict:
             parser.error("--check-invariants is a dense debug mode (the "
                          "sharded while-loop drivers never surface "
                          "intermediate states to the host)")
+    # Trace-plane validation (the PR 5 rule: everything parser-level).
+    if args.trace_every < 0:
+        parser.error("--trace-every must be >= 0 (0 disables the "
+                     "on-device trace plane)")
+    if args.trace_every:
+        if args.model in ("slush", "snowflake"):
+            parser.error(f"--trace-every needs a round body carrying "
+                         f"the trace plane; the family models "
+                         f"(slush/snowflake) predate it — got "
+                         f"{args.model}")
+        if args.trace_every > args.max_rounds:
+            parser.error(f"--trace-every ({args.trace_every}) exceeds "
+                         f"--max-rounds ({args.max_rounds}): only round "
+                         f"0 would ever be sampled — the stride is "
+                         f"inert at this horizon (mirrors "
+                         f"obs.trace.alloc)")
+        if not (args.metrics or args.trace_out):
+            parser.error("--trace-every needs a sink for the decoded "
+                         "trace: --metrics PATH (shared) or --trace-out "
+                         "PATH (its own file)")
+        if args.phase_grid is not None:
+            parser.error("--trace-every x --phase-grid is not supported: "
+                         "every grid point would decode its own "
+                         "[F, S, M] trace into one file with repeating "
+                         "rounds — trace single --fleet points instead")
+    elif args.trace_out:
+        parser.error("--trace-out requires --trace-every (without the "
+                     "trace plane there is nothing to decode)")
     if args.metrics:
         if args.model in ("slush", "snowflake"):
             parser.error(f"--metrics needs a round body carrying the "
                          f"in-graph tap; the family models "
                          f"(slush/snowflake) predate it — got "
                          f"{args.model}")
-        if args.mesh:
+        if args.mesh and (args.metrics_every or not args.trace_every):
             parser.error("--metrics is the dense in-graph tap; sharded "
                          "drivers stream stacked telemetry host-side "
-                         "(obs.MetricsSink.write_stacked — see "
-                         "examples/fault_scenarios.py)")
-        if args.metrics_every == 0:
+                         "(obs.MetricsSink.write_stacked) — or use "
+                         "--trace-every: the trace plane is replicated "
+                         "and legal under shard_map")
+        if args.metrics_every == 0 and (args.trace_every == 0
+                                        or args.trace_out):
+            # The historic default: a sink implies the callback tap at
+            # stride 1.  With the trace plane selected AND no
+            # --trace-out, the --metrics sink serves the decoded trace
+            # instead and the callback stays off; with --trace-out the
+            # trace has its own file, so a bare --metrics keeps its
+            # callback meaning (never an opened-but-empty sink).
             args.metrics_every = 1
+        if args.metrics_every and args.trace_every and not args.trace_out:
+            parser.error("--metrics-every and --trace-every are two "
+                         "taps, one JSONL line per round EACH — an "
+                         "interleaved mix in one file would carry "
+                         "duplicate rounds under one manifest; give "
+                         "the trace plane its own sink with "
+                         "--trace-out")
     elif args.metrics_every:
         parser.error("--metrics-every requires --metrics (without a sink "
                      "the tap's records are dropped)")

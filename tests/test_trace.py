@@ -1,0 +1,434 @@
+"""On-device trace plane (go_avalanche_tpu/obs/trace.py, PR 11):
+callback-tap vs trace-plane JSONL bit-parity (dense + sharded), fleet
+[F, S, M] == stacked single-sim traces, trace-fed recovery verdicts,
+watchdog cursor/stride invariants, off-path static absence, and the
+parser/validation hygiene around --trace-every."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_avalanche_tpu import fleet as fl
+from go_avalanche_tpu import obs
+from go_avalanche_tpu.config import AvalancheConfig
+from go_avalanche_tpu.models import avalanche as av
+from go_avalanche_tpu.models import backlog as bl
+from go_avalanche_tpu.obs import trace as obs_trace
+
+TIMING = dict(time_step_s=1.0, request_timeout_s=3.0)
+
+
+def _async_cfg(**kw):
+    base = dict(finalization_score=16, latency_mode="fixed",
+                latency_rounds=1, partition_spec=(2, 6, 0.5), **TIMING)
+    base.update(kw)
+    return AvalancheConfig(**base)
+
+
+# --- tag + config validation: the trace fragment and the knob's range.
+
+def test_tag_trace_fragment_pinned():
+    assert obs.tag_from_config(AvalancheConfig(trace_every=2)) == ", trace2"
+    assert obs.tag_from_config(
+        AvalancheConfig(metrics_every=1, trace_every=3)) \
+        == ", metrics1, trace3"
+
+
+def test_config_rejects_negative_trace_every():
+    with pytest.raises(ValueError, match="trace_every"):
+        AvalancheConfig(trace_every=-1)
+
+
+def test_alloc_rejects_inert_rounds_below_stride():
+    cfg = AvalancheConfig(trace_every=8)
+    with pytest.raises(ValueError, match="exceeds the run horizon"):
+        obs_trace.alloc(cfg, 5, av.TRACE_COLUMNS)
+
+
+def test_write_round_checks_column_manifest():
+    cfg = AvalancheConfig(trace_every=1)
+    buf = obs_trace.alloc(cfg, 4, (("polls", "i"), ("bogus", "i")))
+    tel = av.SimTelemetry(*([jnp.int32(0)]
+                            * len(av.SimTelemetry._fields)))
+    with pytest.raises(ValueError, match="manifest mismatch"):
+        obs_trace.write_round(buf, cfg, jnp.int32(0), tel)
+
+
+# --- JSONL bit-parity: callback tap vs trace plane, same seed/config.
+
+def _run_callback_jsonl(tmp_path, every, n_rounds):
+    cfg = _async_cfg(metrics_every=every)
+    state = av.init(jax.random.key(1), 16, 8, cfg,
+                    init_pref=av.contested_init_pref(1, 16, 8))
+    path = tmp_path / "cb.jsonl"
+    with obs.metrics_sink(path):
+        av.run_scan(state, cfg, n_rounds)
+    rows = sorted((json.loads(l) for l in path.read_text().splitlines()),
+                  key=lambda r: r["round"])
+    return [json.dumps(r, sort_keys=True) for r in rows]
+
+
+def _run_trace_jsonl(tmp_path, every, n_rounds):
+    cfg = _async_cfg(trace_every=every)
+    state = av.with_trace(
+        av.init(jax.random.key(1), 16, 8, cfg,
+                init_pref=av.contested_init_pref(1, 16, 8)),
+        cfg, n_rounds)
+    final, _ = av.run_scan(state, cfg, n_rounds)
+    path = tmp_path / "tr.jsonl"
+    with obs.metrics_sink(path) as sink:
+        wrote = obs_trace.write_trace(sink, final.trace)
+    assert wrote == -(-n_rounds // every)
+    return path.read_text().splitlines()
+
+
+@pytest.mark.parametrize("every", [1, 2])
+def test_dense_callback_vs_trace_jsonl_bit_identical(tmp_path, every):
+    """Acceptance pin: the decoded trace-plane JSONL is bit-identical
+    to the callback tap's JSONL on the same seed/config (the configs
+    differ only in which tap is on — neither perturbs the trajectory)."""
+    n_rounds = 9
+    assert (_run_callback_jsonl(tmp_path, every, n_rounds)
+            == _run_trace_jsonl(tmp_path, every, n_rounds))
+
+
+def test_sharded_trace_matches_host_stacked_jsonl(tmp_path):
+    """Sharded model parity: the trace plane (replicated, written
+    in-graph under shard_map) decodes to the same JSONL the host-side
+    tap (`write_stacked` of the sharded scan's psum'd telemetry — the
+    sharded drivers' callback-flavor path) writes for the SAME run."""
+    from go_avalanche_tpu.parallel import sharded
+    from go_avalanche_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(n_node_shards=4, n_tx_shards=2)
+    cfg = _async_cfg(trace_every=1)
+    pref = av.contested_init_pref(3, 16, 12)
+    state = av.with_trace(
+        av.init(jax.random.key(3), 16, 12, cfg, init_pref=pref), cfg, 10)
+    placed = sharded.shard_state(state, mesh)
+    final, tel = sharded.run_scan_sharded(mesh, placed, cfg, n_rounds=10)
+
+    host_path, trace_path = tmp_path / "h.jsonl", tmp_path / "t.jsonl"
+    with obs.metrics_sink(host_path) as sink:
+        sink.write_stacked(tel)
+    with obs.metrics_sink(trace_path) as sink:
+        obs_trace.write_trace(sink, final.trace)
+    assert host_path.read_text() == trace_path.read_text()
+
+
+@pytest.mark.slow  # tier-1 wall budget (ROADMAP)
+def test_backlog_scheduler_trace_matches_callback(tmp_path):
+    """A streaming scheduler's trace carries the FULL scheduler record
+    (inner round + retire/occupancy), matching the callback tap's
+    one-line-per-round contract bit-for-bit."""
+    n_rounds = 8
+
+    def build(cfg):
+        b = bl.make_backlog(jnp.arange(32, dtype=jnp.int32))
+        return bl.init(jax.random.key(2), 12, 8, b, cfg)
+
+    cb_cfg = AvalancheConfig(finalization_score=12, metrics_every=1)
+    path_cb = tmp_path / "cb.jsonl"
+    with obs.metrics_sink(path_cb):
+        jax.jit(bl.run_scan, static_argnames=("cfg", "n_rounds"))(
+            build(cb_cfg), cb_cfg, n_rounds)
+    cb_rows = sorted(
+        (json.loads(l) for l in path_cb.read_text().splitlines()),
+        key=lambda r: r["round"])
+
+    tr_cfg = AvalancheConfig(finalization_score=12, trace_every=1)
+    state = bl.with_trace(build(tr_cfg), tr_cfg, n_rounds)
+    final, _ = jax.jit(bl.run_scan, static_argnames=("cfg", "n_rounds"))(
+        state, tr_cfg, n_rounds)
+    path_tr = tmp_path / "tr.jsonl"
+    with obs.metrics_sink(path_tr) as sink:
+        obs_trace.write_trace(sink, final.sim.trace)
+    tr_rows = [json.loads(l) for l in path_tr.read_text().splitlines()]
+    assert cb_rows == tr_rows
+    assert "retired" in tr_rows[0] and "occupied" in tr_rows[0]
+
+
+# --- fleet: vmap lifts [S, M] to per-trial [F, S, M].
+
+def test_fleet_trace_equals_stacked_single_sim_traces():
+    cfg = _async_cfg(finalization_score=48, trace_every=1,
+                     partition_spec=None,
+                     fault_script=(("partition", 2, 6, 0.5),))
+    F, R = 4, 12
+    res = fl.run_fleet("avalanche", cfg, fleet=F, n_nodes=32, n_txs=8,
+                       n_rounds=R, seed=0)
+    assert res.trace.data.shape == (F, R, len(av.TRACE_COLUMNS))
+    keys = jax.random.split(jax.random.key(0), F)
+    for i in range(F):
+        st = av.with_trace(
+            av.init(keys[i], 32, 8, cfg,
+                    init_pref=av.contested_init_pref_from_key(
+                        keys[i], 32, 8)),
+            cfg, R)
+        fin, _ = av.run_scan(st, cfg, R)
+        np.testing.assert_array_equal(
+            np.asarray(res.trace.data[i]),
+            np.asarray(jax.device_get(fin.trace.data)),
+            err_msg=f"trial {i}")
+    # Fleet-stacked records: per-trial lists, fleet-trace dispatch.
+    records = res.trace_records()
+    assert len(records) == R and len(records[0]["expiries"]) == F
+    assert obs.recovery.is_fleet_trace(records)
+
+
+def test_fleet_trace_feeds_per_trial_recovery_verdicts():
+    """The PR 6 scripted partition-heal check, upgraded: per-trial
+    verdicts come straight from the trace plane against each trial's
+    OWN realized stochastic window — same verdict as the host-telemetry
+    path on the same run."""
+    cfg = AvalancheConfig(
+        finalization_score=48, latency_mode="fixed", latency_rounds=1,
+        fault_script=(
+            ("stochastic_partition", (3, 6), (4, 10), (0.4, 0.6)),),
+        trace_every=1, **TIMING)
+    F, R = 4, 40
+    res = fl.run_fleet("avalanche", cfg, fleet=F, n_nodes=48, n_txs=12,
+                       n_rounds=R, seed=1)
+    # check_recovery consumes the TraceBuffer directly (decode inside).
+    reports = obs.check_recovery(cfg, res.trace,
+                                 windows=res.cut_windows)
+    assert len(reports) == F and all(r.ok for r in reports)
+    # Same verdicts via the legacy host-telemetry records.
+    legacy = obs.check_recovery(
+        cfg, fl.fleet_trace_records(res.telemetry, F),
+        windows=res.cut_windows)
+    assert [r.ok for r in reports] == [r.ok for r in legacy]
+    assert [r.windows for r in reports] == [r.windows for r in legacy]
+
+
+def test_dense_trace_feeds_check_recovery_same_verdict():
+    """Single-sim: the decoded trace is accepted by check_recovery and
+    yields the identical report to the stacked-telemetry records (the
+    PR 6 partition-heal property, now trace-backed)."""
+    cfg = _async_cfg(finalization_score=48, trace_every=1)
+    state = av.with_trace(
+        av.init(jax.random.key(5), 64, 16, cfg,
+                init_pref=av.contested_init_pref(5, 64, 16)),
+        cfg, 20)
+    final, tel = av.run_scan(state, cfg, 20)
+    from go_avalanche_tpu.obs.sink import _flatten_telemetry
+
+    host = _flatten_telemetry(jax.device_get(tel), {})
+    tel_records = [{"round": r,
+                    **{k: int(np.asarray(v[r])) for k, v in host.items()}}
+                   for r in range(20)]
+    rep_tel = obs.verify_recovery(cfg, tel_records)
+    rep_trace = obs.check_recovery(cfg, final.trace)
+    assert rep_trace.ok and rep_tel.ok
+    assert rep_trace.windows == rep_tel.windows
+    assert rep_trace.totals == rep_tel.totals
+
+
+# --- float columns: bitcast round-trip is exact.
+
+def test_node_stream_float_column_roundtrips(tmp_path):
+    cfg = AvalancheConfig(stake_mode="zipf", stake_zipf_s=1.2,
+                          registry_nodes=64, active_nodes=16,
+                          node_churn_rate=0.2, trace_every=1)
+    from go_avalanche_tpu.models import node_stream as ns
+
+    state = ns.with_trace(ns.init(jax.random.key(0), 8, cfg), cfg, 6)
+    final, tel = jax.jit(ns.run_scan,
+                         static_argnames=("cfg", "n_rounds"))(
+        state, cfg, 6)
+    recs = obs_trace.trace_records(final.sim.trace)
+    host = np.asarray(jax.device_get(tel.resident_stake))
+    for r in recs:
+        assert isinstance(r["resident_stake"], float)
+        assert r["resident_stake"] == float(host[r["round"]])
+
+
+# --- watchdog: cursor/stride consistency, untouched slots zero.
+
+def test_watchdog_trace_cursor_and_zero_slots():
+    cfg = AvalancheConfig(finalization_score=64, trace_every=2)
+    state = av.with_trace(av.init(jax.random.key(0), 8, 8, cfg), cfg, 10)
+    wd = obs.Watchdog(cfg)
+    step = jax.jit(lambda s: av.round_step(s, cfg)[0])
+    for _ in range(5):
+        state = step(state)
+        wd.check(state)
+    # Corrupt the cursor: slot index no longer == round // stride.
+    bad = state._replace(trace=dataclasses.replace(
+        state.trace, cursor=state.trace.cursor + 1))
+    with pytest.raises(obs.InvariantViolation, match="cursor"):
+        obs.check_trace(bad.trace, cfg, int(jax.device_get(bad.round)))
+    # Poke an untouched slot: it must stay zero.
+    dirty = state._replace(trace=dataclasses.replace(
+        state.trace, data=state.trace.data.at[-1, 0].set(7)))
+    with pytest.raises(obs.InvariantViolation, match="zero"):
+        obs.check_trace(dirty.trace, cfg, int(jax.device_get(state.round)))
+
+
+# --- off path: trace_every == 0 is statically absent.
+
+def test_trace_off_path_lowering_identical():
+    cfg_off = AvalancheConfig(finalization_score=8)
+    state = av.init(jax.random.key(0), 16, 8, cfg_off)
+    base = jax.jit(lambda s: av.round_step(s, cfg_off)[0]).lower(
+        state).as_text()
+    # The trace leaf is None and cfg.trace_every == 0: write_round
+    # returns before tracing, so the program has no update slice for it
+    # (beyond whatever the round itself lowers) — compare against a
+    # config that only differs in the (inert at 0) trace knob.
+    cfg_same = dataclasses.replace(cfg_off, trace_every=0)
+    again = jax.jit(lambda s: av.round_step(s, cfg_same)[0]).lower(
+        state).as_text()
+    assert base == again
+    cfg_on = dataclasses.replace(cfg_off, trace_every=1)
+    on_state = av.with_trace(state, cfg_on, 4)
+    on = jax.jit(lambda s: av.round_step(s, cfg_on)[0]).lower(
+        on_state).as_text()
+    assert "dynamic_update_slice" in on or "dynamic-update-slice" in on
+
+
+# --- run_sim wiring: parser hygiene + end-to-end decode.
+
+def _run_sim(argv):
+    from go_avalanche_tpu import run_sim
+
+    return run_sim.main(argv)
+
+
+@pytest.mark.parametrize("argv,msg", [
+    (["--trace-every", "-1", "--metrics", "x.jsonl"], "trace-every"),
+    (["--trace-every", "2"], "sink"),
+    (["--trace-every", "50", "--max-rounds", "10",
+      "--metrics", "x.jsonl"], None),
+    (["--trace-out", "x.jsonl"], None),
+    (["--metrics", "x.jsonl", "--metrics-every", "1",
+      "--trace-every", "1"], None),
+    (["--model", "slush", "--trace-every", "1",
+      "--metrics", "x.jsonl"], None),
+])
+def test_run_sim_trace_parser_rejections(argv, msg):
+    with pytest.raises(SystemExit):
+        _run_sim(argv)
+
+
+def test_run_sim_trace_end_to_end(tmp_path):
+    path = tmp_path / "t.jsonl"
+    result = _run_sim([
+        "--model", "avalanche", "--nodes", "16", "--txs", "8",
+        "--max-rounds", "12", "--finalization-score", "64",
+        "--trace-every", "3", "--metrics", str(path), "--json"])
+    assert result["trace_records"] > 0
+    rows = [json.loads(l) for l in path.read_text().splitlines()]
+    assert all(r["round"] % 3 == 0 for r in rows)
+    assert rows[0]["tag"] == ", trace3"
+    manifest = json.loads((tmp_path / "t.jsonl.manifest.json").read_text())
+    assert manifest["tap"] == {"kind": "trace", "metrics_every": 0,
+                               "trace_every": 3}
+
+
+@pytest.mark.slow  # tier-1 wall budget (ROADMAP)
+def test_run_sim_both_taps_two_sinks(tmp_path):
+    """Callback tap + trace plane in one run, one sink EACH: the two
+    files carry identical rows (same trajectory, same stride) modulo
+    the tag."""
+    cb, tr = tmp_path / "cb.jsonl", tmp_path / "tr.jsonl"
+    result = _run_sim([
+        "--model", "avalanche", "--nodes", "16", "--txs", "8",
+        "--max-rounds", "10", "--finalization-score", "64",
+        "--metrics", str(cb), "--metrics-every", "2",
+        "--trace-every", "2", "--trace-out", str(tr), "--json"])
+    assert result["trace_records"] == result["metrics_records"] > 0
+
+    def rows(p):
+        out = sorted((json.loads(l) for l in p.read_text().splitlines()),
+                     key=lambda r: r["round"])
+        for r in out:
+            r.pop("tag", None)
+        return out
+
+    assert rows(cb) == rows(tr)
+    tr_manifest = json.loads(
+        (tmp_path / "tr.jsonl.manifest.json").read_text())
+    assert tr_manifest["tap"]["kind"] == "callback+trace"
+
+
+@pytest.mark.slow  # tier-1 wall budget (ROADMAP)
+def test_run_sim_fleet_trace_stacked_rows(tmp_path):
+    path = tmp_path / "f.jsonl"
+    result = _run_sim([
+        "--model", "avalanche", "--nodes", "16", "--txs", "8",
+        "--max-rounds", "6", "--finalization-score", "64",
+        "--fleet", "3", "--trace-every", "1",
+        "--metrics", str(path), "--json"])
+    assert result["trace_records"] == 6
+    rows = [json.loads(l) for l in path.read_text().splitlines()]
+    fleet_rows = [r for r in rows if "expiries" in r]
+    assert len(fleet_rows) == 6
+    assert all(len(r["expiries"]) == 3 for r in fleet_rows)
+
+
+@pytest.mark.slow  # tier-1 wall budget (ROADMAP)
+def test_run_sim_mesh_trace_allowed(tmp_path):
+    """--mesh x --trace-every composes (the plane is replicated); the
+    callback tap alone still rejects --mesh."""
+    path = tmp_path / "m.jsonl"
+    result = _run_sim([
+        "--model", "avalanche", "--nodes", "16", "--txs", "8",
+        "--max-rounds", "8", "--finalization-score", "64",
+        "--mesh", "4,2", "--trace-every", "2",
+        "--metrics", str(path), "--json"])
+    assert result["trace_records"] > 0
+    with pytest.raises(SystemExit):
+        _run_sim(["--model", "avalanche", "--mesh", "4,2",
+                  "--metrics", str(path)])
+
+
+@pytest.mark.slow  # tier-1 wall budget (ROADMAP)
+def test_run_sim_trace_out_keeps_callback_default(tmp_path):
+    """--metrics + --trace-every + --trace-out with NO explicit
+    --metrics-every: the trace has its own sink, so the --metrics sink
+    keeps its historic callback-at-stride-1 meaning — never an
+    opened-but-empty file."""
+    cb, tr = tmp_path / "cb.jsonl", tmp_path / "tr.jsonl"
+    result = _run_sim([
+        "--model", "avalanche", "--nodes", "16", "--txs", "8",
+        "--max-rounds", "6", "--finalization-score", "64",
+        "--metrics", str(cb), "--trace-every", "1",
+        "--trace-out", str(tr), "--json"])
+    assert result["metrics_records"] == 6 == result["trace_records"]
+    assert len(cb.read_text().splitlines()) == 6
+
+
+def test_bench_parser_rejects_inert_trace_stride(capsys):
+    """Stride > rounds with the trace tap dies at the PARSER — a worker
+    ValueError would spin bench's accelerator retry/fallback loop."""
+    import bench
+    import sys
+    from unittest import mock
+
+    argv = ["bench.py", "--rounds", "5", "--metrics", "x.jsonl",
+            "--metrics-every", "100", "--metrics-tap", "trace"]
+    with mock.patch.object(sys, "argv", argv), pytest.raises(SystemExit):
+        bench.main()
+
+
+# --- bench: the --metrics-tap trace lane writes the same schema.
+
+@pytest.mark.slow  # tier-1 wall budget (ROADMAP)
+def test_bench_metrics_tap_trace_lane(tmp_path):
+    import bench
+
+    path = tmp_path / "b.jsonl"
+    result = bench.bench(32, 32, 3, 8, repeats=1, metrics=str(path),
+                         metrics_every=1, metrics_tap="trace")
+    assert ", trace1" in result["metric"]
+    rows = [json.loads(l) for l in path.read_text().splitlines()]
+    # warmup + 1 repeat, 3 rounds each, stride 1.
+    assert [r["round"] for r in rows] == list(range(6))
+    assert all(r["tag"] == ", trace1" for r in rows)
