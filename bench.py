@@ -264,13 +264,22 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
         state = run(state)
         _sync(state)
 
+        # Retrace guard (go_avalanche_tpu/analysis/retrace.py): the
+        # warmup call above compiled everything; a compile INSIDE the
+        # timed repeats would mean the measurement times XLA's compiler
+        # (donation changing a layout, a shape leaking into a static)
+        # — fail loudly rather than record a poisoned number.
+        from go_avalanche_tpu.analysis import retrace
+
         best_dt = None
-        for _ in range(repeats):
-            t0 = time.perf_counter()
-            state = run(state)
-            _sync(state)
-            dt = time.perf_counter() - t0
-            best_dt = dt if best_dt is None else min(best_dt, dt)
+        with retrace.CompileCounter() as compiles:
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                state = run(state)
+                _sync(state)
+                dt = time.perf_counter() - t0
+                best_dt = dt if best_dt is None else min(best_dt, dt)
+        compiles.expect_at_most(0, "the bench timed loop")
 
         if trace_every and sink is not None:
             # Decode the trace plane AFTER the timed loop (the whole

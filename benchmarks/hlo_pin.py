@@ -86,7 +86,18 @@ run: an UNINTENDED program change fails CI; an intended one re-pins with
 `--update` and the diff of `hlo_pin.json` records that the program
 changed on purpose.
 
+PR 12 (the static-analysis plane, go_avalanche_tpu/analysis/): the
+archive carries a per-program OP-CLASS HISTOGRAM next to each hash
+(written by `--update`; entries without one still read fine — the
+schema bump is backward-compatible), and `--explain` turns a mismatch
+from two inscrutable digests into the op classes that appeared or
+vanished (`analysis/drift.py`).  `--verify-off-path` additionally runs
+the semantic contract auditor over the off-path programs — zero host
+callbacks, no trace-buffer argument, clean dtype budget, donation
+honored — so hash equality is no longer the only witness.
+
     python benchmarks/hlo_pin.py                    # check all pins
+    python benchmarks/hlo_pin.py --explain          # check + name drift
     python benchmarks/hlo_pin.py --list             # show pinned programs
     python benchmarks/hlo_pin.py --update           # re-pin all programs
     python benchmarks/hlo_pin.py --update flagship  # re-pin one program
@@ -318,15 +329,21 @@ PROGRAM_BUILDERS = {
 def stale_pins(archive: dict) -> list:
     """Archived pins whose lowering path no longer exists: programs
     unknown to `PROGRAMS`, or whose `benchmarks.workload` builders
-    (`PROGRAM_BUILDERS`) have been renamed/removed.  Pure metadata —
-    no jax import, no lowering — so the check is gate-cheap."""
+    (`PROGRAM_BUILDERS`) have been renamed/removed — and archived op
+    HISTOGRAMS whose program (or whose platform hash) vanished, so a
+    `--explain` can never diff against an orphaned snapshot.  Pure
+    metadata — no jax import, no lowering — so the check is
+    gate-cheap."""
     from benchmarks import workload
 
     stale = []
     for name in sorted(archive.get("programs", {})):
+        entry = archive["programs"][name]
         if name not in PROGRAMS:
+            orphan = (" (its archived op histogram is orphaned too)"
+                      if entry.get("histograms") else "")
             stale.append(f"{name}: archived but unknown to "
-                         f"hlo_pin.PROGRAMS (builder removed?)")
+                         f"hlo_pin.PROGRAMS (builder removed?){orphan}")
             continue
         for builder in PROGRAM_BUILDERS.get(name, ()):
             if not hasattr(workload, builder):
@@ -334,6 +351,12 @@ def stale_pins(archive: dict) -> list:
                     f"{name}: workload builder {builder!r} no longer "
                     f"exists in benchmarks/workload.py — the pin can "
                     f"no longer lower")
+        for platform in sorted(entry.get("histograms", {})):
+            if platform not in entry.get("hashes", {}):
+                stale.append(
+                    f"{name}: archived [{platform}] op histogram has no "
+                    f"matching pin hash — the histogram outlived its "
+                    f"program (re-run --update or drop it)")
     return stale
 
 # The off-path flagship programs: with cfg.metrics_every == 0 and an
@@ -373,23 +396,39 @@ def hlo_hash(hlo_text: str) -> str:
     return hashlib.sha256(strip_locations(hlo_text).encode()).hexdigest()
 
 
-_HASH_CACHE: dict = {}
+_TEXT_CACHE: dict = {}
 
 
-def program_hash(name: str, workload: dict | None = None) -> str:
-    """Current hash of a pinned program (archive workload or default).
-
-    Memoized per (name, workload) within the process.  An explicit
+def program_text(name: str, workload: dict | None = None) -> str:
+    """Location-stripped StableHLO text of a pinned program (archive
+    workload or default), memoized per (name, workload) — ONE lowering
+    feeds the hash, the op histogram AND the contract auditor
+    (go_avalanche_tpu/analysis/hlo_audit.py).  An explicit
     ``metrics_every=0`` is a DISTINCT cache key from an absent one on
     purpose: the off-path check must actually lower the explicit-0
     program (proving off == absent), not read back the drift test's
-    memoized hash."""
+    memoized text."""
     default_workload, builder = PROGRAMS[name]
     workload = dict(workload or default_workload)
     key = (name, json.dumps(workload, sort_keys=True))
-    if key not in _HASH_CACHE:
-        _HASH_CACHE[key] = hlo_hash(builder(workload))
-    return _HASH_CACHE[key]
+    if key not in _TEXT_CACHE:
+        _TEXT_CACHE[key] = strip_locations(builder(workload))
+    return _TEXT_CACHE[key]
+
+
+def program_hash(name: str, workload: dict | None = None) -> str:
+    """Current hash of a pinned program (archive workload or default);
+    shares `program_text`'s memoized lowering."""
+    return hashlib.sha256(program_text(name, workload).encode()).hexdigest()
+
+
+def program_histogram(name: str, workload: dict | None = None) -> dict:
+    """Current op-class histogram of a pinned program — the drift
+    explainer's live side (`analysis/drift.py`); shares
+    `program_text`'s memoized lowering."""
+    from go_avalanche_tpu.analysis import drift
+
+    return drift.op_histogram(program_text(name, workload))
 
 
 def verify_off_path(platform: str, archive: dict | None = None) -> list:
@@ -535,14 +574,26 @@ def main() -> None:
                              "byte-identical to the archived pins — the "
                              "observability tap and the fault-script "
                              "engine must both be statically absent on "
-                             "the default path")
+                             "the default path — AND semantically "
+                             "callback-free / trace-plane-free / "
+                             "donation-honoring per the contract "
+                             "auditor (go_avalanche_tpu/analysis)")
+    parser.add_argument("--explain", action="store_true",
+                        help="on a pin mismatch, diff the archived "
+                             "op-class histogram against the current "
+                             "lowering and NAME the op classes that "
+                             "appeared/vanished/changed count "
+                             "(analysis/drift.py) instead of printing "
+                             "two hashes; no-op while pins match")
     args = parser.parse_args()
-    if args.stale and (args.update is not None or args.verify_off_path):
+    if args.stale and (args.update is not None or args.verify_off_path
+                       or args.explain):
         # --stale short-circuits before any lowering; silently skipping
-        # --update / --verify-off-path under it would green-light a CI
-        # step that never ran its real check.
+        # --update / --verify-off-path / --explain under it would
+        # green-light a CI step that never ran its real check.
         parser.error("--stale composes with --list only; run --update "
-                     "/ --verify-off-path as their own invocations")
+                     "/ --verify-off-path / --explain as their own "
+                     "invocations")
 
     archive = _load_archive()
 
@@ -579,12 +630,20 @@ def main() -> None:
 
     if args.verify_off_path:
         failures = verify_off_path(platform, archive)
+        # The semantic half (PR 12): byte-identity proves the off-path
+        # program didn't move; the auditor proves the unmoved program
+        # IS callback-free / trace-plane-free / donation-honoring, so
+        # a future re-pin can never silently bless a leaked tap.
+        from go_avalanche_tpu.analysis import hlo_audit
+
+        failures += hlo_audit.audit_off_path(platform, archive)
         if failures:
             print("OFF-PATH DRIFT:\n  " + "\n  ".join(failures),
                   file=sys.stderr)
             sys.exit(1)
         print(f"ok: metrics-off empty-fault-script flagship programs "
-              f"match their [{platform}] pins")
+              f"match their [{platform}] pins and pass the semantic "
+              f"zero-callback audit")
         return
 
     if args.update is not None:
@@ -600,6 +659,12 @@ def main() -> None:
             entry.setdefault("workload", dict(PROGRAMS[name][0]))
             current = program_hash(name, entry["workload"])
             entry.setdefault("hashes", {})[platform] = current
+            # The schema-bump payload (PR 12): the op-class histogram
+            # rides next to the hash so a future mismatch can be
+            # EXPLAINED (--explain / analysis/drift.py); same memoized
+            # lowering, zero extra cost.
+            entry.setdefault("histograms", {})[platform] = \
+                program_histogram(name, entry["workload"])
             print(f"pinned {name} [{platform}]: {current}")
         archive["jax"] = jax.__version__
         ARCHIVE.write_text(json.dumps(archive, indent=2, sort_keys=True)
@@ -621,6 +686,25 @@ def main() -> None:
         checked += 1
         if pinned != current:
             failures.append(f"{name}: pinned {pinned} != current {current}")
+            if args.explain:
+                # Name the drift: archived vs current op-class
+                # histogram (analysis/drift.py).  A pre-PR-12 entry has
+                # no histogram; say so instead of diffing nothing.
+                from go_avalanche_tpu.analysis import drift
+
+                archived_hist = entry.get("histograms", {}).get(platform)
+                if archived_hist is None:
+                    failures.append(
+                        f"  {name}: no archived [{platform}] op "
+                        f"histogram to diff (pre-PR-12 archive entry; "
+                        f"--update writes one)")
+                else:
+                    failures.extend(
+                        f"  {name}: {line}"
+                        for line in drift.diff_histograms(
+                            archived_hist,
+                            program_histogram(name,
+                                              entry.get("workload"))))
         else:
             print(f"ok: {name} [{platform}] matches pin "
                   f"({current[:12]}...)")

@@ -1,0 +1,43 @@
+"""Program-plane static analysis (PR 12).
+
+The repo's compiled-program hygiene was hash-equality only
+(`benchmarks/hlo_pin.py`): a pin mismatch said *something* drifted but
+never *what*, and the contracts the codebase actually depends on were
+enforced by byte-identity or not at all.  This package turns them into
+machine-checked invariants over the lowered/compiled programs:
+
+  * `hlo_audit`  — the HLO contract auditor: per-program custom-call
+                   allowlists (off-path programs contain ZERO host
+                   callbacks), the dtype budget (no f64 / no shaped-i64
+                   anywhere), per-sharded-driver collective allowlists
+                   (psum on declared axes only; an accidental
+                   all-gather of an ``[N, T]`` plane is a hard
+                   failure), and the donation audit (every donated
+                   state leaf must alias an output — lowered
+                   `tf.aliasing_output` / `jax.buffer_donor` coverage
+                   at the archived shape, compiled
+                   ``input_output_alias`` coverage at audit shape);
+  * `drift`      — op-class histograms archived next to each pin hash
+                   (`hlo_pin.py --explain` names the op classes that
+                   appeared/vanished instead of printing two hashes);
+  * `retrace`    — the compile-cache counter: `bench.py`'s timed loop
+                   asserts ZERO recompiles inside the measurement and
+                   `fleet.run_phase_grid` asserts at most one fleet
+                   compile per config point;
+  * `lint`       — the repo-convention AST linter: canonical-module
+                   spellings (`cluster_of` / `tag_from_config` /
+                   `suppress_taps` / `draw_churn_swaps`), a jax-free
+                   `config.py` validation plane, no host RNG in traced
+                   model/ops code, no `jax.debug.print` in library
+                   modules.
+
+CLI: ``python -m go_avalanche_tpu.analysis`` (see `cli.py`); everything
+also runs in tier-1 (`tests/test_analysis.py`) — lowering is
+`eval_shape`-cheap per the hlo_pin precedent.  docs/static_analysis.md
+holds the contract table and the how-to-add-a-rule guide.
+"""
+
+from go_avalanche_tpu.analysis.retrace import (  # noqa: F401
+    CompileCounter,
+    RetraceError,
+)

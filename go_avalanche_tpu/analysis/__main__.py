@@ -1,0 +1,6 @@
+"""`python -m go_avalanche_tpu.analysis` entry point (see cli.py)."""
+
+from go_avalanche_tpu.analysis.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,122 @@
+"""`python -m go_avalanche_tpu.analysis` — the static-analysis CLI.
+
+Subcommands (default ``all``):
+
+  audit   contract-audit every archived pin (callbacks / dtype budget /
+          collectives / donation), the off-path re-lowerings, the five
+          sharded drivers on the 2x2 audit mesh, and the compile-level
+          ``input_output_alias`` donation proof for the flagship, the
+          fleet, the traffic program and every sharded driver;
+  lint    the repo-convention AST linter (jax-free — runs anywhere);
+  all     both.
+
+Exit status 1 on any failure, 0 clean; one line per failure on stderr
+(the hlo_pin.py convention).  Also installed as the ``avalanche-audit``
+console script (pyproject.toml).
+
+Environment: like tests/conftest.py, the audit runs on the CPU backend
+with 8 virtual XLA devices so the sharded-driver mesh exists without
+hardware; set ``GO_AVALANCHE_TPU_ANALYSIS_HW=1`` to audit on the real
+accelerator instead (platform-specific custom calls differ, which is
+the point of a hardware audit).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _ensure_devices() -> None:
+    """Mirror tests/conftest.py: a virtual 8-device CPU mesh, forced
+    AFTER the jax import because the container's axon plugin overrides
+    JAX_PLATFORMS at interpreter start (see conftest.py's NOTE)."""
+    if os.environ.get("GO_AVALANCHE_TPU_ANALYSIS_HW"):
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+# The acceptance set for the compile-level donation proof: the flagship
+# program, the fleet program and the traffic program (every sharded
+# driver is proven separately on the audit mesh).
+DONATION_COMPILE_PROGRAMS = ("flagship", "fleet_small",
+                             "flagship_traffic")
+
+
+def run_audit(compile_donation: bool = True) -> list:
+    """Every lowered-program contract, as one failure list."""
+    import jax
+
+    from benchmarks import hlo_pin
+    from go_avalanche_tpu.analysis import hlo_audit
+
+    failures = []
+    archive = hlo_pin._load_archive()
+    platform = jax.default_backend()
+    failures += hlo_audit.audit_all_pinned(archive)
+    failures += hlo_audit.audit_off_path(platform, archive)
+    try:
+        # One pass over the five drivers; compile_donation rides along
+        # so nothing is lowered (or reported) twice.
+        failures += hlo_audit.audit_all_sharded(
+            compile_donation=compile_donation)
+    except hlo_audit.AuditUnavailable as e:
+        failures.append(f"sharded audit unavailable: {e}")
+    if compile_donation:
+        for name in DONATION_COMPILE_PROGRAMS:
+            failures += hlo_audit.audit_donation_compiled(name)
+    return failures
+
+
+def run_lint() -> list:
+    from go_avalanche_tpu.analysis import lint
+
+    try:
+        return [str(v) for v in lint.lint_repo()]
+    except RuntimeError as e:
+        # Installed-wheel invocation with no checkout in sight: an
+        # explicit failure line beats linting all of site-packages.
+        return [f"lint unavailable: {e}"]
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m go_avalanche_tpu.analysis", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("command", nargs="?", default="all",
+                        choices=("audit", "lint", "all"),
+                        help="which surface to run (default: all)")
+    parser.add_argument("--no-compile-donation", action="store_true",
+                        help="skip the compile-level input_output_alias "
+                             "proof (lowering-level donation attrs are "
+                             "still checked); the compile pass costs a "
+                             "few seconds of XLA time at toy shapes")
+    args = parser.parse_args(argv)
+
+    failures = []
+    if args.command in ("lint", "all"):
+        failures += run_lint()
+    if args.command in ("audit", "all"):
+        _ensure_devices()
+        failures += run_audit(
+            compile_donation=not args.no_compile_donation)
+
+    if failures:
+        print("STATIC ANALYSIS FAILURES:\n  " + "\n  ".join(failures),
+              file=sys.stderr)
+        return 1
+    what = {"audit": "contract audit", "lint": "lint",
+            "all": "contract audit + lint"}[args.command]
+    print(f"ok: {what} clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,93 @@
+"""Op-class histograms: the drift EXPLAINER behind `hlo_pin --explain`.
+
+A pin mismatch used to print two sha256 digests — true, and useless for
+deciding whether the drift was the intended one.  This module archives a
+per-program histogram of StableHLO op classes next to each pin hash
+(`benchmarks/hlo_pin.json`, schema bump carried backward-compatibly:
+entries without a ``histograms`` key still read fine), and on mismatch
+`hlo_pin.py --explain` diffs the archived histogram against the current
+lowering and names the op classes that appeared, vanished or changed
+count.
+
+The histogram is computed from the SAME location-stripped text the hash
+covers (`hlo_pin.strip_locations`), so the two artifacts can never
+describe different programs.  Op classes:
+
+  * ``stablehlo.<op>``         — one class per StableHLO op name;
+  * ``custom_call:<target>``   — custom calls split out by target (the
+                                 class that distinguishes "a callback
+                                 appeared" from "a Sharding annotation
+                                 moved");
+  * ``<dialect>.<op>``         — any non-stablehlo dialect op (func /
+                                 mhlo / chlo), counted by full name.
+
+Two same-hash programs have identical histograms by construction; two
+different-hash programs with IDENTICAL histograms are the "shape or
+constant moved, structure did not" case — `diff_histograms` reports
+that explicitly rather than returning an empty diff.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+from typing import Dict, List
+
+# One op instance per SSA statement: `= stablehlo.add`, `= func.call`,
+# `= "stablehlo.all_gather"(...)` (region-bearing / generic-form ops
+# print quoted).  Ops that produce no results (stablehlo.return,
+# func.return) appear without `=` and are matched by the bare form.
+_OP_RE = re.compile(
+    r'(?:^|\s)"?([a-z_]+\.[a-z_0-9]+)"?[ (]')
+_CUSTOM_TARGET_RE = re.compile(r'custom_call\s*@([\w.$]+)')
+
+
+def op_histogram(hlo_text: str) -> Dict[str, int]:
+    """Histogram of op classes in (location-stripped) StableHLO text.
+
+    Returns a plain ``{class: count}`` dict (JSON-ready, sorted on
+    write by the archive's ``sort_keys``).  `custom_call` instances are
+    classified by target; everything else by ``dialect.op`` name.
+    """
+    hist: Counter = Counter()
+    for line in hlo_text.splitlines():
+        targets = _CUSTOM_TARGET_RE.findall(line)
+        if targets:
+            for t in targets:
+                hist[f"custom_call:{t}"] += 1
+            continue
+        m = _OP_RE.search(line)
+        if m:
+            hist[m.group(1)] += 1
+    return dict(hist)
+
+
+def diff_histograms(archived: Dict[str, int],
+                    current: Dict[str, int]) -> List[str]:
+    """Name the op classes whose counts differ, archived -> current.
+
+    One line per differing class, vanished/appeared called out, sorted
+    by |count delta| descending then name (the biggest structural move
+    first — usually the one-line answer to "what drifted").  Equal
+    histograms return the explicit shape-or-constant note instead of
+    [] so `--explain` never prints nothing on a real hash mismatch.
+    """
+    classes = sorted(set(archived) | set(current))
+    rows = []
+    for cls in classes:
+        a, c = archived.get(cls, 0), current.get(cls, 0)
+        if a == c:
+            continue
+        if a == 0:
+            note = "APPEARED"
+        elif c == 0:
+            note = "VANISHED"
+        else:
+            note = f"{c - a:+d}"
+        rows.append((abs(c - a), cls, f"{cls}: {a} -> {c} ({note})"))
+    if not rows:
+        return ["op histograms are identical: the drift is in shapes, "
+                "constants or operand wiring, not op structure "
+                "(diff the lowered text directly)"]
+    rows.sort(key=lambda r: (-r[0], r[1]))
+    return [r[2] for r in rows]

@@ -1,0 +1,1096 @@
+"""HLO contract auditor: semantic assertions over lowered/compiled programs.
+
+`benchmarks/hlo_pin.py` pins byte-identity; this module pins MEANING.
+Every program in the pin registry (plus the five sharded drivers and
+the program a `run_sim` invocation selects) is statically audited for
+the contracts the codebase actually depends on:
+
+  * **custom-call allowlist** — off-path programs contain ZERO host
+    callbacks (`xla_python_*callback` custom calls); the tapped
+    program (`flagship_metrics`) contains exactly its one io_callback.
+    Upgrades `hlo_pin --verify-off-path` from hash equality to a
+    semantic assertion.
+  * **dtype budget** — no f64 and no SHAPED i64/ui64 tensor anywhere
+    (the engines are u8/u16/i32/f32 by design; a silent x64 promotion
+    doubles every plane's HBM traffic).  The one sanctioned i64 is the
+    SCALAR callback-pointer constant inside callback-allowed programs.
+  * **collective allowlist** — single-chip programs carry zero
+    collectives; each sharded driver's lowered program must contain
+    exactly its `DECLARED_COLLECTIVES` (collective kind x mesh axes,
+    inferred from replica_groups), and every `all_gather` result must
+    stay strictly smaller than the unpacked ``[N, T]`` plane — the
+    accidental-gather-of-a-plane hard failure.
+  * **donation audit** — for every donated program, each flat state
+    leaf must reach the entry signature as a donated argument
+    (`tf.aliasing_output` under plain jit, `jax.buffer_donor` under
+    shard_map — JAX silently un-donates on shape/dtype mismatch, which
+    is exactly what this catches), and a small-shape COMPILE must show
+    ``input_output_alias`` covering every argument.  This is the
+    static answer to the ROADMAP's donation-under-vmap soak follow-up,
+    fleet program included.
+
+All checks are text-level over the same location-stripped StableHLO the
+pins hash (plus optimized-HLO text for the compile-level donation
+proof), so the audit is `eval_shape`-cheap and runs in tier-1
+(tests/test_analysis.py) and via `python -m go_avalanche_tpu.analysis`.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+# ------------------------------------------------------------ text parsing
+
+_CALLBACK_TARGET_RE = re.compile(r"^xla(?:_ffi)?_python_[a-z_]*callback$")
+_CUSTOM_TARGET_RE = re.compile(r'custom_call\s*@([\w.$]+)')
+COLLECTIVE_KINDS = ("all_reduce", "all_gather", "all_to_all",
+                    "collective_permute", "reduce_scatter",
+                    "collective_broadcast")
+_COLLECTIVE_RE = re.compile(
+    r'"?stablehlo\.(' + "|".join(COLLECTIVE_KINDS) + r')"?[ (]')
+_REPLICA_GROUPS_RE = re.compile(r'replica_groups\s*=\s*dense<([^>]*)>')
+_TENSOR_TYPE_RE = re.compile(r'tensor<([^>]*)>')
+_MAIN_SIG_RE = re.compile(
+    r'func\.func public @main\((.*?)\)\s*->', re.DOTALL)
+_RESULT_TYPE_RE = re.compile(r'->\s*tensor<([^>]*)>')
+
+# Custom-call targets that are lowering plumbing, not program semantics
+# (sharding annotations, SPMD shape bridges, platform PRNG FFI).
+BENIGN_CUSTOM_CALLS = frozenset({
+    "Sharding", "SPMDShardToFullShape", "SPMDFullToShardShape",
+    "cu_threefry2x32", "cu_threefry2x32_ffi",
+})
+
+
+def custom_call_targets(text: str) -> Counter:
+    """All custom-call targets in the program, with counts."""
+    return Counter(_CUSTOM_TARGET_RE.findall(text))
+
+
+def callback_calls(text: str) -> int:
+    """Number of host-callback custom calls (io_callback / pure_callback
+    / debug prints all lower to `xla*_python_*callback` targets)."""
+    return sum(n for t, n in custom_call_targets(text).items()
+               if _CALLBACK_TARGET_RE.match(t))
+
+
+def unknown_custom_calls(text: str) -> List[str]:
+    """Custom-call targets that are neither benign plumbing nor python
+    callbacks — anything here is a new dependency the contract table
+    must name explicitly before it ships."""
+    return sorted(t for t in custom_call_targets(text)
+                  if t not in BENIGN_CUSTOM_CALLS
+                  and not _CALLBACK_TARGET_RE.match(t))
+
+
+# Structural attributes whose payload types are metadata, not program
+# values (replica group tables, layouts) — their i64 spelling is MLIR's,
+# not the program's.
+_ATTR_CONTEXT = ("replica_groups", "source_target_pairs",
+                 "operand_layouts", "result_layouts", "layout =",
+                 "dimension_numbers", "scatter_dimension_numbers",
+                 "gather_dimension_numbers")
+
+
+def dtype_violations(text: str, scalar_i64_ok: bool = False) -> List[str]:
+    """Every f64 / shaped-i64 / shaped-ui64 tensor TYPE in the program.
+
+    `scalar_i64_ok` permits the bare ``tensor<i64>`` scalar (the python
+    callback's process pointer constant) — only meaningful for
+    programs whose contract allows callbacks."""
+    out = []
+    for lineno, line in enumerate(text.splitlines(), 1):
+        for m in _TENSOR_TYPE_RE.finditer(line):
+            ty = m.group(1)
+            if not ("f64" in ty or "i64" in ty):
+                continue
+            prefix = line[:m.start()]
+            if any(a in prefix for a in _ATTR_CONTEXT):
+                continue
+            # A `dense<...> : tensor<...>` payload that is NOT a
+            # stablehlo.constant is op metadata (reduce_window padding,
+            # replica group tables, ...), spelled i64 by MLIR itself —
+            # only constants carry program values through dense<>.
+            if "dense<" in prefix and "stablehlo.constant" not in line:
+                continue
+            if ty == "i64" and scalar_i64_ok:
+                continue
+            out.append(f"line {lineno}: tensor<{ty}> — the dtype budget "
+                       f"forbids f64/s64 (u8/u16/i32/f32 engines; x64 "
+                       f"promotion doubles HBM traffic)")
+    return out
+
+
+def parse_replica_groups(line: str) -> Optional[Tuple[Tuple[int, ...], ...]]:
+    """The replica_groups attribute on a collective's op line, as a
+    tuple of device-id groups (None when the op carries none)."""
+    m = _REPLICA_GROUPS_RE.search(line)
+    if not m:
+        return None
+    body = m.group(1).strip()
+    if not body.startswith("["):
+        body = f"[[{body}]]"
+    elif not body.startswith("[["):
+        body = f"[{body}]"
+    import json
+
+    groups = json.loads(body.replace(" ", "").replace("],[", "], ["))
+    return tuple(tuple(int(d) for d in g) for g in groups)
+
+
+def collective_instances(text: str) -> List[Dict]:
+    """Every collective op instance: kind, replica groups (if printed on
+    the op line) and — for single-line ops like all_gather — the result
+    tensor's element count."""
+    out = []
+    for lineno, line in enumerate(text.splitlines(), 1):
+        m = _COLLECTIVE_RE.search(line)
+        if not m:
+            continue
+        kind = m.group(1)
+        groups = parse_replica_groups(line)
+        elems = None
+        rm = _RESULT_TYPE_RE.search(line)
+        if rm:
+            # `16x16xui8` -> dims [16, 16] (the last x-component is the
+            # element type; a bare `tensor<ui8>` scalar has no dims).
+            parts = rm.group(1).split("x")
+            dims = parts[:-1]
+            if all(d.isdigit() for d in dims):
+                elems = 1
+                for d in dims:
+                    elems *= int(d)
+        out.append({"kind": kind, "groups": groups, "elems": elems,
+                    "line": lineno})
+    return out
+
+
+def axis_groupings(mesh_axes: Sequence[Tuple[str, int]]
+                   ) -> Dict[FrozenSet[FrozenSet[int]], Tuple[str, ...]]:
+    """Map every possible replica-group partition of a row-major device
+    grid to the mesh-axis subset it reduces over.
+
+    `mesh_axes` is the ordered ``[(axis_name, size), ...]`` of the
+    audit mesh; device ids are row-major over that order (how
+    `parallel/mesh.make_mesh` lays its grid out).  Covers every
+    non-empty axis subset, so an observed grouping that matches nothing
+    is by construction NOT a reduction over declared mesh axes.
+
+    On a mesh with a size-1 axis, distinct subsets collapse to the
+    SAME partition (reducing over a trivial axis is a no-op); the
+    iteration goes largest-subset first so the SMALLEST subset wins —
+    a collective on a degenerate mesh attributes to the minimal axis
+    set, never to a phantom extra axis.
+    """
+    import itertools
+
+    names = [n for n, _ in mesh_axes]
+    table: Dict[FrozenSet[FrozenSet[int]], Tuple[str, ...]] = {}
+    for r in range(len(names), 0, -1):
+        for subset in itertools.combinations(names, r):
+            table[_partition_for_axes(mesh_axes, subset)] = subset
+    return table
+
+
+def _partition_for_axes(mesh_axes: Sequence[Tuple[str, int]],
+                        axes: Tuple[str, ...]
+                        ) -> FrozenSet[FrozenSet[int]]:
+    """The replica-group partition a reduction over `axes` produces on
+    a row-major device grid — the ONE spelling of the grid layout,
+    shared by `axis_groupings` and `declared_partitions`."""
+    import itertools
+
+    names = [n for n, _ in mesh_axes]
+    sizes = [s for _, s in mesh_axes]
+    idx = {names.index(a) for a in axes if a in names}
+    groups: Dict[Tuple, List[int]] = {}
+    for coord in itertools.product(*[range(s) for s in sizes]):
+        dev = 0
+        for c, s in zip(coord, sizes):
+            dev = dev * s + c
+        key = tuple(c for i, c in enumerate(coord) if i not in idx)
+        groups.setdefault(key, []).append(dev)
+    return frozenset(frozenset(g) for g in groups.values())
+
+
+def declared_partitions(declared: FrozenSet,
+                        mesh_axes: Sequence[Tuple[str, int]]
+                        ) -> Dict[str, set]:
+    """kind -> the replica-group partitions the declared (kind, axes)
+    pairs produce ON THIS MESH.
+
+    The mesh-robust form of the allowlist: on a degenerate mesh two
+    declared axis sets can yield the same partition — coverage compares
+    partitions directly, so `run_sim --audit --mesh 4,1` never
+    false-fails on axis-attribution ambiguity."""
+    out: Dict[str, set] = {}
+    for kind, axes in declared:
+        out.setdefault(kind, set()).add(
+            _partition_for_axes(mesh_axes, axes))
+    return out
+
+
+def collective_coverage_failures(text: str, declared: FrozenSet,
+                                 mesh_axes: Sequence[Tuple[str, int]],
+                                 what: str) -> List[str]:
+    """Partition-based allowlist check for an ARBITRARY mesh: every
+    collective instance's replica grouping must equal some declared
+    (kind, axes) pair's grouping on this mesh."""
+    allowed = declared_partitions(declared, mesh_axes)
+    failures = []
+    for inst in collective_instances(text):
+        if inst["groups"] is None:
+            failures.append(
+                f"{what}: line {inst['line']}: {inst['kind']} without "
+                f"parseable replica_groups — the collective allowlist "
+                f"cannot attribute it to a mesh axis")
+            continue
+        norm = frozenset(frozenset(g) for g in inst["groups"])
+        if norm not in allowed.get(inst["kind"], ()):
+            failures.append(
+                f"{what}: line {inst['line']}: UNDECLARED collective "
+                f"{inst['kind']} over device groups {inst['groups']} — "
+                f"no DECLARED_COLLECTIVES entry produces this grouping "
+                f"on the audited mesh")
+    return failures
+
+
+def observed_collectives(text: str, mesh_axes: Sequence[Tuple[str, int]]
+                         ) -> Tuple[FrozenSet[Tuple[str, Tuple[str, ...]]],
+                                    List[str]]:
+    """The set of (collective kind, mesh axes) pairs a lowered sharded
+    program contains, plus failures for any instance whose replica
+    grouping matches no mesh-axis subset."""
+    table = axis_groupings(mesh_axes)
+    observed = set()
+    failures = []
+    for inst in collective_instances(text):
+        if inst["groups"] is None:
+            failures.append(
+                f"line {inst['line']}: {inst['kind']} without parseable "
+                f"replica_groups — the collective allowlist cannot "
+                f"attribute it to a mesh axis")
+            continue
+        norm = frozenset(frozenset(g) for g in inst["groups"])
+        axes = table.get(norm)
+        if axes is None:
+            failures.append(
+                f"line {inst['line']}: {inst['kind']} over device groups "
+                f"{inst['groups']} matches no mesh-axis subset — not a "
+                f"reduction over declared axes")
+            continue
+        observed.add((inst["kind"], axes))
+    return frozenset(observed), failures
+
+
+def main_signature(text: str) -> Tuple[int, int, int]:
+    """(n_args, n_aliased, n_buffer_donor) of the entry @main function.
+
+    `tf.aliasing_output` is plain jit's donated-and-matched spelling;
+    `jax.buffer_donor` is the shard_map/deferred spelling.  A donated
+    leaf that JAX silently un-donated (shape/dtype mismatch against
+    every output) carries NEITHER — which is the bug this counts."""
+    m = _MAIN_SIG_RE.search(text)
+    if not m:
+        raise ValueError("no `func.func public @main(...)` entry "
+                         "signature in the lowered text")
+    sig = m.group(1)
+    return (len(re.findall(r"%arg\d+\s*:", sig)),
+            sig.count("tf.aliasing_output"),
+            sig.count("jax.buffer_donor"))
+
+
+def compiled_alias_count(compiled_text: str) -> int:
+    """Number of aliased parameters in an optimized HLO module's
+    ``input_output_alias={ {0}: (0, {}, may-alias), ... }`` header.
+
+    The table nests braces (`{0}` output indices, `{}` parameter
+    index paths), so the close brace is found by depth counting, not
+    regex."""
+    idx = compiled_text.find("input_output_alias={")
+    if idx < 0:
+        return 0
+    start = compiled_text.index("{", idx)
+    depth = 0
+    for j in range(start, len(compiled_text)):
+        c = compiled_text[j]
+        if c == "{":
+            depth += 1
+        elif c == "}":
+            depth -= 1
+            if depth == 0:
+                return len(re.findall(r"alias\)",
+                                      compiled_text[start:j]))
+    return 0
+
+
+# --------------------------------------------------------- shared checkers
+
+
+def audit_text(text: str, what: str, *, callbacks: int = 0,
+               donated_leaves: Optional[int] = None,
+               collectives: FrozenSet = frozenset(),
+               mesh_axes: Optional[Sequence[Tuple[str, int]]] = None,
+               plane_elems: Optional[int] = None) -> List[str]:
+    """Run every text-level contract over one lowered program.
+
+    `callbacks` — exact python-callback budget; `donated_leaves` — flat
+    donated-state leaf count (None: program is not donated; the audit
+    then asserts zero donation attrs, pinning the spelling);
+    `collectives`/`mesh_axes` — the declared (kind, axes) allowlist and
+    the audit mesh (None mesh: single-chip, zero collectives);
+    `plane_elems` — the unpacked [N, T] element count for the
+    all-gather plane guard."""
+    failures = []
+
+    got_cb = callback_calls(text)
+    if got_cb != callbacks:
+        failures.append(
+            f"{what}: {got_cb} host-callback custom call(s), contract "
+            f"says exactly {callbacks} — "
+            + ("an io_callback/debug print leaked into an off-path "
+               "program" if got_cb > callbacks else
+               "the declared tap vanished (stale contract?)"))
+    unknown = unknown_custom_calls(text)
+    if unknown:
+        failures.append(
+            f"{what}: undeclared custom-call target(s) "
+            f"{', '.join(unknown)} — extend BENIGN_CUSTOM_CALLS (or the "
+            f"program contract) only with a reviewed reason")
+
+    for v in dtype_violations(text, scalar_i64_ok=callbacks > 0):
+        failures.append(f"{what}: {v}")
+
+    if mesh_axes is None:
+        insts = collective_instances(text)
+        if insts:
+            kinds = Counter(i["kind"] for i in insts)
+            failures.append(
+                f"{what}: single-chip program contains collectives "
+                f"{dict(kinds)} — nothing may communicate here")
+    else:
+        observed, group_failures = observed_collectives(text, mesh_axes)
+        failures.extend(f"{what}: {g}" for g in group_failures)
+        # Subset check only here: one config's program legitimately
+        # lowers a subset of the manifest (async-only psums etc.);
+        # manifest STALENESS is `audit_sharded`'s union-equality job.
+        for kind, axes in sorted(observed - collectives):
+            failures.append(
+                f"{what}: UNDECLARED collective {kind} over axes "
+                f"{'/'.join(axes)} — the driver's DECLARED_COLLECTIVES "
+                f"manifest does not allow it")
+        if plane_elems is not None:
+            for inst in collective_instances(text):
+                if (inst["kind"] == "all_gather"
+                        and inst["elems"] is not None
+                        and inst["elems"] >= plane_elems):
+                    failures.append(
+                        f"{what}: line {inst['line']}: all_gather result "
+                        f"of {inst['elems']} elements >= the unpacked "
+                        f"[N, T] plane ({plane_elems}) — gathering a "
+                        f"full plane is the exact ICI blow-up the "
+                        f"packed-plane design exists to avoid")
+
+    n_args, aliased, donors = main_signature(text)
+    if donated_leaves is not None:
+        if n_args != donated_leaves:
+            failures.append(
+                f"{what}: entry signature has {n_args} args but the "
+                f"donated state pytree has {donated_leaves} leaves — "
+                f"the audit is looking at a different program")
+        if aliased + donors != n_args:
+            failures.append(
+                f"{what}: donation NOT honored — only {aliased + donors} "
+                f"of {n_args} donated args carry "
+                f"tf.aliasing_output/jax.buffer_donor (JAX silently "
+                f"un-donates on shape/dtype mismatch; the state "
+                f"double-buffers in HBM)")
+    elif aliased or donors:
+        failures.append(
+            f"{what}: {aliased + donors} arg(s) carry donation attrs "
+            f"but the contract says this program is NOT donated — "
+            f"update the contract if donation was added on purpose")
+    return failures
+
+
+# ------------------------------------------------------- pinned programs
+
+# Exact python-callback budget per pinned program (absent: 0).  The
+# metrics tap is ONE unordered io_callback under a round-mod cond.
+PINNED_CALLBACK_BUDGET: Dict[str, int] = {"flagship_metrics": 1}
+
+# Programs whose timed jit donates its state (everything except the
+# bare streaming step, which is lowered un-donated by design).
+PINNED_UNDONATED = frozenset({"streaming_step"})
+
+# Small-shape overrides for the compile-level donation proof: same
+# builder, same knobs, toy dims — compiling the 16384^2 program on a
+# gate box would dominate tier-1 for no extra information.
+_SMALL_DIMS = dict(nodes=64, txs=64, rounds=2)
+_SMALL_FLEET = dict(fleet=4, nodes=32, txs=32, rounds=2)
+_SMALL_TRAFFIC = dict(nodes=64, txs=256, window=64, rounds=4, rate=4.0)
+
+
+def small_workload(name: str) -> Dict:
+    """The pinned program's workload with dimensions shrunk to compile
+    shape (engine knobs untouched — the audit must compile the same
+    program FAMILY the pin hashes)."""
+    from benchmarks import hlo_pin
+
+    workload = dict(hlo_pin.PROGRAMS[name][0])
+    if name == "fleet_small":
+        workload.update(_SMALL_FLEET)
+    elif name == "flagship_traffic":
+        workload.update(_SMALL_TRAFFIC)
+    else:
+        workload.update(_SMALL_DIMS)
+    return workload
+
+
+def pinned_donated_leaves(name: str, workload: Dict) -> int:
+    """Flat leaf count of the state pytree the pinned program donates
+    (eval_shape through the same `benchmarks/workload` builders the
+    lowering uses — the PROGRAM_BUILDERS seam)."""
+    import jax
+
+    from benchmarks import workload as wl
+
+    if name == "fleet_small":
+        state = jax.eval_shape(lambda: wl.fleet_flagship_state(
+            workload["fleet"], workload["nodes"], workload["txs"],
+            workload["k"])[0])
+    elif name == "flagship_traffic":
+        state = jax.eval_shape(lambda: wl.traffic_backlog_state(
+            workload["nodes"], workload["txs"], workload["window"],
+            workload["k"], workload["rate"])[0])
+    else:
+        state = jax.eval_shape(lambda: wl.flagship_state(
+            workload["nodes"], workload["txs"], workload["k"],
+            workload.get("latency", 0),
+            inflight_engine=workload.get("inflight", "walk"),
+            trace_every=workload.get("trace_every", 0),
+            trace_rounds=workload["rounds"],
+            stake=workload.get("stake", "off"),
+            clusters=workload.get("clusters", 1))[0])
+    return len(jax.tree.leaves(state))
+
+
+def audit_pinned(name: str, workload: Optional[Dict] = None) -> List[str]:
+    """Text-level contract audit of one pinned program at its archived
+    workload (lowering shared with the drift test via
+    `hlo_pin.program_text`'s cache — the audit costs no extra
+    lowering)."""
+    from benchmarks import hlo_pin
+
+    workload = dict(workload or hlo_pin.PROGRAMS[name][0])
+    text = hlo_pin.program_text(name, workload)
+    donated = (None if name in PINNED_UNDONATED
+               else pinned_donated_leaves(name, workload))
+    return audit_text(
+        text, f"{name}",
+        callbacks=PINNED_CALLBACK_BUDGET.get(name, 0),
+        donated_leaves=donated)
+
+
+def audit_all_pinned(archive: Optional[Dict] = None) -> List[str]:
+    """Audit every archived pin (archived workload when present)."""
+    from benchmarks import hlo_pin
+
+    archive = archive or hlo_pin._load_archive()
+    failures = []
+    for name, entry in sorted(archive.get("programs", {}).items()):
+        if name not in hlo_pin.PROGRAMS:
+            continue  # --stale owns that failure
+        failures.extend(audit_pinned(name, entry.get("workload")))
+    return failures
+
+
+def audit_donation_compiled(name: str) -> List[str]:
+    """Compile the pinned program at audit shape and prove the
+    executable's ``input_output_alias`` covers every donated leaf —
+    the compile-level half of the donation audit (lowered attrs can in
+    principle be dropped by XLA; the alias table is what the runtime
+    acts on)."""
+    from benchmarks import hlo_pin
+
+    if name in PINNED_UNDONATED:
+        return []
+    workload = small_workload(name)
+    _, builder = hlo_pin.PROGRAMS[name]
+    text = builder(workload)
+    leaves = pinned_donated_leaves(name, workload)
+    failures = audit_text(hlo_pin.strip_locations(text),
+                          f"{name}@audit-shape",
+                          callbacks=PINNED_CALLBACK_BUDGET.get(name, 0),
+                          donated_leaves=leaves)
+    compiled = _compile_pinned(name, workload)
+    aliased = compiled_alias_count(compiled)
+    if aliased != leaves:
+        failures.append(
+            f"{name}@audit-shape: compiled input_output_alias covers "
+            f"{aliased} of {leaves} donated leaves — the executable "
+            f"double-buffers the rest (ROADMAP donation-soak contract)")
+    return failures
+
+
+def _compile_pinned(name: str, workload: Dict) -> str:
+    """Optimized-HLO text of the pinned program compiled at `workload`
+    shape (mirrors the lowering spelling in benchmarks/hlo_pin.py, but
+    keeps the Lowered object so `.compile()` is available)."""
+    import dataclasses as _dc
+
+    import jax
+
+    import bench
+    from benchmarks.workload import (
+        flagship_config,
+        flagship_state,
+        fleet_flagship_state,
+        traffic_backlog_state,
+        traffic_config,
+    )
+
+    if name == "fleet_small":
+        cfg = flagship_config(workload["txs"], workload["k"])
+        state_abs = jax.eval_shape(lambda: fleet_flagship_state(
+            workload["fleet"], workload["nodes"], workload["txs"],
+            workload["k"])[0])
+        lowered = bench.fleet_program(cfg, workload["rounds"],
+                                      workload["fleet"]).lower(state_abs)
+    elif name == "flagship_traffic":
+        cfg = traffic_config(workload["window"], workload["k"],
+                             workload["rate"])
+        state_abs = jax.eval_shape(lambda: traffic_backlog_state(
+            workload["nodes"], workload["txs"], workload["window"],
+            workload["k"], workload["rate"])[0])
+        lowered = bench.traffic_program(cfg,
+                                        workload["rounds"]).lower(state_abs)
+    else:
+        cfg = flagship_config(workload["txs"], workload["k"],
+                              workload.get("latency", 0),
+                              inflight_engine=workload.get("inflight",
+                                                           "walk"),
+                              metrics_every=workload.get("metrics_every",
+                                                         0),
+                              trace_every=workload.get("trace_every", 0),
+                              stake=workload.get("stake", "off"),
+                              clusters=workload.get("clusters", 1))
+        if workload.get("exchange", "fused") != "fused":
+            cfg = _dc.replace(cfg, fused_exchange=False)
+        if workload.get("ingest", "u8") != "u8":
+            cfg = _dc.replace(cfg, ingest_engine=workload["ingest"])
+        if workload.get("faults") is not None:
+            from go_avalanche_tpu.config import fault_script_from_json
+
+            cfg = _dc.replace(cfg, fault_script=fault_script_from_json(
+                workload["faults"]))
+        state_abs = jax.eval_shape(lambda: flagship_state(
+            workload["nodes"], workload["txs"], workload["k"],
+            workload.get("latency", 0),
+            inflight_engine=workload.get("inflight", "walk"),
+            trace_every=workload.get("trace_every", 0),
+            trace_rounds=workload["rounds"])[0])
+        lowered = bench.flagship_program(cfg,
+                                         workload["rounds"]).lower(state_abs)
+    return lowered.compile().as_text()
+
+
+def audit_off_path(platform: str, archive: Optional[Dict] = None
+                   ) -> List[str]:
+    """The semantic half of `hlo_pin --verify-off-path`: each off-path
+    flagship program, re-lowered with every tap/script/stake knob
+    forced off, must contain ZERO host callbacks, zero collectives, a
+    clean dtype budget and full donation coverage — not merely the
+    archived hash.  (Hash equality already proves byte-identity; this
+    proves the byte-identical program IS callback-free, so a future
+    re-pin cannot silently bless a leaked tap.)"""
+    from benchmarks import hlo_pin
+
+    archive = archive or hlo_pin._load_archive()
+    failures = []
+    for name in hlo_pin.OFF_PATH_PROGRAMS:
+        entry = archive.get("programs", {}).get(name)
+        if not entry or entry.get("hashes", {}).get(platform) is None:
+            continue
+        workload = dict(entry.get("workload")
+                        or hlo_pin.PROGRAMS[name][0])
+        workload.update(metrics_every=0, trace_every=0, faults=[],
+                        stake="off")
+        failures.extend(audit_pinned(name, workload))
+    return failures
+
+
+# ------------------------------------------------------- sharded drivers
+
+SHARDED_DRIVERS = ("avalanche", "dag", "backlog", "streaming_dag",
+                   "node_stream")
+
+
+class AuditUnavailable(RuntimeError):
+    """The audit cannot run in this environment (e.g. fewer than 4
+    devices for the 2x2 collective-attribution mesh)."""
+
+
+def _audit_mesh():
+    """A 2x2 (nodes, txs) mesh over the first 4 devices: small, and
+    every axis subset produces a DISTINCT replica grouping, so
+    collective attribution is unambiguous."""
+    import jax
+
+    from go_avalanche_tpu.parallel.mesh import make_mesh
+
+    devices = jax.devices()
+    if len(devices) < 4:
+        raise AuditUnavailable(
+            f"the sharded-driver audit needs >= 4 devices for its 2x2 "
+            f"mesh, found {len(devices)} — run under the tier-1 "
+            f"harness (8 virtual CPU devices) or on hardware")
+    return make_mesh(n_node_shards=2, n_tx_shards=2,
+                     devices=devices[:4])
+
+
+# The async audit knobs: a 1-round fixed latency with a 4-round timeout
+# turns the in-flight ring on, whose counters are the node-axis psums
+# several manifests declare — the async VARIANT below proves those
+# entries are live, not stale.
+_ASYNC_KW = dict(latency_mode="fixed", latency_rounds=1, time_step_s=1.0,
+                 request_timeout_s=3.0)
+
+
+def _sharded_case(driver: str):
+    """(variants, declared manifest, [N, T] plane elements) for one
+    sharded driver at audit shape — variants are ``(label,
+    program_builder(mesh), abstract state)`` triples; the base variant
+    comes first (the compile-donation one), an async variant follows
+    where the manifest declares async-only collectives.  States come
+    from `jax.eval_shape` over the dense inits — nothing allocates."""
+    import jax
+    import jax.numpy as jnp
+
+    from go_avalanche_tpu.config import AdversaryStrategy, AvalancheConfig
+
+    key = jax.random.key(0)
+    if driver == "avalanche":
+        from go_avalanche_tpu.models import avalanche as av
+        from go_avalanche_tpu.parallel import sharded as drv
+
+        def variant(label, cfg):
+            state = jax.eval_shape(lambda: av.init(key, 16, 8, cfg))
+            return (label,
+                    lambda mesh: drv.scan_program(mesh, state, cfg,
+                                                  n_rounds=2,
+                                                  donate=True),
+                    state)
+
+        # The async+adversary variant exercises the ring counters AND
+        # the minority-plane psum — the manifest's nodes-axis
+        # all_reduce entries.
+        variants = [
+            variant("base", AvalancheConfig()),
+            variant("async", AvalancheConfig(
+                byzantine_fraction=0.25,
+                adversary_strategy=AdversaryStrategy.OPPOSE_MAJORITY,
+                **_ASYNC_KW)),
+        ]
+        return variants, drv.DECLARED_COLLECTIVES, 16 * 8
+    if driver == "dag":
+        from go_avalanche_tpu.models import dag as dag_model
+        from go_avalanche_tpu.parallel import sharded_dag as drv
+
+        cs = jnp.arange(8, dtype=jnp.int32) // 2
+
+        def variant(label, cfg):
+            # n_sets/set_size passed explicitly (the fleet's spelling)
+            # so init stays abstract under eval_shape.
+            state = jax.eval_shape(lambda: dag_model.init(
+                key, 16, cs, cfg, n_sets=4, set_size=2))
+            return (label,
+                    lambda mesh: drv.settle_program(mesh, state, cfg,
+                                                    max_rounds=8,
+                                                    donate=True),
+                    state)
+
+        variants = [variant("base", AvalancheConfig()),
+                    variant("async", AvalancheConfig(**_ASYNC_KW))]
+        return variants, drv.DECLARED_COLLECTIVES, 16 * 8
+    if driver == "backlog":
+        from go_avalanche_tpu.models import backlog as bl
+        from go_avalanche_tpu.parallel import sharded_backlog as drv
+
+        cfg = AvalancheConfig()
+        state = jax.eval_shape(lambda: bl.init(
+            key, 16, 8, bl.make_backlog(jnp.arange(32, dtype=jnp.int32)),
+            cfg))
+        variants = [("base",
+                     lambda mesh: drv.scan_program(mesh, state, cfg,
+                                                   n_rounds=2,
+                                                   donate=True),
+                     state)]
+        return variants, drv.DECLARED_COLLECTIVES, 16 * 8
+    if driver == "streaming_dag":
+        from go_avalanche_tpu.models import streaming_dag as sdg
+        from go_avalanche_tpu.parallel import sharded_streaming_dag as drv
+
+        cfg = AvalancheConfig()
+        backlog = sdg.make_set_backlog(
+            jnp.arange(32, dtype=jnp.int32).reshape(16, 2))
+        state = jax.eval_shape(lambda: sdg.init(key, 16, 8, backlog, cfg))
+        variants = [("base",
+                     lambda mesh: drv.scan_program(mesh, state, cfg,
+                                                   n_rounds=2,
+                                                   donate=True),
+                     state)]
+        return variants, drv.DECLARED_COLLECTIVES, 16 * 16
+    if driver == "node_stream":
+        from go_avalanche_tpu.models import node_stream as ns
+        from go_avalanche_tpu.parallel import sharded_node_stream as drv
+
+        def variant(label, cfg):
+            state = jax.eval_shape(lambda: ns.init(key, 8, cfg))
+            return (label,
+                    lambda mesh: drv.scan_program(mesh, state, cfg,
+                                                  n_rounds=2,
+                                                  donate=True),
+                    state)
+
+        ns_kw = dict(stake_mode="zipf", registry_nodes=32,
+                     active_nodes=16, node_churn_rate=0.25)
+        variants = [
+            variant("base", AvalancheConfig(**ns_kw)),
+            variant("async", AvalancheConfig(**ns_kw, **_ASYNC_KW)),
+        ]
+        return variants, drv.DECLARED_COLLECTIVES, 16 * 8
+    raise ValueError(f"unknown sharded driver {driver!r}; drivers: "
+                     f"{', '.join(SHARDED_DRIVERS)}")
+
+
+def audit_sharded(driver: str, compile_donation: bool = False
+                  ) -> List[str]:
+    """Full contract audit of one sharded driver on the 2x2 audit mesh.
+
+    Per variant (base + async where the manifest declares async-only
+    collectives): observed collectives ⊆ `DECLARED_COLLECTIVES`, the
+    all-gather plane guard, dtype budget, zero callbacks, donated-leaf
+    coverage.  Across ALL variants: the union of observed collectives
+    must EQUAL the manifest — a declared pair no audit variant lowers
+    is a stale entry and fails.  `compile_donation=True` additionally
+    compiles the base variant and proves the executable's
+    ``input_output_alias`` coverage."""
+    import jax
+
+    from benchmarks.hlo_pin import strip_locations
+    from go_avalanche_tpu.parallel.mesh import NODES_AXIS, TXS_AXIS
+
+    mesh = _audit_mesh()
+    variants, declared, plane_elems = _sharded_case(driver)
+    mesh_axes = [(NODES_AXIS, mesh.shape[NODES_AXIS]),
+                 (TXS_AXIS, mesh.shape[TXS_AXIS])]
+    failures: List[str] = []
+    union: set = set()
+    for i, (label, program, state) in enumerate(variants):
+        what = f"sharded:{driver}[{label}]"
+        lowered = program(mesh).lower(state)
+        text = strip_locations(lowered.as_text())
+        leaves = len(jax.tree.leaves(state))
+        # The shared checker owns the contracts (subset allowlist,
+        # plane guard, dtype, callbacks, donation); only the
+        # cross-variant union below is this function's own.
+        failures.extend(audit_text(
+            text, what, callbacks=0, donated_leaves=leaves,
+            collectives=declared, mesh_axes=mesh_axes,
+            plane_elems=plane_elems))
+        observed, _ = observed_collectives(text, mesh_axes)
+        union |= observed
+        if compile_donation and i == 0:
+            c_aliased = compiled_alias_count(lowered.compile().as_text())
+            if c_aliased != leaves:
+                failures.append(
+                    f"{what}: compiled input_output_alias covers "
+                    f"{c_aliased} of {leaves} donated leaves — the "
+                    f"sharded state double-buffers the rest (the "
+                    f"donation-under-shard_map soak, statically)")
+    for kind, axes in sorted(declared - union):
+        failures.append(
+            f"sharded:{driver}: declared collective {kind} over axes "
+            f"{'/'.join(axes)} never lowered in any audit variant — "
+            f"stale manifest entry")
+    return failures
+
+
+def audit_all_sharded(compile_donation: bool = False) -> List[str]:
+    failures = []
+    for driver in SHARDED_DRIVERS:
+        failures.extend(audit_sharded(driver, compile_donation))
+    return failures
+
+
+# --------------------------------------------------------- run_sim audit
+
+
+def audit_run_sim(args, cfg) -> List[str]:
+    """`run_sim --audit`: lower the EXACT program the parsed flags
+    select — same model entry point, same statics, same donation — and
+    run the text-level contracts before the runner executes it.
+
+    Fleet audits lower through `fleet._compiled_fleet`'s lru-cached jit,
+    so the subsequent execution compiles the audited program exactly
+    once (lowering never compiles).  The parser has already rejected
+    the combinations with no single-program meaning (--phase-grid,
+    --check-invariants, --chunk)."""
+    import jax
+
+    from benchmarks.hlo_pin import strip_locations
+
+    callbacks = 1 if cfg.metrics_every > 0 else 0
+    what = f"run_sim:{args.model}"
+
+    if args.fleet is not None:
+        from go_avalanche_tpu import fleet as fl
+
+        keys_abs = jax.eval_shape(
+            lambda: jax.random.split(jax.random.key(args.seed),
+                                     args.fleet))
+        jitted = fl._compiled_fleet(
+            args.model, cfg, int(args.nodes), int(args.txs),
+            int(args.max_rounds), int(args.conflict_size),
+            float(args.yes_fraction), bool(args.contested),
+            int(args.slots))
+        text = strip_locations(jitted.lower(keys_abs).as_text())
+        return audit_text(text, f"{what}@fleet{args.fleet}",
+                          callbacks=0, donated_leaves=None)
+
+    if args.mesh:
+        from go_avalanche_tpu.parallel.mesh import (
+            NODES_AXIS,
+            TXS_AXIS,
+        )
+
+        mesh, program, state = _run_sim_mesh_program(args, cfg)
+        text = strip_locations(program.lower(state).as_text())
+        declared = _driver_manifest(args.model)
+        mesh_axes = [(NODES_AXIS, mesh.shape[NODES_AXIS]),
+                     (TXS_AXIS, mesh.shape[TXS_AXIS])]
+        # Partition-based coverage: the user's mesh can be degenerate
+        # (a size-1 axis makes axis subsets indistinguishable), so the
+        # allowlist compares replica groupings, never axis names.
+        failures = collective_coverage_failures(text, declared,
+                                                mesh_axes, what)
+        failures.extend(
+            f"{what}: {v}"
+            for v in dtype_violations(text, scalar_i64_ok=False))
+        got_cb = callback_calls(text)
+        if got_cb:
+            failures.append(
+                f"{what}: {got_cb} host-callback custom call(s) inside "
+                f"a sharded program — io_callback is illegal under "
+                f"shard_map here")
+        if args.donate:
+            leaves = len(jax.tree.leaves(state))
+            n_args, aliased, donors = main_signature(text)
+            if aliased + donors != n_args or n_args != leaves:
+                failures.append(
+                    f"{what}: --donate requested but only "
+                    f"{aliased + donors} of {n_args} args (for {leaves} "
+                    f"leaves) carry donation attrs")
+        return failures
+
+    program, state = _run_sim_dense_program(args, cfg)
+    text = strip_locations(program.lower(state).as_text())
+    donated = (len(jax.tree.leaves(state))
+               if args.model == "avalanche" else None)
+    return audit_text(text, what, callbacks=callbacks,
+                      donated_leaves=donated)
+
+
+def _run_sim_dense_program(args, cfg):
+    """(jitted program, abstract state) for a dense run_sim selection —
+    the same entry point + statics each runner calls."""
+    import jax
+    import jax.numpy as jnp
+
+    key = jax.random.key(args.seed)
+    model = args.model
+    if model in ("slush", "snowflake"):
+        from go_avalanche_tpu.models import family as fam
+
+        if model == "slush":
+            state = jax.eval_shape(lambda: fam.slush_init(
+                key, args.nodes, cfg, yes_fraction=args.yes_fraction))
+            program = jax.jit(fam.slush_run,
+                              static_argnames=("cfg", "m_rounds"))
+            return _bind(program, cfg, m_rounds=args.max_rounds), state
+        state = jax.eval_shape(lambda: fam.snowflake_init(
+            key, args.nodes, cfg, yes_fraction=args.yes_fraction))
+        program = jax.jit(fam.snowflake_run,
+                          static_argnames=("cfg", "max_rounds"))
+        return _bind(program, cfg, max_rounds=args.max_rounds), state
+    if model == "snowball":
+        from go_avalanche_tpu.models import snowball as sb
+
+        state = jax.eval_shape(lambda: sb.with_trace(
+            sb.init(key, args.nodes, cfg,
+                    yes_fraction=args.yes_fraction), cfg,
+            args.max_rounds))
+        program = jax.jit(sb.run, static_argnames=("cfg", "max_rounds"))
+        return _bind(program, cfg, max_rounds=args.max_rounds), state
+    if model == "avalanche":
+        from go_avalanche_tpu.models import avalanche as av
+
+        init_pref = (av.contested_init_pref(args.seed, args.nodes,
+                                            args.txs)
+                     if args.contested else None)
+        state = jax.eval_shape(lambda: av.with_trace(
+            av.init(key, args.nodes, args.txs, cfg,
+                    init_pref=init_pref), cfg, args.max_rounds))
+        # THE lru-cached jit `av.run(donate=True)` executes.
+        return av._compiled_run(cfg, int(args.max_rounds), True), state
+    if model == "dag":
+        from go_avalanche_tpu.models import dag as dag_model
+
+        cs = jnp.arange(args.txs, dtype=jnp.int32) // args.conflict_size
+        state = jax.eval_shape(lambda: dag_model.with_trace(
+            dag_model.init(key, args.nodes, cs, cfg), cfg,
+            args.max_rounds))
+        program = jax.jit(dag_model.run,
+                          static_argnames=("cfg", "max_rounds"))
+        return _bind(program, cfg, max_rounds=args.max_rounds), state
+    if model == "backlog":
+        from go_avalanche_tpu.models import backlog as bl
+
+        state = jax.eval_shape(lambda: bl.with_trace(
+            bl.init(key, args.nodes, args.slots,
+                    bl.make_backlog(jnp.arange(args.txs,
+                                               dtype=jnp.int32)), cfg),
+            cfg, args.max_rounds))
+        program = jax.jit(bl.run, static_argnames=("cfg", "max_rounds"))
+        return _bind(program, cfg, max_rounds=args.max_rounds), state
+    if model == "streaming_dag":
+        from go_avalanche_tpu.models import streaming_dag as sdg
+
+        c = args.conflict_size
+        n_sets = args.txs // c
+        backlog = sdg.make_set_backlog(
+            jnp.arange(args.txs, dtype=jnp.int32).reshape(n_sets, c))
+        state = jax.eval_shape(lambda: sdg.with_trace(
+            sdg.init(key, args.nodes, args.slots, backlog, cfg), cfg,
+            args.max_rounds))
+        program = jax.jit(sdg.run, static_argnames=("cfg", "max_rounds"))
+        return _bind(program, cfg, max_rounds=args.max_rounds), state
+    if model == "node_stream":
+        from go_avalanche_tpu.models import node_stream as ns
+
+        state = jax.eval_shape(lambda: ns.with_trace(
+            ns.init(key, args.txs, cfg), cfg, args.max_rounds))
+        program = jax.jit(ns.run_scan,
+                          static_argnames=("cfg", "n_rounds"))
+        return _bind(program, cfg, n_rounds=args.max_rounds), state
+    raise ValueError(f"no audit program for model {args.model!r}")
+
+
+class _Bound:
+    """A jitted (state, **statics) program partially applied to its
+    statics so the audit's `.lower(state)` spelling is uniform."""
+
+    def __init__(self, jitted, cfg, **statics):
+        self._jitted, self._cfg, self._statics = jitted, cfg, statics
+
+    def lower(self, state):
+        return self._jitted.lower(state, self._cfg, **self._statics)
+
+
+def _bind(jitted, cfg, **statics) -> _Bound:
+    return _Bound(jitted, cfg, **statics)
+
+
+def _driver_manifest(model: str) -> FrozenSet:
+    from go_avalanche_tpu.parallel import (
+        sharded,
+        sharded_backlog,
+        sharded_dag,
+        sharded_node_stream,
+        sharded_streaming_dag,
+    )
+
+    return {
+        "avalanche": sharded.DECLARED_COLLECTIVES,
+        "dag": sharded_dag.DECLARED_COLLECTIVES,
+        "backlog": sharded_backlog.DECLARED_COLLECTIVES,
+        "streaming_dag": sharded_streaming_dag.DECLARED_COLLECTIVES,
+        "node_stream": sharded_node_stream.DECLARED_COLLECTIVES,
+    }[model]
+
+
+def _run_sim_mesh_program(args, cfg):
+    """(mesh, jitted program, abstract state) for a --mesh selection —
+    the exact driver program seam each mesh runner executes."""
+    import jax
+    import jax.numpy as jnp
+
+    from go_avalanche_tpu.parallel.mesh import make_mesh
+
+    n_shards, t_shards = (int(x) for x in args.mesh.split(","))
+    mesh = make_mesh(n_node_shards=n_shards, n_tx_shards=t_shards)
+    key = jax.random.key(args.seed)
+    model = args.model
+    if model == "avalanche":
+        from go_avalanche_tpu.models import avalanche as av
+        from go_avalanche_tpu.parallel import sharded as drv
+
+        init_pref = (av.contested_init_pref(args.seed, args.nodes,
+                                            args.txs)
+                     if args.contested else None)
+        state = jax.eval_shape(lambda: av.with_trace(
+            av.init(key, args.nodes, args.txs, cfg,
+                    init_pref=init_pref), cfg, args.max_rounds))
+        return mesh, drv.settle_program(
+            mesh, state, cfg, max_rounds=args.max_rounds,
+            donate=args.donate), state
+    if model == "dag":
+        from go_avalanche_tpu.models import dag as dag_model
+        from go_avalanche_tpu.parallel import sharded_dag as drv
+
+        cs = jnp.arange(args.txs, dtype=jnp.int32) // args.conflict_size
+        state = jax.eval_shape(lambda: dag_model.with_trace(
+            dag_model.init(key, args.nodes, cs, cfg), cfg,
+            args.max_rounds))
+        return mesh, drv.settle_program(
+            mesh, state, cfg, max_rounds=args.max_rounds,
+            donate=args.donate), state
+    if model == "backlog":
+        from go_avalanche_tpu.models import backlog as bl
+        from go_avalanche_tpu.parallel import sharded_backlog as drv
+
+        state = jax.eval_shape(lambda: bl.with_trace(
+            bl.init(key, args.nodes, args.slots,
+                    bl.make_backlog(jnp.arange(args.txs,
+                                               dtype=jnp.int32)), cfg),
+            cfg, args.max_rounds))
+        return mesh, drv.settle_program(
+            mesh, state, cfg, max_rounds=args.max_rounds,
+            donate=args.donate), state
+    if model == "streaming_dag":
+        from go_avalanche_tpu.models import streaming_dag as sdg
+        from go_avalanche_tpu.parallel import sharded_streaming_dag as drv
+
+        c = args.conflict_size
+        backlog = sdg.make_set_backlog(
+            jnp.arange(args.txs, dtype=jnp.int32).reshape(
+                args.txs // c, c))
+        state = jax.eval_shape(lambda: sdg.with_trace(
+            sdg.init(key, args.nodes, args.slots, backlog, cfg), cfg,
+            args.max_rounds))
+        return mesh, drv.settle_program(
+            mesh, state, cfg, max_rounds=args.max_rounds,
+            donate=args.donate), state
+    if model == "node_stream":
+        from go_avalanche_tpu.models import node_stream as ns
+        from go_avalanche_tpu.parallel import sharded_node_stream as drv
+
+        state = jax.eval_shape(lambda: ns.with_trace(
+            ns.init(key, args.txs, cfg), cfg, args.max_rounds))
+        return mesh, drv.scan_program(
+            mesh, state, cfg, n_rounds=args.max_rounds,
+            donate=args.donate), state
+    raise ValueError(f"no sharded audit program for model {args.model!r}")

@@ -1,0 +1,315 @@
+"""Repo-convention AST linter: the one-spelling rules reviews keep re-fixing.
+
+Four rules, each with a canonical-location table, an allowlist and a
+PINNED violation message (tests/test_analysis.py asserts the exact
+wording — a drifted message is itself a violation of the one-spelling
+idea).  Pure stdlib `ast` — linting never imports jax, so the CLI's
+lint subcommand runs anywhere.
+
+  canonical-spelling   `cluster_of` / `tag_from_config` /
+                       `suppress_taps` / `draw_churn_swaps` are bound
+                       in exactly one module each and imported from
+                       there (or a declared re-exporter) only.  Any
+                       other def / assignment / import-source is a
+                       drifted copy waiting to diverge (the
+                       suppress_taps double-emit class).
+  config-jax-free      `config.py` validators (`_validate_*`,
+                       `__post_init__`) never touch `jax` / `jnp`, and
+                       the module never imports jax: AvalancheConfig
+                       is a hashable jit-STATIC — validation must not
+                       trace.
+  host-rng-in-traced   no `np.random` / `random` module use in traced
+                       model/ops/parallel code: every draw comes from
+                       the jax PRNG key plane (host RNG breaks vmap
+                       determinism and the fleet's per-trial key
+                       contract).  Host-side control-plane modules
+                       (processor/net/connector) are out of scope by
+                       construction.
+  debug-print          no `jax.debug.print` / `jax.debug.breakpoint`
+                       in library modules: telemetry flows through the
+                       obs planes (metrics tap / trace plane), never
+                       ad-hoc prints in compiled code.
+
+Adding a rule: give it an id + pinned message here, a fixture test in
+tests/test_analysis.py (one planted violation, one clean positive),
+and a row in docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Set
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+# ---------------------------------------------------------------- rule tables
+
+# name -> the ONE module (repo-relative posix path) allowed to bind it.
+CANONICAL_MODULES: Dict[str, str] = {
+    "cluster_of": "go_avalanche_tpu/ops/sampling.py",
+    "tag_from_config": "go_avalanche_tpu/obs/tags.py",
+    "suppress_taps": "go_avalanche_tpu/config.py",
+    "draw_churn_swaps": "go_avalanche_tpu/models/node_stream.py",
+}
+
+# name -> module paths an `from X import name` may name.  The obs
+# package __init__ is the one declared re-exporter: its own import of
+# `tag_from_config` is covered by the tags entry below, and importing
+# from the package is canonical for everyone else — a DEF or assignment
+# of the name there is still a drifted copy and still flags.
+ALLOWED_IMPORT_SOURCES: Dict[str, Set[str]] = {
+    "cluster_of": {"go_avalanche_tpu.ops.sampling"},
+    "tag_from_config": {"go_avalanche_tpu.obs.tags", "go_avalanche_tpu.obs"},
+    "suppress_taps": {"go_avalanche_tpu.config"},
+    "draw_churn_swaps": {"go_avalanche_tpu.models.node_stream"},
+}
+
+# Traced library scope for host-rng-in-traced: directories (prefix
+# match) + single files.
+TRACED_SCOPE_PREFIXES = (
+    "go_avalanche_tpu/models/",
+    "go_avalanche_tpu/ops/",
+    "go_avalanche_tpu/parallel/",
+)
+TRACED_SCOPE_FILES = {
+    "go_avalanche_tpu/traffic.py",
+    "go_avalanche_tpu/stake.py",
+    "go_avalanche_tpu/fleet.py",
+    "go_avalanche_tpu/obs/trace.py",
+}
+
+# Library scope for debug-print: the whole package.
+LIBRARY_SCOPE_PREFIX = "go_avalanche_tpu/"
+
+# Per-rule allowlist: rule -> set of repo-relative files exempted.
+# Keep empty unless a reviewed exception exists; every entry needs a
+# docs/static_analysis.md row saying why.
+ALLOWLIST: Dict[str, Set[str]] = {
+    "canonical-spelling": set(),
+    "config-jax-free": set(),
+    "host-rng-in-traced": set(),
+    "debug-print": set(),
+}
+
+_MSG_CANONICAL = ("{name} has ONE spelling — bind/import it from "
+                  "{canonical} only (a drifted copy diverges silently; "
+                  "docs/static_analysis.md)")
+_MSG_CONFIG_JAX = ("config validators must stay jax-free: "
+                   "AvalancheConfig is a hashable jit-STATIC and "
+                   "validation must never trace (use plain python/math)")
+_MSG_HOST_RNG = ("host RNG in traced code: models/ops/parallel draw "
+                 "ONLY from the jax PRNG key plane (np.random / the "
+                 "random module break vmap determinism and the fleet's "
+                 "per-trial key contract)")
+_MSG_DEBUG_PRINT = ("jax.debug.{attr} in a library module: telemetry "
+                    "flows through the obs planes (metrics tap / trace "
+                    "plane), never ad-hoc prints in compiled code")
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    path: str      # repo-relative posix path
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def _allowed(rule: str, rel: str) -> bool:
+    return rel in ALLOWLIST.get(rule, ())
+
+
+# ------------------------------------------------------------ rule visitors
+
+
+def _canonical_spelling(tree: ast.AST, rel: str) -> List[Violation]:
+    out: List[Violation] = []
+
+    def flag(name: str, line: int) -> None:
+        out.append(Violation(rel, line, "canonical-spelling",
+                             _MSG_CANONICAL.format(
+                                 name=name,
+                                 canonical=CANONICAL_MODULES[name])))
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            if (node.name in CANONICAL_MODULES
+                    and rel != CANONICAL_MODULES[node.name]):
+                flag(node.name, node.lineno)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                args = node.args
+                for a in (*args.posonlyargs, *args.args, *args.kwonlyargs,
+                          *filter(None, (args.vararg, args.kwarg))):
+                    if (a.arg in CANONICAL_MODULES
+                            and rel != CANONICAL_MODULES[a.arg]):
+                        flag(a.arg, a.lineno)
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            if (node.id in CANONICAL_MODULES
+                    and rel != CANONICAL_MODULES[node.id]):
+                flag(node.id, node.lineno)
+        elif isinstance(node, ast.ImportFrom):
+            module = node.module or ""
+            for alias in node.names:
+                bound = alias.asname or alias.name
+                if bound not in CANONICAL_MODULES:
+                    continue
+                name = bound
+                if rel == CANONICAL_MODULES[name]:
+                    continue
+                ok_sources = ALLOWED_IMPORT_SOURCES.get(name, set())
+                renamed = alias.asname is not None and alias.name != name
+                if renamed or module not in ok_sources:
+                    flag(name, node.lineno)
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if (alias.asname in CANONICAL_MODULES
+                        and rel != CANONICAL_MODULES[alias.asname]):
+                    flag(alias.asname, node.lineno)
+    return out
+
+
+def _config_jax_free(tree: ast.AST, rel: str) -> List[Violation]:
+    if rel != "go_avalanche_tpu/config.py":
+        return []
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "jax" or alias.name.startswith("jax."):
+                    out.append(Violation(rel, node.lineno,
+                                         "config-jax-free",
+                                         _MSG_CONFIG_JAX))
+        elif isinstance(node, ast.ImportFrom):
+            if (node.module or "").split(".")[0] == "jax":
+                out.append(Violation(rel, node.lineno, "config-jax-free",
+                                     _MSG_CONFIG_JAX))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if not (node.name.startswith("_validate")
+                    or node.name == "__post_init__"):
+                continue
+            for inner in ast.walk(node):
+                if (isinstance(inner, ast.Name)
+                        and isinstance(inner.ctx, ast.Load)
+                        and inner.id in ("jax", "jnp")):
+                    out.append(Violation(rel, inner.lineno,
+                                         "config-jax-free",
+                                         _MSG_CONFIG_JAX))
+    return out
+
+
+def _in_traced_scope(rel: str) -> bool:
+    return (rel in TRACED_SCOPE_FILES
+            or any(rel.startswith(p) for p in TRACED_SCOPE_PREFIXES))
+
+
+def _host_rng_in_traced(tree: ast.AST, rel: str) -> List[Violation]:
+    if not _in_traced_scope(rel):
+        return []
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "random":
+                    out.append(Violation(rel, node.lineno,
+                                         "host-rng-in-traced",
+                                         _MSG_HOST_RNG))
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "random":
+                out.append(Violation(rel, node.lineno,
+                                     "host-rng-in-traced", _MSG_HOST_RNG))
+        elif isinstance(node, ast.Attribute):
+            if (node.attr == "random" and isinstance(node.value, ast.Name)
+                    and node.value.id in ("np", "numpy")):
+                out.append(Violation(rel, node.lineno,
+                                     "host-rng-in-traced", _MSG_HOST_RNG))
+    return out
+
+
+def _debug_print(tree: ast.AST, rel: str) -> List[Violation]:
+    if not rel.startswith(LIBRARY_SCOPE_PREFIX):
+        return []
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Attribute)
+                and node.attr in ("print", "breakpoint")
+                and isinstance(node.value, ast.Attribute)
+                and node.value.attr == "debug"
+                and isinstance(node.value.value, ast.Name)
+                and node.value.value.id == "jax"):
+            out.append(Violation(
+                rel, node.lineno, "debug-print",
+                _MSG_DEBUG_PRINT.format(attr=node.attr)))
+    return out
+
+
+_RULES = (
+    ("canonical-spelling", _canonical_spelling),
+    ("config-jax-free", _config_jax_free),
+    ("host-rng-in-traced", _host_rng_in_traced),
+    ("debug-print", _debug_print),
+)
+
+RULE_IDS = tuple(rule for rule, _ in _RULES)
+
+
+# ----------------------------------------------------------------- drivers
+
+
+def lint_source(src: str, rel: str) -> List[Violation]:
+    """Lint one file's SOURCE under its repo-relative posix path —
+    the unit tests' fixture entry point."""
+    try:
+        tree = ast.parse(src, filename=rel)
+    except SyntaxError as e:
+        return [Violation(rel, e.lineno or 0, "parse-error",
+                          f"file does not parse: {e.msg}")]
+    out: List[Violation] = []
+    for rule, fn in _RULES:
+        if _allowed(rule, rel):
+            continue
+        out.extend(fn(tree, rel))
+    return sorted(out, key=lambda v: (v.path, v.line, v.rule))
+
+
+_SKIP_DIRS = {".git", "__pycache__", ".claude", ".pytest_cache",
+              "node_modules", ".venv", "venv", ".tox", ".eggs",
+              "build", "dist", "site-packages"}
+
+
+def _require_checkout(root: Path) -> Path:
+    """Refuse to treat a non-checkout directory as the repo: from an
+    installed wheel, ``parents[2]`` is site-packages, and rglobbing
+    every installed distribution would both take minutes and flag
+    third-party files under OUR conventions."""
+    if (root / "pyproject.toml").exists() \
+            and (root / "go_avalanche_tpu").is_dir():
+        return root
+    raise RuntimeError(
+        f"{root} is not a go-avalanche-tpu source checkout (no "
+        f"pyproject.toml + go_avalanche_tpu/ side by side) — the "
+        f"repo-convention linter needs the repo; run it from the "
+        f"checkout or pass lint_repo(root=...)")
+
+
+def repo_py_files(root: Path = REPO_ROOT) -> Iterable[Path]:
+    for path in sorted(root.rglob("*.py")):
+        if any(part in _SKIP_DIRS for part in path.parts):
+            continue
+        yield path
+
+
+def lint_repo(root: Optional[Path] = None) -> List[Violation]:
+    """Lint every .py file in the repo; [] means lint-clean.  Raises
+    `RuntimeError` when no source checkout is findable (installed-wheel
+    runs must pass `root` explicitly)."""
+    root = _require_checkout(root or REPO_ROOT)
+    out: List[Violation] = []
+    for path in repo_py_files(root):
+        rel = path.relative_to(root).as_posix()
+        out.extend(lint_source(path.read_text(), rel))
+    return out

@@ -1,0 +1,108 @@
+"""Retrace guard: a compile-cache counter for one-compile claims.
+
+Two of the repo's standing performance claims are COMPILE-COUNT claims,
+and until now neither was machine-checked:
+
+  * `bench.py`'s timed loop assumes the warmup call compiled everything
+    — a recompile inside the measured repeats (donation changing a
+    layout, a shape leaking into a static) would silently time XLA's
+    compiler instead of the program;
+  * `fleet.run_phase_grid`'s "one compile per config point" (the PR 7
+    dispatch-amortization premise): a config field accidentally turned
+    traced-to-static-hash-unstable would re-trace the whole fleet
+    program per point without changing a single result.
+
+`CompileCounter` counts backend compiles via `jax.monitoring`'s
+``/jax/core/compile/backend_compile_duration`` event — fired once per
+actual XLA compile, never on a cache hit (verified by
+tests/test_analysis.py).  The listener is registered once per process
+and only ever increments an integer, so leaving it installed costs
+nothing; counters snapshot it.
+
+    with retrace.CompileCounter() as c:
+        timed_loop()
+    c.expect_at_most(0, "the bench timed loop")   # raises RetraceError
+"""
+
+from __future__ import annotations
+
+_COMPILE_EVENT_FRAGMENT = "backend_compile"
+
+_compiles = 0
+_listener_installed = False
+
+
+class RetraceError(RuntimeError):
+    """A compiled-program cache was violated: something (re)compiled
+    where the surrounding claim says nothing may."""
+
+
+def _install_listener() -> None:
+    """Register the process-wide compile-event listener (idempotent).
+
+    Deferred to first CompileCounter use so importing the analysis
+    package never imports jax (the lint CLI must run jax-free)."""
+    global _listener_installed
+    if _listener_installed:
+        return
+    import jax.monitoring
+
+    def _on_event_duration(name: str, *args, **kwargs) -> None:
+        global _compiles
+        if _COMPILE_EVENT_FRAGMENT in name:
+            _compiles += 1
+
+    jax.monitoring.register_event_duration_secs_listener(
+        _on_event_duration)
+    _listener_installed = True
+
+
+class CompileCounter:
+    """Context manager counting XLA backend compiles inside its scope.
+
+    The count FREEZES at scope exit — jitted work after the with-block
+    (a decode pass, a report step) never contaminates the guarded
+    measurement."""
+
+    def __enter__(self) -> "CompileCounter":
+        _install_listener()
+        self._start = _compiles
+        self._end = None
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._end = _compiles
+
+    @property
+    def count(self) -> int:
+        end = self._end if self._end is not None else _compiles
+        return end - self._start
+
+    def expect_at_most(self, n: int, what: str) -> None:
+        """Raise `RetraceError` if more than `n` compiles happened in
+        scope — with the count, so the failure names its magnitude."""
+        if self.count > n:
+            raise RetraceError(
+                f"{what} compiled {self.count} program(s) where at most "
+                f"{n} is allowed — a static argument is unstable or a "
+                f"shape/layout leaked into the cache key (the "
+                f"one-compile contract, go_avalanche_tpu/analysis/"
+                f"retrace.py)")
+
+
+def guard_fleet_point(misses_before: int, misses_after: int,
+                      point) -> None:
+    """The phase-grid guard: one config point may TRACE the fleet
+    program at most once (`fleet._compiled_fleet` is lru-cached — a
+    repeated point legitimately costs zero).  More than one cache miss
+    for a single point means the jit-static config hashed unstably and
+    the sweep is recompiling per call, the exact regression the PR 7
+    one-compile-per-config-point claim forbids."""
+    misses = misses_after - misses_before
+    if misses > 1:
+        raise RetraceError(
+            f"phase point {point!r} traced the fleet program {misses} "
+            f"times (expected at most 1): the config is not a stable "
+            f"jit-static cache key — one compile per config point is "
+            f"the fleet's dispatch-amortization contract "
+            f"(go_avalanche_tpu/fleet.py, PR 7)")

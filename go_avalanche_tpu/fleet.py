@@ -798,14 +798,25 @@ def run_phase_grid(
             "stake_mode set to 'zipf' (the exponent is only read "
             "there — every point would otherwise reject or measure "
             "the same program)")
+    from go_avalanche_tpu.analysis import retrace
+
     rows = []
     for point in points:
         cfg = point_config(base_cfg, point)
+        # One compile per config point is the fleet's whole
+        # dispatch-amortization premise (PR 7): `_compiled_fleet` may
+        # TRACE at most once per point (zero for a repeated point —
+        # lru hit).  More means the config stopped being a stable
+        # jit-static cache key; fail the sweep rather than silently
+        # recompile per trial batch (analysis/retrace.py).
+        misses_before = _compiled_fleet.cache_info().misses
         res = run_fleet(model, cfg, fleet, n_nodes, n_txs=n_txs,
                         n_rounds=n_rounds, seed=seed,
                         conflict_size=conflict_size,
                         yes_fraction=yes_fraction, contested=contested,
                         window=window)
+        retrace.guard_fleet_point(
+            misses_before, _compiled_fleet.cache_info().misses, point)
         row = {"point": point, **res.summary(),
                "tag": tag_from_config(cfg)}
         realized = res.realizations()

@@ -472,6 +472,21 @@ def _donate(donate: bool) -> tuple:
     return (0,) if donate else ()
 
 
+# The collective allowlist (go_avalanche_tpu/analysis/hlo_audit.py): every
+# (collective kind, mesh axes) pair this driver's lowered program may
+# contain — psum on DECLARED axes only, nothing else.  The audit lowers
+# the scan program on a small mesh and asserts set equality, so both an
+# undeclared collective (an accidental all-gather of an [N, T] plane)
+# and a stale manifest entry fail tier-1.
+DECLARED_COLLECTIVES = frozenset({
+    ("all_gather", (NODES_AXIS,)),    # packed preference plane [N, T/8]
+                                      #   + the alive vector [N]
+    ("all_to_all", (NODES_AXIS,)),    # gossip heard-plane owner exchange
+    ("all_reduce", (NODES_AXIS,)),    # minority plane, ring counters
+    ("all_reduce", (NODES_AXIS, TXS_AXIS)),  # telemetry + settled flag
+})
+
+
 def _shard_mapped(mesh, fn, track_finality: bool = True,
                   with_inflight: bool = False,
                   with_fault_params: bool = False,
@@ -514,14 +529,15 @@ def make_sharded_round_step(mesh, cfg: AvalancheConfig = DEFAULT_CONFIG,
     return step
 
 
-def run_scan_sharded(
-    mesh,
-    state: AvalancheSimState,
-    cfg: AvalancheConfig = DEFAULT_CONFIG,
-    n_rounds: int = 100,
-    donate: bool = False,
-) -> Tuple[AvalancheSimState, SimTelemetry]:
-    """Fixed-round sharded run; one jit, collectives inside the scan."""
+def scan_program(mesh, state: AvalancheSimState,
+                 cfg: AvalancheConfig = DEFAULT_CONFIG,
+                 n_rounds: int = 100, donate: bool = False):
+    """The jitted fixed-round sharded program `run_scan_sharded`
+    executes — exposed unexecuted so `analysis/hlo_audit.py` lowers THE
+    driver program, not a reconstruction of it (the
+    `bench.flagship_program` seam, applied to the mesh drivers).  Only
+    tree structure and shapes are read from `state`, so abstract
+    (`jax.eval_shape`) states lower on any host."""
     n_global = state.records.votes.shape[0]
     n_tx = mesh.shape[TXS_AXIS]
 
@@ -537,17 +553,26 @@ def run_scan_sharded(
         with_inflight=state.inflight is not None,
         with_fault_params=state.fault_params is not None,
         trace_spec=obs_trace.replicated_spec(state.trace)),
-        donate_argnums=_donate(donate))(state)
+        donate_argnums=_donate(donate))
 
 
-def run_sharded(
+def run_scan_sharded(
     mesh,
     state: AvalancheSimState,
     cfg: AvalancheConfig = DEFAULT_CONFIG,
-    max_rounds: int = 2000,
+    n_rounds: int = 100,
     donate: bool = False,
-) -> AvalancheSimState:
-    """Run until globally settled (psum'd flag) or `max_rounds`; one jit."""
+) -> Tuple[AvalancheSimState, SimTelemetry]:
+    """Fixed-round sharded run; one jit, collectives inside the scan."""
+    return scan_program(mesh, state, cfg, n_rounds, donate)(state)
+
+
+def settle_program(mesh, state: AvalancheSimState,
+                   cfg: AvalancheConfig = DEFAULT_CONFIG,
+                   max_rounds: int = 2000, donate: bool = False):
+    """The jitted run-until-settled program `run_sharded` executes
+    (while_loop + psum'd settled flag) — the audit seam twin of
+    `scan_program`."""
     n_global = state.records.votes.shape[0]
     n_tx = mesh.shape[TXS_AXIS]
 
@@ -581,4 +606,15 @@ def run_sharded(
                         obs_trace.replicated_spec(state.trace))
     fn = shard_map(local_run, mesh=mesh, in_specs=(specs,),
                    out_specs=specs, check_vma=False)
-    return jax.jit(fn, donate_argnums=_donate(donate))(state)
+    return jax.jit(fn, donate_argnums=_donate(donate))
+
+
+def run_sharded(
+    mesh,
+    state: AvalancheSimState,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    max_rounds: int = 2000,
+    donate: bool = False,
+) -> AvalancheSimState:
+    """Run until globally settled (psum'd flag) or `max_rounds`; one jit."""
+    return settle_program(mesh, state, cfg, max_rounds, donate)(state)

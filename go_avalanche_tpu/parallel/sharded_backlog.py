@@ -344,14 +344,28 @@ def make_sharded_backlog_step(mesh, cfg: AvalancheConfig = DEFAULT_CONFIG,
     return step
 
 
-def run_scan_sharded_backlog(
-    mesh,
-    state: BacklogSimState,
-    cfg: AvalancheConfig = DEFAULT_CONFIG,
-    n_rounds: int = 100,
-    donate: bool = False,
-) -> Tuple[BacklogSimState, BacklogTelemetry]:
-    """Fixed-round sharded stream; one jit, collectives inside the scan."""
+# Collective allowlist (analysis/hlo_audit.py): the streaming scheduler
+# adds txs-axis merges (one-hot retire/refill psums, admission-count
+# all-gather — a [n_tx_shards] vector, never a plane) on top of the
+# inner round's node-axis surface.
+DECLARED_COLLECTIVES = frozenset({
+    ("all_gather", (NODES_AXIS,)),
+    ("all_gather", (TXS_AXIS,)),      # per-shard admission counts
+    ("all_to_all", (NODES_AXIS,)),
+    ("all_reduce", (NODES_AXIS,)),
+    ("all_reduce", (TXS_AXIS,)),      # retire/refill one-hot merges,
+                                      #   occupancy, traffic deltas
+    ("all_reduce", (NODES_AXIS, TXS_AXIS)),
+})
+
+
+def scan_program(mesh, state: BacklogSimState,
+                 cfg: AvalancheConfig = DEFAULT_CONFIG,
+                 n_rounds: int = 100, donate: bool = False):
+    """The jitted fixed-round program `run_scan_sharded_backlog`
+    executes — exposed unexecuted so `analysis/hlo_audit.py` lowers THE
+    driver program (the `bench.flagship_program` seam).  Only tree
+    structure and shapes are read from `state`."""
     n_global = state.sim.records.votes.shape[0]
     n_tx = mesh.shape[TXS_AXIS]
 
@@ -368,20 +382,26 @@ def run_scan_sharded_backlog(
         with_fault_params=state.sim.fault_params is not None,
         with_traffic=state.traffic is not None,
         trace_spec=obs_trace.replicated_spec(state.sim.trace)),
-        donate_argnums=sharded._donate(donate))(state)
+        donate_argnums=sharded._donate(donate))
 
 
-def run_sharded_backlog(
+def run_scan_sharded_backlog(
     mesh,
     state: BacklogSimState,
     cfg: AvalancheConfig = DEFAULT_CONFIG,
-    max_rounds: int = 100_000,
+    n_rounds: int = 100,
     donate: bool = False,
-) -> BacklogSimState:
-    """Stream the whole backlog to settlement over the mesh; one jit.
+) -> Tuple[BacklogSimState, BacklogTelemetry]:
+    """Fixed-round sharded stream; one jit, collectives inside the scan."""
+    return scan_program(mesh, state, cfg, n_rounds, donate)(state)
 
-    Ends with a harvest pass so the last window's outcomes are recorded.
-    """
+
+def settle_program(mesh, state: BacklogSimState,
+                   cfg: AvalancheConfig = DEFAULT_CONFIG,
+                   max_rounds: int = 100_000, donate: bool = False):
+    """The jitted drain-to-settlement program `run_sharded_backlog`
+    executes (while_loop + harvest pass) — the audit seam twin of
+    `scan_program`."""
     n_global = state.sim.records.votes.shape[0]
     n_tx = mesh.shape[TXS_AXIS]
 
@@ -414,4 +434,18 @@ def run_sharded_backlog(
         with_fault_params=state.sim.fault_params is not None,
         with_traffic=state.traffic is not None,
         trace_spec=obs_trace.replicated_spec(state.sim.trace)),
-        donate_argnums=sharded._donate(donate))(state)
+        donate_argnums=sharded._donate(donate))
+
+
+def run_sharded_backlog(
+    mesh,
+    state: BacklogSimState,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    max_rounds: int = 100_000,
+    donate: bool = False,
+) -> BacklogSimState:
+    """Stream the whole backlog to settlement over the mesh; one jit.
+
+    Ends with a harvest pass so the last window's outcomes are recorded.
+    """
+    return settle_program(mesh, state, cfg, max_rounds, donate)(state)

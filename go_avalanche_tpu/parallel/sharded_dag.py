@@ -336,15 +336,26 @@ def make_sharded_dag_round_step(mesh, cfg: AvalancheConfig = DEFAULT_CONFIG,
     return step
 
 
-def run_sharded_dag(
-    mesh,
-    state: DagSimState,
-    cfg: AvalancheConfig = DEFAULT_CONFIG,
-    max_rounds: int = 2000,
-    donate: bool = False,
-) -> DagSimState:
-    """Run until every (live node, set) resolved globally, or `max_rounds`;
-    one jit, early exit via a psum'd settled flag."""
+# Collective allowlist (analysis/hlo_audit.py): the conflict-DAG round
+# gathers the packed preference plane over nodes and psums telemetry /
+# the settled flag over both axes; async configs add the node-axis ring
+# psums.  Segment reductions stay shard-local (sets never straddle tx
+# shards — shard_dag_state validates) and the DAG gossip path never
+# lowers an all_to_all here.
+DECLARED_COLLECTIVES = frozenset({
+    ("all_gather", (NODES_AXIS,)),
+    ("all_reduce", (NODES_AXIS,)),      # ring counters (async configs)
+    ("all_reduce", (NODES_AXIS, TXS_AXIS)),
+})
+
+
+def settle_program(mesh, state: DagSimState,
+                   cfg: AvalancheConfig = DEFAULT_CONFIG,
+                   max_rounds: int = 2000, donate: bool = False):
+    """The jitted run-until-resolved program `run_sharded_dag` executes
+    — exposed unexecuted so `analysis/hlo_audit.py` lowers THE driver
+    program (the `bench.flagship_program` seam).  Only tree structure
+    and shapes are read from `state`; abstract states lower fine."""
     n_global = state.base.records.votes.shape[0]
     n_tx = mesh.shape[TXS_AXIS]
 
@@ -390,4 +401,16 @@ def run_sharded_dag(
                                           is not None),
                        trace_spec=obs_trace.replicated_spec(
                            state.base.trace))
-    return jax.jit(fn, donate_argnums=sharded._donate(donate))(state)
+    return jax.jit(fn, donate_argnums=sharded._donate(donate))
+
+
+def run_sharded_dag(
+    mesh,
+    state: DagSimState,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    max_rounds: int = 2000,
+    donate: bool = False,
+) -> DagSimState:
+    """Run until every (live node, set) resolved globally, or `max_rounds`;
+    one jit, early exit via a psum'd settled flag."""
+    return settle_program(mesh, state, cfg, max_rounds, donate)(state)

@@ -191,15 +191,25 @@ def _shard_mapped(mesh, fn, with_tel=True, track_finality: bool = True,
                      out_specs=out_specs, check_vma=False)
 
 
-def run_scan_sharded_node_stream(
-    mesh,
-    state: NodeStreamState,
-    cfg: AvalancheConfig = DEFAULT_CONFIG,
-    n_rounds: int = 100,
-    donate: bool = False,
-) -> Tuple[NodeStreamState, NodeStreamTelemetry]:
-    """Fixed-round sharded node stream; one jit, collectives inside the
-    scan."""
+# Collective allowlist (analysis/hlo_audit.py): churn/rotation is
+# replicated work (identical registry draws on every shard — no axis
+# folds, see `_local_churn`), so the collective surface is exactly the
+# inner avalanche round's.
+DECLARED_COLLECTIVES = frozenset({
+    ("all_gather", (NODES_AXIS,)),
+    ("all_to_all", (NODES_AXIS,)),
+    ("all_reduce", (NODES_AXIS,)),
+    ("all_reduce", (NODES_AXIS, TXS_AXIS)),
+})
+
+
+def scan_program(mesh, state: NodeStreamState,
+                 cfg: AvalancheConfig = DEFAULT_CONFIG,
+                 n_rounds: int = 100, donate: bool = False):
+    """The jitted fixed-round program `run_scan_sharded_node_stream`
+    executes — exposed unexecuted so `analysis/hlo_audit.py` lowers THE
+    driver program (the `bench.flagship_program` seam).  Only tree
+    structure and shapes are read from `state`."""
     n_global = state.slot_node.shape[0]
     n_tx = mesh.shape[TXS_AXIS]
 
@@ -215,4 +225,16 @@ def run_scan_sharded_node_stream(
         with_inflight=state.sim.inflight is not None,
         with_fault_params=state.sim.fault_params is not None,
         trace_spec=obs_trace.replicated_spec(state.sim.trace)),
-        donate_argnums=sharded._donate(donate))(state)
+        donate_argnums=sharded._donate(donate))
+
+
+def run_scan_sharded_node_stream(
+    mesh,
+    state: NodeStreamState,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    n_rounds: int = 100,
+    donate: bool = False,
+) -> Tuple[NodeStreamState, NodeStreamTelemetry]:
+    """Fixed-round sharded node stream; one jit, collectives inside the
+    scan."""
+    return scan_program(mesh, state, cfg, n_rounds, donate)(state)

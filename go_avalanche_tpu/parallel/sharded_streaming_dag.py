@@ -456,17 +456,27 @@ def make_sharded_streaming_dag_step(mesh,
     return step
 
 
-def run_sharded_streaming_dag(
-    mesh,
-    state: StreamingDagState,
-    cfg: AvalancheConfig = DEFAULT_CONFIG,
-    max_rounds: int = 100_000,
-    donate: bool = False,
-) -> StreamingDagState:
-    """Stream the whole conflict graph to settlement over the mesh; one jit.
+# Collective allowlist (analysis/hlo_audit.py): the set-streaming
+# scheduler's txs-axis merges (row-block retire/refill psums, pool-count
+# all-gather — a [n_tx_shards] vector, never a plane) on top of the
+# inner round's node-axis surface.
+DECLARED_COLLECTIVES = frozenset({
+    ("all_gather", (NODES_AXIS,)),
+    ("all_gather", (TXS_AXIS,)),      # per-shard admission-pool counts
+    ("all_reduce", (NODES_AXIS,)),    # settle test over the nodes axis
+    ("all_reduce", (TXS_AXIS,)),      # retire/refill merges, occupancy,
+                                      #   traffic deltas
+    ("all_reduce", (NODES_AXIS, TXS_AXIS)),
+})
 
-    Ends with a harvest pass so the last window's outcomes are recorded.
-    """
+
+def settle_program(mesh, state: StreamingDagState,
+                   cfg: AvalancheConfig = DEFAULT_CONFIG,
+                   max_rounds: int = 100_000, donate: bool = False):
+    """The jitted drain-to-settlement program `run_sharded_streaming_dag`
+    executes — exposed unexecuted so `analysis/hlo_audit.py` lowers THE
+    driver program (the `bench.flagship_program` seam).  Only tree
+    structure and shapes are read from `state`."""
     n_global = state.dag.base.records.votes.shape[0]
     n_tx = mesh.shape[TXS_AXIS]
     c = state.backlog.score.shape[1]
@@ -503,17 +513,28 @@ def run_sharded_streaming_dag(
                        with_traffic=state.traffic is not None,
                        trace_spec=obs_trace.replicated_spec(
                            state.dag.base.trace))
-    return jax.jit(fn, donate_argnums=sharded._donate(donate))(state)
+    return jax.jit(fn, donate_argnums=sharded._donate(donate))
 
 
-def run_scan_sharded_streaming_dag(
+def run_sharded_streaming_dag(
     mesh,
     state: StreamingDagState,
     cfg: AvalancheConfig = DEFAULT_CONFIG,
-    n_rounds: int = 100,
+    max_rounds: int = 100_000,
     donate: bool = False,
-) -> Tuple[StreamingDagState, StreamingDagTelemetry]:
-    """Fixed-round sharded stream; one jit, collectives inside the scan."""
+) -> StreamingDagState:
+    """Stream the whole conflict graph to settlement over the mesh; one jit.
+
+    Ends with a harvest pass so the last window's outcomes are recorded.
+    """
+    return settle_program(mesh, state, cfg, max_rounds, donate)(state)
+
+
+def scan_program(mesh, state: StreamingDagState,
+                 cfg: AvalancheConfig = DEFAULT_CONFIG,
+                 n_rounds: int = 100, donate: bool = False):
+    """The jitted fixed-round program `run_scan_sharded_streaming_dag`
+    executes — the audit seam twin of `settle_program`."""
     n_global = state.dag.base.records.votes.shape[0]
     n_tx = mesh.shape[TXS_AXIS]
     c = state.backlog.score.shape[1]
@@ -531,4 +552,15 @@ def run_scan_sharded_streaming_dag(
         with_fault_params=state.dag.base.fault_params is not None,
         with_traffic=state.traffic is not None,
         trace_spec=obs_trace.replicated_spec(state.dag.base.trace)),
-        donate_argnums=sharded._donate(donate))(state)
+        donate_argnums=sharded._donate(donate))
+
+
+def run_scan_sharded_streaming_dag(
+    mesh,
+    state: StreamingDagState,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    n_rounds: int = 100,
+    donate: bool = False,
+) -> Tuple[StreamingDagState, StreamingDagTelemetry]:
+    """Fixed-round sharded stream; one jit, collectives inside the scan."""
+    return scan_program(mesh, state, cfg, n_rounds, donate)(state)

@@ -27,6 +27,7 @@ from __future__ import annotations
 import argparse
 import contextlib
 import json
+import sys
 import time
 from typing import Dict
 
@@ -865,6 +866,20 @@ def main(argv=None) -> Dict:
                              "node counts (RESULTS.md retire-cap tradeoff; "
                              "PERF_NOTES r05 A/B).  Default: dense rewrite")
     # output / tooling
+    parser.add_argument("--audit", action="store_true",
+                        help="run the HLO contract auditor "
+                             "(go_avalanche_tpu/analysis/hlo_audit.py) "
+                             "on the EXACT program these flags select "
+                             "before executing it: host-callback "
+                             "budget, dtype budget, collective "
+                             "allowlist (--mesh: the driver's "
+                             "DECLARED_COLLECTIVES manifest), donation "
+                             "coverage.  Lowering never compiles, so "
+                             "the audited program still compiles "
+                             "exactly once at execution (--fleet "
+                             "audits lower through the same lru-cached "
+                             "jit the fleet executes).  Exits 1 with "
+                             "the contract failures instead of running")
     parser.add_argument("--json", action="store_true",
                         help="emit one JSON line instead of key=value text")
     parser.add_argument("--trace", type=str, default=None,
@@ -930,6 +945,28 @@ def main(argv=None) -> Dict:
                              "streaming schedulers legitimately reset "
                              "refilled columns)")
     args = parser.parse_args(argv)
+
+    # --audit validation: everything parser-level (the PR 5 rule).  The
+    # audit lowers ONE program; flag combinations with no single-program
+    # meaning are rejected here, never discovered in the worker.
+    if args.audit:
+        if args.phase_grid is not None:
+            parser.error(
+                "--audit with --phase-grid would compile twice per "
+                "point: every grid point re-jits its own fleet program, "
+                "so auditing the sweep means lowering the whole grid "
+                "before the sweep compiles it again — audit a single "
+                "--fleet point (one program, lowered once, compiled "
+                "once) instead")
+        if args.check_invariants:
+            parser.error("--audit lowers the one fused program the run "
+                         "executes; --check-invariants dispatches "
+                         "per-round jits — there is no single program "
+                         "to audit")
+        if args.chunk:
+            parser.error("--audit lowers the one fused program the run "
+                         "executes; --chunk dispatches host-driven "
+                         "chunks — audit the unchunked spelling")
 
     # Fleet-mode validation: everything parser-level (the PR 5 rule).
     args.phase_grid_parsed = None
@@ -1179,6 +1216,21 @@ def main(argv=None) -> Dict:
                   "dag": run_dag, "backlog": run_backlog,
                   "streaming_dag": run_streaming_dag,
                   "node_stream": run_node_stream}[args.model]
+
+    if args.audit:
+        # Static contract audit of the exact program the flags above
+        # selected (analysis/hlo_audit.py) — BEFORE any execution, so a
+        # contract violation never produces a half-run artifact.  The
+        # report goes to stderr; stdout keeps the one-result contract.
+        from go_avalanche_tpu.analysis import hlo_audit
+
+        failures = hlo_audit.audit_run_sim(args, cfg)
+        if failures:
+            print("AUDIT FAILURES:\n  " + "\n  ".join(failures),
+                  file=sys.stderr)
+            raise SystemExit(1)
+        print(f"audit ok: {args.model} program passes its contracts "
+              f"(callbacks/dtype/collectives/donation)", file=sys.stderr)
 
     ctx = tracing.trace(args.trace) if args.trace else contextlib.nullcontext()
     if args.metrics:

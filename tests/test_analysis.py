@@ -1,0 +1,533 @@
+"""Static-analysis plane tests (PR 12, go_avalanche_tpu/analysis/).
+
+Positive pins for every contract (the committed tree is audit- and
+lint-clean), one synthetic violating program per contract (planted
+callback, planted f64, planted all-gather of a plane, un-donated
+buffer, one AST fixture per lint rule), the drift explainer pinned on a
+known histogram delta, and the retrace guard's compile counting.
+"""
+
+import json
+
+import pytest
+
+from go_avalanche_tpu.analysis import drift, lint, retrace
+
+# ---------------------------------------------------------------- drift
+
+
+def test_op_histogram_classes():
+    text = (
+        "  %0 = stablehlo.add %a, %b : tensor<4xi32>\n"
+        "  %1 = stablehlo.add %0, %b : tensor<4xi32>\n"
+        "  %2 = stablehlo.custom_call @xla_python_cpu_callback(%1)\n"
+        '  %3 = "stablehlo.all_gather"(%2) : (tensor<4xi32>) -> '
+        "tensor<8xi32>\n"
+        "  stablehlo.return %3 : tensor<8xi32>\n")
+    h = drift.op_histogram(text)
+    assert h["stablehlo.add"] == 2
+    assert h["custom_call:xla_python_cpu_callback"] == 1
+    assert h["stablehlo.all_gather"] == 1
+    assert h["stablehlo.return"] == 1
+    # A custom_call line counts as its target class, not double-counted
+    # as a bare stablehlo.custom_call.
+    assert "stablehlo.custom_call" not in h
+
+
+def test_diff_histograms_pinned_delta():
+    # The known delta: two adds fused away, one callback appeared.
+    out = drift.diff_histograms(
+        {"stablehlo.add": 3, "stablehlo.gather": 1},
+        {"stablehlo.add": 1, "stablehlo.gather": 1,
+         "custom_call:xla_python_cpu_callback": 1})
+    assert out == [
+        "stablehlo.add: 3 -> 1 (-2)",
+        "custom_call:xla_python_cpu_callback: 0 -> 1 (APPEARED)",
+    ]
+    out = drift.diff_histograms({"stablehlo.while": 1}, {})
+    assert out == ["stablehlo.while: 1 -> 0 (VANISHED)"]
+
+
+def test_diff_identical_histograms_says_so():
+    # A real hash mismatch with equal histograms must explain itself,
+    # never print nothing.
+    [note] = drift.diff_histograms({"stablehlo.add": 2},
+                                   {"stablehlo.add": 2})
+    assert "shapes, constants or operand wiring" in note
+
+
+# ----------------------------------------------------------------- lint
+
+
+def test_lint_canonical_spelling_rebind_and_assign():
+    vs = lint.lint_source("def cluster_of(x):\n    return x\n",
+                          "go_avalanche_tpu/somewhere.py")
+    assert [v.rule for v in vs] == ["canonical-spelling"]
+    assert "cluster_of has ONE spelling" in vs[0].message
+    assert "go_avalanche_tpu/ops/sampling.py" in vs[0].message
+    # The assignment form the PR-12 sweep fixed in tests/test_sampling.
+    vs = lint.lint_source("import numpy as np\n"
+                          "cluster_of = np.arange(8)\n",
+                          "tests/test_x.py")
+    assert [v.rule for v in vs] == ["canonical-spelling"]
+
+
+def test_lint_canonical_spelling_import_sources():
+    ok = lint.lint_source(
+        "from go_avalanche_tpu.ops.sampling import cluster_of\n",
+        "go_avalanche_tpu/traffic.py")
+    assert ok == []
+    bad = lint.lint_source(
+        "from go_avalanche_tpu.traffic import cluster_of\n",
+        "go_avalanche_tpu/models/foo.py")
+    assert [v.rule for v in bad] == ["canonical-spelling"]
+    # The declared re-export: obs/__init__ may import tag_from_config,
+    # and importing it FROM the obs package is canonical.
+    assert lint.lint_source(
+        "from go_avalanche_tpu.obs.tags import tag_from_config\n",
+        "go_avalanche_tpu/obs/__init__.py") == []
+    assert lint.lint_source(
+        "from go_avalanche_tpu.obs import tag_from_config\n",
+        "go_avalanche_tpu/fleet.py") == []
+    # ...but a DEF in the re-exporter is still a drifted copy.
+    vs = lint.lint_source("def tag_from_config(cfg):\n    return ''\n",
+                          "go_avalanche_tpu/obs/__init__.py")
+    assert [v.rule for v in vs] == ["canonical-spelling"]
+
+
+def test_lint_config_jax_free():
+    src = ("import jax.numpy as jnp\n"
+           "class C:\n"
+           "    def _validate_stake(self):\n"
+           "        return jnp.asarray(self.x)\n")
+    vs = lint.lint_source(src, "go_avalanche_tpu/config.py")
+    assert {v.rule for v in vs} == {"config-jax-free"}
+    assert any("must never trace" in v.message for v in vs)
+    # Same source under any other path: the rule is config.py-scoped.
+    assert lint.lint_source(src, "go_avalanche_tpu/stake_helpers.py") == []
+
+
+def test_lint_host_rng_in_traced_scope_only():
+    src = ("import numpy as np\n"
+           "def draw(n):\n"
+           "    return np.random.rand(n)\n")
+    vs = lint.lint_source(src, "go_avalanche_tpu/models/foo.py")
+    assert [v.rule for v in vs] == ["host-rng-in-traced"]
+    assert "jax PRNG key plane" in vs[0].message
+    vs = lint.lint_source("import random\n",
+                          "go_avalanche_tpu/ops/bar.py")
+    assert [v.rule for v in vs] == ["host-rng-in-traced"]
+    # processor.py is host-side control plane — out of traced scope.
+    assert lint.lint_source(src, "go_avalanche_tpu/processor.py") == []
+
+
+def test_lint_debug_print_library_scope_only():
+    src = ("import jax\n"
+           "def f(x):\n"
+           "    jax.debug.print('x={}', x)\n"
+           "    return x\n")
+    vs = lint.lint_source(src, "go_avalanche_tpu/ops/foo.py")
+    assert [v.rule for v in vs] == ["debug-print"]
+    assert "obs planes" in vs[0].message
+    assert lint.lint_source(src, "examples/scratch.py") == []
+
+
+def test_repo_is_lint_clean():
+    """The PR-12 acceptance bar: the committed tree has zero violations
+    under every rule (the lint sweep fixed the duplicate spellings)."""
+    assert [str(v) for v in lint.lint_repo()] == []
+
+
+# -------------------------------------------------------------- retrace
+
+
+def test_compile_counter_counts_compiles_not_cache_hits():
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x * 2 + 1)
+    with retrace.CompileCounter() as c1:
+        f(jnp.arange(7))
+    assert c1.count >= 1
+    with retrace.CompileCounter() as c2:
+        f(jnp.arange(7))          # cached: no compile event
+    assert c2.count == 0
+    c2.expect_at_most(0, "a cached call")
+    with pytest.raises(retrace.RetraceError,
+                       match="one-compile contract"):
+        c1.expect_at_most(0, "the bench timed loop")
+
+
+def test_guard_fleet_point():
+    retrace.guard_fleet_point(3, 4, {"k": 8})       # one trace: fine
+    retrace.guard_fleet_point(5, 5, {"k": 8})       # lru hit: fine
+    with pytest.raises(retrace.RetraceError,
+                       match="dispatch-amortization"):
+        retrace.guard_fleet_point(0, 2, {"k": 8})
+
+
+# ------------------------------------------- hlo_audit: synthetic text
+
+
+def _prog(args, body="", results="tensor<4xf32>"):
+    return ("module @jit_f {\n"
+            f"  func.func public @main({args}) -> ({results}) {{\n"
+            f"{body}"
+            "    stablehlo.return %arg0 : tensor<4xf32>\n"
+            "  }\n"
+            "}\n")
+
+
+_DONATED_ARG = "%arg0: tensor<4xf32> {tf.aliasing_output = 0 : i32}"
+
+
+def test_audit_text_planted_callback():
+    from go_avalanche_tpu.analysis import hlo_audit
+
+    text = _prog(_DONATED_ARG,
+                 "    %0 = stablehlo.custom_call "
+                 "@xla_python_cpu_callback(%arg0)\n")
+    fails = hlo_audit.audit_text(text, "fixture", callbacks=0,
+                                 donated_leaves=1)
+    assert any("host-callback" in f and "leaked" in f for f in fails)
+    # With the budget declared, the same program is clean.
+    assert hlo_audit.audit_text(text, "fixture", callbacks=1,
+                                donated_leaves=1) == []
+
+
+def test_audit_text_planted_f64_and_shaped_i64():
+    from go_avalanche_tpu.analysis import hlo_audit
+
+    text = _prog(_DONATED_ARG,
+                 "    %0 = stablehlo.constant dense<1.0> : tensor<f64>\n")
+    assert any("dtype budget" in f for f in hlo_audit.audit_text(
+        text, "fixture", donated_leaves=1))
+    text = _prog(_DONATED_ARG,
+                 "    %0 = stablehlo.iota dim = 0 : tensor<8xi64>\n")
+    assert any("dtype budget" in f for f in hlo_audit.audit_text(
+        text, "fixture", donated_leaves=1))
+    # Attribute-context i64 (reduce_window padding) is MLIR metadata,
+    # and the scalar callback pointer rides a callback budget.
+    text = _prog(
+        _DONATED_ARG,
+        '    %0 = "stablehlo.reduce_window"(%arg0) <{padding = '
+        "dense<[[3, 0]]> : tensor<1x2xi64>}> ({\n"
+        "    %1 = stablehlo.constant dense<93862033884320> : "
+        "tensor<i64>\n"
+        "    %2 = stablehlo.custom_call "
+        "@xla_python_cpu_callback(%1)\n")
+    assert hlo_audit.audit_text(text, "fixture", callbacks=1,
+                                donated_leaves=1) == []
+
+
+def test_audit_text_planted_plane_all_gather():
+    from go_avalanche_tpu.analysis import hlo_audit
+
+    mesh_axes = [("nodes", 2), ("txs", 2)]
+    gather = ('    %0 = "stablehlo.all_gather"(%arg0) <{replica_groups '
+              "= dense<[[0, 2], [1, 3]]> : tensor<2x2xi64>}> : "
+              "(tensor<8x16xui8>) -> tensor<16x16xui8>\n")
+    text = _prog(_DONATED_ARG, gather)
+    # Declared and small enough: clean.
+    assert hlo_audit.audit_text(
+        text, "fixture", donated_leaves=1,
+        collectives=frozenset({("all_gather", ("nodes",))}),
+        mesh_axes=mesh_axes, plane_elems=1024) == []
+    # Same gather, undeclared: the allowlist failure.
+    fails = hlo_audit.audit_text(
+        text, "fixture", donated_leaves=1, collectives=frozenset(),
+        mesh_axes=mesh_axes, plane_elems=1024)
+    assert any("UNDECLARED collective all_gather" in f for f in fails)
+    # Declared but the result reaches [N, T] plane size: hard failure.
+    fails = hlo_audit.audit_text(
+        text, "fixture", donated_leaves=1,
+        collectives=frozenset({("all_gather", ("nodes",))}),
+        mesh_axes=mesh_axes, plane_elems=256)
+    assert any("ICI blow-up" in f for f in fails)
+    # A single-chip contract rejects any collective at all.
+    fails = hlo_audit.audit_text(text, "fixture", donated_leaves=1)
+    assert any("single-chip program contains collectives" in f
+               for f in fails)
+
+
+def test_axis_groupings_degenerate_mesh_prefers_minimal_axes():
+    """On a mesh with a size-1 axis, distinct axis subsets collapse to
+    one partition; attribution must pick the MINIMAL subset, never a
+    phantom extra axis (the `--mesh 4,1` false-failure regression)."""
+    from go_avalanche_tpu.analysis import hlo_audit
+
+    table = hlo_audit.axis_groupings([("nodes", 4), ("txs", 1)])
+    all_dev = frozenset({frozenset({0, 1, 2, 3})})
+    assert table[all_dev] == ("nodes",)
+    # Non-degenerate meshes keep exact attribution.
+    table = hlo_audit.axis_groupings([("nodes", 2), ("txs", 2)])
+    assert table[frozenset({frozenset({0, 1, 2, 3})})] == ("nodes",
+                                                           "txs")
+
+
+def test_collective_coverage_is_partition_based_on_degenerate_mesh():
+    from go_avalanche_tpu.analysis import hlo_audit
+
+    gather = ('    %0 = "stablehlo.all_gather"(%arg0) <{replica_groups '
+              "= dense<[[0, 1, 2, 3]]> : tensor<1x4xi64>}> : "
+              "(tensor<4x2xui8>) -> tensor<16x2xui8>\n")
+    text = _prog(_DONATED_ARG, gather)
+    mesh_axes = [("nodes", 4), ("txs", 1)]
+    # A nodes-axis declaration covers the all-devices grouping on a
+    # nodes-only mesh (both subsets produce the same partition there).
+    assert hlo_audit.collective_coverage_failures(
+        text, frozenset({("all_gather", ("nodes",))}), mesh_axes,
+        "w") == []
+    assert hlo_audit.collective_coverage_failures(
+        text, frozenset({("all_gather", ("nodes", "txs"))}), mesh_axes,
+        "w") == []
+    fails = hlo_audit.collective_coverage_failures(
+        text, frozenset({("all_reduce", ("nodes",))}), mesh_axes, "w")
+    assert any("UNDECLARED collective all_gather" in f for f in fails)
+
+
+def test_audit_text_undonated_buffer():
+    from go_avalanche_tpu.analysis import hlo_audit
+
+    args = ("%arg0: tensor<4xf32> {tf.aliasing_output = 0 : i32}, "
+            "%arg1: tensor<3xi32>")
+    fails = hlo_audit.audit_text(_prog(args), "fixture",
+                                 donated_leaves=2)
+    assert any("donation NOT honored" in f and "1 of 2" in f
+               for f in fails)
+    # The un-donated contract pins the converse too.
+    fails = hlo_audit.audit_text(_prog(args), "fixture",
+                                 donated_leaves=None)
+    assert any("NOT donated" in f for f in fails)
+
+
+def test_real_undonated_leaf_fails_lowered_audit():
+    """JAX silently un-donates a leaf whose buffer matches no output —
+    the exact failure mode the donation audit exists to catch, planted
+    with a real lowering."""
+    import functools
+    import warnings
+
+    import jax
+    import jax.numpy as jnp
+
+    from benchmarks.hlo_pin import strip_locations
+    from go_avalanche_tpu.analysis import hlo_audit
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def bad(s):
+        a, b = s
+        return a + 1, (b * 2).astype(jnp.float32)   # b un-donatable
+
+    abs_in = (jax.ShapeDtypeStruct((4, 4), jnp.float32),
+              jax.ShapeDtypeStruct((3,), jnp.int32))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        text = strip_locations(bad.lower(abs_in).as_text())
+    fails = hlo_audit.audit_text(text, "planted", donated_leaves=2)
+    assert any("donation NOT honored" in f for f in fails)
+
+
+def test_real_planted_callback_fails_offpath_contract():
+    """An io_callback planted into a real program trips the
+    custom-call allowlist — the semantic upgrade over hash equality."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import io_callback
+
+    from benchmarks.hlo_pin import strip_locations
+    from go_avalanche_tpu.analysis import hlo_audit
+
+    def tapped(x):
+        io_callback(lambda v: None, None, x.sum(), ordered=False)
+        return x + 1
+
+    abs_in = jax.ShapeDtypeStruct((8,), jnp.int32)
+    text = strip_locations(jax.jit(tapped).lower(abs_in).as_text())
+    assert hlo_audit.callback_calls(text) == 1
+    fails = hlo_audit.audit_text(text, "planted", callbacks=0)
+    assert any("host-callback" in f for f in fails)
+
+
+# ------------------------------------------ hlo_audit: committed tree
+
+
+def test_all_archived_pins_pass_contract_audit():
+    """The acceptance criterion: every archived pin passes callbacks /
+    dtype / collectives / donation (text cache shared with the drift
+    test — no extra lowering)."""
+    from go_avalanche_tpu.analysis import hlo_audit
+
+    assert hlo_audit.audit_all_pinned() == []
+
+
+def test_off_path_semantic_audit_is_clean():
+    import jax
+
+    from go_avalanche_tpu.analysis import hlo_audit
+
+    assert hlo_audit.audit_off_path(jax.default_backend()) == []
+
+
+def test_sharded_drivers_pass_collective_and_donation_audit():
+    """All five sharded drivers: declared-collective equality across
+    the base+async audit variants, the all-gather plane guard, and
+    compiled input_output_alias coverage of every donated leaf."""
+    from go_avalanche_tpu.analysis import hlo_audit
+
+    assert hlo_audit.audit_all_sharded(compile_donation=True) == []
+
+
+def test_donation_compiled_flagship_fleet_traffic():
+    """The compile-level donation proof for the flagship, the fleet
+    and the traffic program (the ROADMAP donation-soak follow-up,
+    statically)."""
+    from go_avalanche_tpu.analysis import hlo_audit
+
+    for name in ("flagship", "fleet_small", "flagship_traffic"):
+        assert hlo_audit.audit_donation_compiled(name) == [], name
+
+
+# ------------------------------------ hlo_pin: histograms + --explain
+
+
+def test_hlo_pin_update_writes_histogram_and_explain_names_drift(
+        tmp_path, monkeypatch, capsys):
+    """`--update` archives the op histogram next to the hash; a
+    perturbed archive makes `--explain` NAME the differing op classes
+    (exit 1) instead of printing two digests."""
+    import sys
+
+    from benchmarks import hlo_pin
+
+    tiny = {"nodes": 64, "txs": 64, "rounds": 2, "k": 8}
+    archive_path = tmp_path / "hlo_pin.json"
+    archive_path.write_text(json.dumps(
+        {"programs": {"flagship": {"workload": tiny, "hashes": {}}}}))
+    monkeypatch.setattr(hlo_pin, "ARCHIVE", archive_path)
+
+    monkeypatch.setattr(sys, "argv", ["hlo_pin.py", "--update",
+                                      "flagship"])
+    hlo_pin.main()
+    archive = json.loads(archive_path.read_text())
+    entry = archive["programs"]["flagship"]
+    [platform] = entry["hashes"]
+    hist = entry["histograms"][platform]
+    assert hist and all(isinstance(v, int) for v in hist.values())
+
+    # Perturb: wrong hash + a histogram claiming an op class that the
+    # current program does not contain.
+    entry["hashes"][platform] = "0" * 64
+    entry["histograms"][platform] = dict(hist, **{"stablehlo.ghost_op": 3})
+    archive_path.write_text(json.dumps(archive))
+    monkeypatch.setattr(sys, "argv", ["hlo_pin.py", "--explain"])
+    with pytest.raises(SystemExit) as exc:
+        hlo_pin.main()
+    assert exc.value.code == 1
+    err = capsys.readouterr().err
+    assert "stablehlo.ghost_op: 3 -> 0 (VANISHED)" in err
+
+
+def test_stale_flags_orphaned_histograms():
+    from benchmarks import hlo_pin
+
+    stale = hlo_pin.stale_pins({"programs": {
+        "ghost": {"workload": {}, "hashes": {},
+                  "histograms": {"cpu": {"stablehlo.add": 1}}},
+        "flagship": {"workload": {}, "hashes": {"cpu": "x"},
+                     "histograms": {"cpu": {}, "tpu": {}}},
+    }})
+    assert any("ghost" in s and "orphaned" in s for s in stale)
+    assert any("flagship" in s and "[tpu]" in s
+               and "no matching pin hash" in s for s in stale)
+
+
+def test_hlo_pin_stale_rejects_explain():
+    from benchmarks import hlo_pin  # noqa: F401 — parser-level test
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    out = subprocess.run(
+        [sys.executable, str(repo / "benchmarks" / "hlo_pin.py"),
+         "--stale", "--explain"],
+        capture_output=True, text=True, timeout=60, cwd=str(repo))
+    assert out.returncode == 2
+    assert "composes with --list only" in out.stderr
+
+
+# --------------------------------------------------- run_sim --audit
+
+
+def test_run_sim_audit_parser_rejections(capsys):
+    from go_avalanche_tpu import run_sim
+
+    for argv, msg in (
+            (["--audit", "--fleet", "4", "--phase-grid",
+              '{"k": [8]}'], "compile twice"),
+            (["--audit", "--check-invariants"],
+             "no single program to audit"),
+            (["--audit", "--model", "streaming_dag", "--chunk", "4",
+              "--metrics", "/tmp/x.jsonl"],
+             "audit the unchunked spelling")):
+        with pytest.raises(SystemExit) as exc:
+            run_sim.main(argv)
+        assert exc.value.code == 2, argv
+        assert msg in capsys.readouterr().err, argv
+
+
+def test_run_sim_audit_dense_snowball(capsys):
+    from go_avalanche_tpu import run_sim
+
+    result = run_sim.main(["--model", "snowball", "--nodes", "32",
+                           "--max-rounds", "8", "--audit", "--json"])
+    assert result["rounds"] >= 1
+    assert "audit ok: snowball" in capsys.readouterr().err
+
+
+def test_run_sim_audit_fleet_single_compile(capsys):
+    """--audit --fleet lowers through the SAME lru-cached jit the
+    fleet executes, so the run still compiles the audited program
+    exactly once."""
+    from go_avalanche_tpu import fleet as fl
+    from go_avalanche_tpu import run_sim
+
+    misses_before = fl._compiled_fleet.cache_info().misses
+    result = run_sim.main(["--model", "snowball", "--fleet", "4",
+                           "--nodes", "16", "--max-rounds", "6",
+                           "--audit", "--json"])
+    assert result["fleet"] == 4
+    assert "audit ok" in capsys.readouterr().err
+    assert fl._compiled_fleet.cache_info().misses - misses_before <= 1
+
+
+def test_run_sim_audit_mesh_avalanche(capsys):
+    from go_avalanche_tpu import run_sim
+
+    result = run_sim.main(["--model", "avalanche", "--nodes", "16",
+                           "--txs", "8", "--max-rounds", "3", "--mesh",
+                           "4,2", "--audit", "--json"])
+    assert result["rounds"] >= 1
+    assert "audit ok: avalanche" in capsys.readouterr().err
+
+
+# ----------------------------------------------------------- CLI lint
+
+
+def test_analysis_cli_lint_subcommand_runs_jax_free():
+    """`python -m go_avalanche_tpu.analysis lint` exits 0 on the clean
+    tree without importing jax (JAX_PLATFORMS poisoned to prove it)."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    env = dict(os.environ, JAX_PLATFORMS="no_such_backend")
+    out = subprocess.run(
+        [sys.executable, "-m", "go_avalanche_tpu.analysis", "lint"],
+        capture_output=True, text=True, timeout=120, cwd=str(repo),
+        env=env)
+    assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]
+    assert "lint clean" in out.stdout

@@ -193,8 +193,8 @@ def test_clustered_locality_statistics():
     for seed in range(32):
         p = np.asarray(sample_peers_clustered(jax.random.key(seed), w, n, k,
                                               c, loc))
-        cluster_of = np.arange(n) * c // n
-        own += (cluster_of[p] == cluster_of[:, None]).sum()
+        cluster_ids = np.arange(n) * c // n
+        own += (cluster_ids[p] == cluster_ids[:, None]).sum()
         total += p.size
     frac = own / total
     assert abs(frac - loc) < 0.03, frac
@@ -217,8 +217,8 @@ def test_clustered_full_locality_never_leaves_cluster():
     n, k, c = 48, 8, 6
     p = np.asarray(sample_peers_clustered(jax.random.key(1), jnp.ones((n,)),
                                           n, k, c, 1.0))
-    cluster_of = np.arange(n) * c // n
-    assert (cluster_of[p] == cluster_of[:, None]).all()
+    cluster_ids = np.arange(n) * c // n
+    assert (cluster_ids[p] == cluster_ids[:, None]).all()
 
 
 def test_clustered_sharded_offset_rows():
