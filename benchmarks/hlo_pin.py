@@ -61,6 +61,16 @@ entry):
                      byte-identical, and flagship_trace with the plane
                      forced off == the flagship_async_coalesced pin) is
                      covered by `--verify-off-path`;
+  flagship_adversary — the ADAPTIVE-adversary program: split_vote
+                     (`cfg.adversary_policy`, ops/adversary.py) on the
+                     coalesced async flagship at byzantine fraction
+                     0.125 — the per-round honest-split context plane,
+                     the policy-content exchange transform and the
+                     policy-stamped latency plane are all in the timed
+                     program.  The off path (policy "off" + byzantine
+                     0, forced explicitly == the archived
+                     flagship_async_coalesced pin) is covered by
+                     `--verify-off-path`;
   flagship_traffic — the `bench.py --arrival` program: the streaming
                      backlog scheduler (`models/backlog.step`) under
                      live-traffic poisson arrival with closed-loop
@@ -143,7 +153,9 @@ def flagship_stablehlo(nodes: int, txs: int, rounds: int, k: int,
                        trace_every: int = 0,
                        faults=None,
                        stake: str = "off",
-                       clusters: int = 1) -> str:
+                       clusters: int = 1,
+                       adversary: str = "off",
+                       byzantine: float = 0.0) -> str:
     """StableHLO text of the flagship bench program at the given shape.
 
     Abstract lowering: `jax.eval_shape` turns the state builder into
@@ -166,7 +178,8 @@ def flagship_stablehlo(nodes: int, txs: int, rounds: int, k: int,
     cfg = flagship_config(txs, k, latency, inflight_engine=inflight,
                           metrics_every=metrics_every,
                           trace_every=trace_every, stake=stake,
-                          clusters=clusters)
+                          clusters=clusters, adversary=adversary,
+                          byzantine=byzantine)
     if exchange != "fused":
         cfg = dataclasses.replace(cfg, fused_exchange=False)
     if ingest != "u8":
@@ -300,6 +313,9 @@ PROGRAMS = {
     "flagship_trace": (dict(FLAGSHIP, latency=2, inflight="coalesced",
                             trace_every=2),
                        lambda w: flagship_stablehlo(**w)),
+    "flagship_adversary": (dict(FLAGSHIP, latency=2, inflight="coalesced",
+                                adversary="split_vote", byzantine=0.125),
+                           lambda w: flagship_stablehlo(**w)),
     "flagship_traffic": (dict(TRAFFIC),
                          lambda w: traffic_stablehlo(**w)),
     "streaming_step": (dict(STREAMING),
@@ -320,6 +336,7 @@ PROGRAM_BUILDERS = {
     "flagship_faults": ("flagship_config", "flagship_state"),
     "flagship_stake": ("flagship_config", "flagship_state"),
     "flagship_trace": ("flagship_config", "flagship_state"),
+    "flagship_adversary": ("flagship_config", "flagship_state"),
     "fleet_small": ("flagship_config", "fleet_flagship_state"),
     "flagship_traffic": ("traffic_config", "traffic_backlog_state"),
     "streaming_step": ("northstar_config", "northstar_state"),
@@ -464,13 +481,16 @@ def verify_off_path(platform: str, archive: dict | None = None) -> list:
         workload["trace_every"] = 0
         workload["faults"] = []
         workload["stake"] = "off"
+        workload["adversary"] = "off"
+        workload["byzantine"] = 0.0
         current = program_hash(name, workload)
         if current != pinned:
             failures.append(
                 f"{name}: metrics-off trace-off empty-script stake-off "
-                f"program {current} != pinned {pinned} — the obs tap, "
-                f"the trace plane, the fault-script engine or the "
-                f"stake subsystem leaks into the off path")
+                f"adversary-off program {current} != pinned {pinned} — "
+                f"the obs tap, the trace plane, the fault-script "
+                f"engine, the stake subsystem or the adversary-policy "
+                f"engine leaks into the off path")
     for tapped, base, overrides, what in (
             ("flagship_metrics", "flagship", {"metrics_every": 0},
              "the tapped program differs from the untapped one by more "
@@ -485,7 +505,12 @@ def verify_off_path(platform: str, archive: dict | None = None) -> list:
             ("flagship_trace", "flagship_async_coalesced",
              {"trace_every": 0},
              "the trace-plane program differs from the coalesced async "
-             "flagship by more than the trace tap")):
+             "flagship by more than the trace tap"),
+            ("flagship_adversary", "flagship_async_coalesced",
+             {"adversary": "off", "byzantine": 0.0},
+             "the adaptive-adversary program differs from the "
+             "coalesced async flagship by more than the policy "
+             "engine")):
         on = archive.get("programs", {}).get(tapped)
         off = archive.get("programs", {}).get(base)
         if not (on and off and off.get("hashes", {}).get(platform)):

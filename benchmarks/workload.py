@@ -33,7 +33,9 @@ def flagship_config(txs: int, k: int = 8, latency: int = 0,
                     metrics_every: int = 0,
                     trace_every: int = 0,
                     stake: str = "off",
-                    clusters: int = 1):
+                    clusters: int = 1,
+                    adversary: str = "off",
+                    byzantine: float = 0.0):
     """The flagship bench config alone — buildable without materializing
     state (how `benchmarks/hlo_pin.py` lowers the full-shape program
     abstractly): finalization unreachable within the timed window
@@ -59,7 +61,14 @@ def flagship_config(txs: int, k: int = 8, latency: int = 0,
     and with `clusters > 1` the two-level HIERARCHICAL engine
     (`ops/sampling.sample_peers_hierarchical`) — the program pinned
     as `flagship_stake`; stake off leaves every archived flagship
-    pin byte-identical (`hlo_pin.py --verify-off-path`)."""
+    pin byte-identical (`hlo_pin.py --verify-off-path`).
+    `adversary` != "off" selects an ADAPTIVE adversary policy
+    (`cfg.adversary_policy`, ops/adversary.py) with `byzantine` as the
+    byzantine fraction — the program pinned as `flagship_adversary`
+    runs split_vote on the coalesced async flagship; policy off +
+    byzantine 0 leaves every archived pin byte-identical (no context
+    plane is built).  Adversary knobs change config VALUES only, never
+    state shapes, so `flagship_state` needs no adversary arguments."""
     from go_avalanche_tpu.config import AvalancheConfig
 
     async_kw = {}
@@ -77,12 +86,16 @@ def flagship_config(txs: int, k: int = 8, latency: int = 0,
                         time_step_s=1.0,
                         request_timeout_s=float(tr - 1),
                         inflight_engine=inflight_engine)
+    adv_kw = {}
+    if adversary != "off" or byzantine > 0.0:
+        adv_kw = dict(byzantine_fraction=byzantine,
+                      adversary_policy=adversary)
     return AvalancheConfig(finalization_score=0x7FFE, k=k, gossip=False,
                            max_element_poll=max(4096, txs),
                            metrics_every=metrics_every,
                            trace_every=trace_every,
                            stake_mode=stake, n_clusters=clusters,
-                           **async_kw)
+                           **async_kw, **adv_kw)
 
 
 def flagship_state(nodes: int, txs: int, k: int = 8, latency: int = 0,

@@ -1,0 +1,213 @@
+"""The adaptive-adversary atlas: the 2409.02217 phase boundary as a
+fleet phase diagram.
+
+"Quantifying Liveness and Safety of Avalanche's Snowball"
+(arXiv 2409.02217) derives Snowball's liveness/safety failure
+probabilities as functions of (byzantine fraction, k, quorum); "An
+Analysis of Avalanche Consensus" (arXiv 2401.02811) constructs the
+adversary that realizes the liveness half: choose votes *as a function
+of observed network state* so the honest population never leaves its
+even split.  This study runs that adversary — `adversary_policy =
+"split_vote"` (`ops/adversary.py`) — over the (byzantine_fraction, k,
+quorum) cube as ONE fleet phase grid (`fleet.run_phase_grid`: one
+vmapped Monte-Carlo fleet per point, re-jit per point) and maps BOTH
+failure modes with Wilson CIs:
+
+  * **P(stall)** — the in-graph liveness detector
+    (`fleet.liveness_stalled`): honest-majority exists yet no honest
+    record finalized by the horizon.  The paper's prediction, and what
+    this atlas checks point-blank: monotone-INCREASING in byzantine
+    fraction at fixed (k, quorum), with a sharp boundary (the
+    metastable band) between the always-settles and never-settles
+    phases; a larger quorum margin (window - quorum) pushes the
+    boundary right.
+  * **P(safety violation)** — the PR-7 quorum-divergence detector:
+    two honest nodes finalizing opposite colors.  split_vote is a
+    LIVENESS attack; its safety row stays near zero below the
+    boundary, which is itself a claim worth the CI.
+
+The run ends with a **detector spot-check** at the most hostile point:
+the fleet re-runs with the on-device trace plane (`cfg.trace_every=1`,
+obs/trace.py) and every trial's stall verdict is checked against its
+trace-plane finality curve — a stalled trial's cumulative
+`finalizations` counter can only carry byzantine rows (at most
+round(byz * N)); a trial with any honest finalization must show a
+non-zero curve.  Two independent measurement paths (final-state
+reduction vs per-round telemetry) agreeing per trial is what makes the
+detector a detector rather than a restatement.
+
+CPU-shape defaults (64 nodes, 48-trial fleets) finish in a few
+minutes; the same script is the TPU-window atlas at paper scale
+(--fleet 1024 --nodes 1024).
+
+Usage:
+    python examples/adversary_atlas.py [--nodes 64] [--fleet 48]
+        [--rounds 120] [--json-out examples/out/adversary_atlas.json]
+        [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")  # allow running from the repo root
+
+from go_avalanche_tpu.config import AvalancheConfig
+from go_avalanche_tpu import fleet
+
+BYZ_GRID = (0.05, 0.15, 0.25, 0.35, 0.45)
+K_GRID = (4, 8)
+QUORUM_GRID = (5, 7)
+
+
+def run_atlas(nodes: int, fleet_size: int, rounds: int, fin_score: int,
+              byz_grid, k_grid, quorum_grid, seed: int = 0):
+    """One `run_phase_grid` over the (byz, k, quorum) cube; returns the
+    phase rows.  The base config carries the policy and a non-zero
+    byzantine fraction (the grid overrides it per point — an all-zero
+    base would reject the policy as inert)."""
+    base = AvalancheConfig(finalization_score=fin_score,
+                           byzantine_fraction=byz_grid[0],
+                           adversary_policy="split_vote")
+    grid = {"byzantine_fraction": list(byz_grid),
+            "k": list(k_grid),
+            "quorum": list(quorum_grid)}
+    return fleet.run_phase_grid("snowball", base, grid, fleet=fleet_size,
+                                n_nodes=nodes, n_rounds=rounds, seed=seed,
+                                yes_fraction=0.5)
+
+
+def monotonicity_report(rows, byz_grid, k_grid, quorum_grid):
+    """Per-(k, quorum) curve of P(stall) vs byzantine fraction, with the
+    monotone-increase check the 2409.02217 boundary predicts.  A dip is
+    only counted as a violation when the Wilson CIs are disjoint —
+    finite fleets wobble inside their intervals."""
+    by_point = {(r["point"]["k"], r["point"]["quorum"],
+                 r["point"]["byzantine_fraction"]): r for r in rows}
+    curves = []
+    for k in k_grid:
+        for q in quorum_grid:
+            pts = [by_point[(k, q, b)] for b in byz_grid]
+            violations = [
+                (byz_grid[i], byz_grid[i + 1])
+                for i in range(len(pts) - 1)
+                # a genuine decrease: the later CI sits wholly below
+                # the earlier one
+                if pts[i + 1]["stall_ci"][1] < pts[i]["stall_ci"][0]]
+            curves.append({
+                "k": k, "quorum": q,
+                "byz": list(byz_grid),
+                "p_stall": [p["p_stall"] for p in pts],
+                "stall_ci": [p["stall_ci"] for p in pts],
+                "p_violation": [p["p_violation"] for p in pts],
+                "monotone": not violations,
+                "monotonicity_violations": violations,
+            })
+    return curves
+
+
+def spot_check(nodes: int, fleet_size: int, rounds: int, fin_score: int,
+               byz: float, seed: int = 0):
+    """Re-run the most hostile point with the per-trial trace plane and
+    check every trial's stall verdict against its trace finality curve
+    (see module docstring).  Returns the per-trial comparison; raises
+    on any disagreement — the atlas must not ship with a detector that
+    contradicts the telemetry it summarizes."""
+    cfg = AvalancheConfig(finalization_score=fin_score,
+                          byzantine_fraction=byz,
+                          adversary_policy="split_vote",
+                          trace_every=1)
+    res = fleet.run_fleet("snowball", cfg, fleet=fleet_size,
+                          n_nodes=nodes, n_rounds=rounds, seed=seed,
+                          yes_fraction=0.5)
+    records = res.trace_records()
+    n_byz = int(round(byz * nodes))
+    trials = []
+    for i in range(fleet_size):
+        total_fin = sum(rec["finalizations"][i] for rec in records)
+        stalled = bool(res.stalled[i])
+        # Stalled: no HONEST row finalized, so the all-rows trace
+        # counter can only carry byzantine finalizations.  Not stalled
+        # with any finalized fraction: the curve must be non-zero.
+        if stalled:
+            ok = total_fin <= n_byz
+        elif res.finalized_fraction[i] > 0:
+            ok = total_fin > 0
+        else:
+            ok = True   # honest-minority trials: detector abstains
+        trials.append({"trial": i, "stalled": stalled,
+                       "trace_finalizations": int(total_fin),
+                       "agrees": ok})
+        if not ok:
+            raise AssertionError(
+                f"stall detector disagrees with the trace-plane "
+                f"finality curve on trial {i}: stalled={stalled}, "
+                f"cumulative finalizations={total_fin} (n_byz={n_byz})")
+    return {"byz": byz, "n_byz": n_byz, "p_stall": res.p_stall,
+            "trials_checked": fleet_size, "trials": trials}
+
+
+def main(argv=None) -> dict:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--nodes", type=int, default=64)
+    ap.add_argument("--fleet", type=int, default=48)
+    ap.add_argument("--rounds", type=int, default=120)
+    ap.add_argument("--finalization-score", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--quick", action="store_true",
+                    help="3-point byz grid at (k=8, quorum=7) only — "
+                         "the smoke spelling the test suite runs")
+    ap.add_argument("--json-out", type=str,
+                    default="examples/out/adversary_atlas.json")
+    args = ap.parse_args(argv)
+
+    byz_grid = (0.05, 0.25, 0.45) if args.quick else BYZ_GRID
+    k_grid = (8,) if args.quick else K_GRID
+    quorum_grid = (7,) if args.quick else QUORUM_GRID
+
+    t0 = time.time()
+    rows = run_atlas(args.nodes, args.fleet, args.rounds,
+                     args.finalization_score, byz_grid, k_grid,
+                     quorum_grid, seed=args.seed)
+    curves = monotonicity_report(rows, byz_grid, k_grid, quorum_grid)
+
+    print(f"# adversary atlas — split_vote on snowball, {args.nodes} "
+          f"nodes, {args.fleet}-trial fleets, {args.rounds}-round "
+          f"horizon, finalization {args.finalization_score}")
+    for c in curves:
+        print(f"\nk={c['k']} quorum={c['quorum']}   "
+              f"(monotone: {c['monotone']})")
+        print(f"{'byz':>6} {'P(stall)':>9} {'stall CI':>18} "
+              f"{'P(violation)':>13}")
+        for b, p, ci, v in zip(c["byz"], c["p_stall"], c["stall_ci"],
+                               c["p_violation"]):
+            print(f"{b:>6} {p:>9.3f} [{ci[0]:.3f}, {ci[1]:.3f}]"
+                  f"{v:>12.3f}")
+
+    check = spot_check(args.nodes, min(args.fleet, 16), args.rounds,
+                       args.finalization_score, byz_grid[-1],
+                       seed=args.seed)
+    print(f"\nspot-check @ byz={check['byz']}: stall verdicts agree "
+          f"with the trace-plane finality curves on all "
+          f"{check['trials_checked']} trials "
+          f"(P(stall) = {check['p_stall']:.3f})")
+
+    result = {"nodes": args.nodes, "fleet": args.fleet,
+              "rounds": args.rounds,
+              "finalization_score": args.finalization_score,
+              "curves": curves, "rows": rows, "spot_check": check,
+              "elapsed_s": round(time.time() - t0, 1)}
+    if args.json_out:
+        os.makedirs(os.path.dirname(args.json_out), exist_ok=True)
+        with open(args.json_out, "w") as fh:
+            json.dump(result, fh, indent=1)
+        print(f"wrote {args.json_out}")
+    return result
+
+
+if __name__ == "__main__":
+    main()

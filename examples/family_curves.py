@@ -117,10 +117,14 @@ def main() -> None:
     byz_fracs = [float(b) for b in args.byzantine.split(",")]
     rows = []
     for byz in byz_fracs:
-        cfg = AvalancheConfig(
-            byzantine_fraction=byz, flip_probability=1.0,
-            adversary_strategy=AdversaryStrategy(args.adversary),
-            finalization_score=args.beta)
+        # The strategy knob rides along only when byz > 0 — at the
+        # honest-baseline 0.0 point it is inert and the config
+        # validator rejects it (PR 13's inert-knob rule).
+        adv = (dict(flip_probability=1.0,
+                    adversary_strategy=AdversaryStrategy(args.adversary))
+               if byz > 0 else {})
+        cfg = AvalancheConfig(byzantine_fraction=byz,
+                              finalization_score=args.beta, **adv)
         for name, runner in PROTOCOLS.items():
             budget = (args.max_rounds // 10 if name == "slush"
                       else args.max_rounds)

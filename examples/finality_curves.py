@@ -37,8 +37,13 @@ from go_avalanche_tpu.utils import metrics
 def run_point(n_nodes: int, n_txs: int, byzantine: float, seed: int,
               max_rounds: int, adversary: str = "flip",
               contested: bool = False) -> dict:
-    cfg = AvalancheConfig(byzantine_fraction=byzantine,
-                          adversary_strategy=AdversaryStrategy(adversary))
+    # The strategy knob rides along only when byzantine > 0 — at the
+    # honest-baseline point it is inert and the config validator
+    # rejects it (PR 13's inert-knob rule).
+    cfg = AvalancheConfig(
+        byzantine_fraction=byzantine,
+        **(dict(adversary_strategy=AdversaryStrategy(adversary))
+           if byzantine > 0 else {}))
     # Per-NODE 50/50 priors: the paper's experimental setup, where the
     # network must actually converge on a value (a unanimous network's
     # finality is size-independent — a flat line that proves nothing).

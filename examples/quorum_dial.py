@@ -120,16 +120,24 @@ def _agreement_one(n_nodes: int, n_txs: int, set_size: int, rounds: int,
     lane0 = (jnp.arange(n_txs) % set_size) == 0
     even_rows = (jnp.arange(n_nodes)[:, None] % 2) == 0
     init_pref = jnp.where(even_rows, lane0[None, :], ~lane0[None, :])
+    # The adversary knobs only ride along when eps > 0 — at eps == 0
+    # they are inert and the config validator rejects them (PR 13's
+    # inert-knob rule); the (0, drop) safety cell measures drops alone.
+    adv = (dict(flip_probability=1.0,
+                adversary_strategy=AdversaryStrategy.EQUIVOCATE)
+           if eps > 0 else {})
     cfg = AvalancheConfig(window=window, quorum=quorum,
                           byzantine_fraction=eps,
-                          drop_probability=drop, flip_probability=1.0,
-                          adversary_strategy=AdversaryStrategy.EQUIVOCATE)
+                          drop_probability=drop, **adv)
     state = dag.init(jax.random.key(seed), n_nodes, cs, cfg,
                      init_pref=init_pref)
-    # eps enters only `init` (byzantine mask is state); zeroing it in the
-    # jitted cfg shares one compile across eps cells (see
-    # equivocation_threshold.sweep_cell).
-    run_cfg = dataclasses.replace(cfg, byzantine_fraction=0.0)
+    # eps enters only `init` (byzantine mask is state); pinning it at a
+    # shared non-zero constant in the jitted cfg shares one compile across
+    # the eps > 0 cells (see equivocation_threshold.sweep_cell — zero
+    # would reject as an inert-knob config).  The eps == 0 cell keeps its
+    # own knob-free config (a separate, equally shared compile key).
+    run_cfg = (dataclasses.replace(cfg, byzantine_fraction=1.0)
+               if eps > 0 else cfg)
     final, _ = jax.jit(dag.run_scan, static_argnames=("cfg", "n_rounds"))(
         state, run_cfg, rounds)
     conf = final.base.records.confidence

@@ -576,7 +576,9 @@ def _compile_pinned(name: str, workload: Dict) -> str:
                                                          0),
                               trace_every=workload.get("trace_every", 0),
                               stake=workload.get("stake", "off"),
-                              clusters=workload.get("clusters", 1))
+                              clusters=workload.get("clusters", 1),
+                              adversary=workload.get("adversary", "off"),
+                              byzantine=workload.get("byzantine", 0.0))
         if workload.get("exchange", "fused") != "fused":
             cfg = _dc.replace(cfg, fused_exchange=False)
         if workload.get("ingest", "u8") != "u8":
@@ -617,7 +619,7 @@ def audit_off_path(platform: str, archive: Optional[Dict] = None
         workload = dict(entry.get("workload")
                         or hlo_pin.PROGRAMS[name][0])
         workload.update(metrics_every=0, trace_every=0, faults=[],
-                        stake="off")
+                        stake="off", adversary="off", byzantine=0.0)
         failures.extend(audit_pinned(name, workload))
     return failures
 

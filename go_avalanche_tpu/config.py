@@ -52,6 +52,43 @@ class AdversaryStrategy(enum.Enum):
     OPPOSE_MAJORITY = "oppose_majority"
 
 
+# Adaptive adversary policies (`cfg.adversary_policy`, ops/adversary.py):
+# jit-static attack KINDS that read the current network state each round
+# — the arXiv 2401.02811 class of adversaries the static strategies
+# can't express (a strategy decides what one lie says; a policy decides
+# WHERE/WHEN/WHAT as a function of the observed state).  "off" is the
+# exact pre-policy code path: no context plane is built and every
+# archived hlo pin is byte-identical (hlo_pin.py --verify-off-path).
+#
+#   split_vote           — lies vote the HONEST population's minority
+#                          color per target (equivocation coins on an
+#                          exact tie), holding honest preferences at an
+#                          even split — the 2401.02811 stall attack.
+#                          Overrides the strategy's lie CONTENT.
+#   withhold_near_quorum — lying draws go SILENT (no responded bit;
+#                          with the async engine on they get the
+#                          never-delivers sentinel and expire through
+#                          the existing timeout machinery) exactly when
+#                          the querier holds a record within
+#                          `adversary_margin` window votes of the
+#                          conclusive quorum — denying the finishing
+#                          votes.
+#   stake_eclipse        — lies concentrate on the top-stake HONEST
+#                          queriers (the most-sampled responders, whose
+#                          poisoned preferences propagate furthest
+#                          through stake-weighted committees); needs a
+#                          stake_mode.  Eclipse-set size is
+#                          max(1, round(byzantine_fraction * N)),
+#                          saturating at the honest population.
+#   timing               — lying responses are DELAYED via the latency
+#                          plane to land at age timeout_rounds() - 1,
+#                          just before expiry (stalest-possible lies,
+#                          maximum time-in-flight); needs the async
+#                          engine.
+ADVERSARY_POLICIES = ("off", "split_vote", "withhold_near_quorum",
+                      "stake_eclipse", "timing")
+
+
 # Fault-script event schema: kind -> positional field names after the
 # kind tag — the one source for both spellings (tuple arity/shape in
 # `_validate_fault_script`, JSON object keys in `fault_script_from_json`,
@@ -653,6 +690,37 @@ class AvalancheConfig:
     flip_probability: float = 1.0     # P(byzantine node lies, per draw)
     adversary_strategy: AdversaryStrategy = AdversaryStrategy.FLIP
                                       # what the lie says (ops/adversary.py)
+    adversary_policy: str = "off"     # adaptive adversary policy (see
+                                      #   ADVERSARY_POLICIES): a
+                                      #   jit-static attack kind that
+                                      #   reads the CURRENT network
+                                      #   state each round — per-round
+                                      #   context planes built by
+                                      #   ops/adversary.policy_ctx,
+                                      #   composing with
+                                      #   byzantine_fraction (who) and
+                                      #   flip_probability (how often);
+                                      #   the strategy supplies the lie
+                                      #   content except under
+                                      #   split_vote, which overrides
+                                      #   it.  "off" = statically
+                                      #   absent — every archived hlo
+                                      #   pin byte-identical.  All
+                                      #   adversary knobs are rejected
+                                      #   as inert when
+                                      #   byzantine_fraction == 0 (the
+                                      #   _validate_stake /
+                                      #   _validate_arrival precedent)
+    adversary_margin: int = 1         # withhold_near_quorum only: a
+                                      #   querier is "near quorum" when
+                                      #   some live record's window
+                                      #   yes- or no-count is within
+                                      #   this many votes of the
+                                      #   conclusive quorum (>= quorum
+                                      #   - margin).  Rejected at any
+                                      #   non-default value under other
+                                      #   policies — a silently ignored
+                                      #   margin would mislabel the run
     drop_probability: float = 0.0     # P(a sampled peer fails to respond
                                       #   => neutral vote, vote.go:56 semantics)
     churn_probability: float = 0.0    # P(a node toggles dead<->alive, per
@@ -858,6 +926,7 @@ class AvalancheConfig:
         self._validate_rtt_matrix()
         self._validate_arrival()
         self._validate_stake()
+        self._validate_adversary()
         if self.latency_mode == "rtt":
             if self.rtt_matrix is None:
                 raise ValueError(
@@ -1291,6 +1360,91 @@ class AvalancheConfig:
                 "node_churn_rate is only read by the node-stream "
                 "scheduler (registry_nodes > 0) — without the registry "
                 "the knob is inert and would mislabel the run")
+
+    def _validate_adversary(self) -> None:
+        """Fault / adversary knobs: reject inert or out-of-range configs
+        at CONSTRUCTION (the `_validate_stake`/`_validate_arrival`
+        inert-knob precedent — a silently ignored adversary knob would
+        mislabel the run as attacked); run_sim mirrors these at its
+        parser.
+
+        NOTE byzantine_fraction == 0 rejects the OTHER adversary knobs
+        at non-default values.  The byzantine mask itself is sim STATE
+        (it enters at `init` only), so a run config paired with a
+        byzantine state must keep its fraction non-zero — the
+        compile-sharing idiom in examples/equivocation_threshold.py
+        pins it at a shared non-zero constant for exactly this reason.
+        """
+        if not (0.0 <= self.byzantine_fraction <= 1.0):
+            raise ValueError(
+                f"byzantine_fraction must be in [0, 1], got "
+                f"{self.byzantine_fraction!r}")
+        if not (0.0 <= self.flip_probability <= 1.0):
+            raise ValueError(
+                f"flip_probability must be in [0, 1], got "
+                f"{self.flip_probability!r}")
+        if self.adversary_policy not in ADVERSARY_POLICIES:
+            raise ValueError(
+                f"adversary_policy must be one of "
+                f"{', '.join(ADVERSARY_POLICIES)}, got "
+                f"{self.adversary_policy!r}")
+        if (isinstance(self.adversary_margin, bool)
+                or not isinstance(self.adversary_margin, int)
+                or self.adversary_margin < 0):
+            raise ValueError(
+                f"adversary_margin must be a non-negative integer "
+                f"(window votes short of the quorum), got "
+                f"{self.adversary_margin!r}")
+        if self.byzantine_fraction == 0.0:
+            inert = []
+            if self.adversary_strategy is not AdversaryStrategy.FLIP:
+                inert.append(
+                    f"adversary_strategy={self.adversary_strategy.value}")
+            if self.flip_probability != 1.0:
+                inert.append(f"flip_probability={self.flip_probability!r}")
+            if self.adversary_policy != "off":
+                inert.append(f"adversary_policy={self.adversary_policy}")
+            if self.adversary_margin != 1:
+                inert.append(f"adversary_margin={self.adversary_margin}")
+            if inert:
+                raise ValueError(
+                    f"{', '.join(inert)} set while byzantine_fraction "
+                    f"== 0: with no byzantine nodes every adversary "
+                    f"knob is inert and would mislabel the run as "
+                    f"attacked — set byzantine_fraction > 0 (the "
+                    f"byzantine mask is drawn at init from it)")
+            return
+        if (self.adversary_margin != 1
+                and self.adversary_policy != "withhold_near_quorum"):
+            raise ValueError(
+                f"adversary_margin is only read by adversary_policy "
+                f"'withhold_near_quorum', got margin "
+                f"{self.adversary_margin} with policy "
+                f"{self.adversary_policy!r} — a silently ignored margin "
+                f"would mislabel the run")
+        if (self.adversary_policy == "split_vote"
+                and self.adversary_strategy is not AdversaryStrategy.FLIP):
+            raise ValueError(
+                f"adversary_policy 'split_vote' OVERRIDES the lie "
+                f"content (lies vote the honest-minority color), so "
+                f"adversary_strategy {self.adversary_strategy.value!r} "
+                f"would be silently ignored and mislabel the run — "
+                f"leave the strategy at its default under split_vote")
+        if self.adversary_policy == "timing" and not self.async_queries():
+            raise ValueError(
+                "adversary_policy 'timing' delays lying responses "
+                "through the in-flight latency plane (ops/inflight.py), "
+                "which the synchronous ideal never builds — select a "
+                "latency_mode (or schedule a cut/spike fault) to turn "
+                "the async engine on")
+        if (self.adversary_policy == "stake_eclipse"
+                and self.stake_mode == "off"):
+            raise ValueError(
+                "adversary_policy 'stake_eclipse' concentrates lies on "
+                "the top-STAKE queriers; with stake_mode 'off' every "
+                "node is weightless and the eclipse set is arbitrary — "
+                "select a stake_mode ('zipf' puts the adversary on top "
+                "stake, the worst case)")
 
     def _validate_rtt_matrix(self) -> None:
         """The cluster-pair RTT matrix must be square, match the

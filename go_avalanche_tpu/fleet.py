@@ -80,7 +80,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from go_avalanche_tpu.config import AdversaryStrategy, AvalancheConfig
+from go_avalanche_tpu.config import (
+    ADVERSARY_POLICIES,
+    AdversaryStrategy,
+    AvalancheConfig,
+)
 from go_avalanche_tpu.ops import voterecord as vr
 
 FLEET_MODELS = ("snowball", "avalanche", "dag", "backlog")
@@ -165,6 +169,33 @@ def dag_safety_violated(state, cfg: AvalancheConfig) -> jax.Array:
     return (per_set >= 2).any()
 
 
+def liveness_stalled(finalized: jax.Array, byzantine: jax.Array,
+                     alive: jax.Array) -> jax.Array:
+    """The in-graph LIVENESS/stall detector — the complement of the
+    safety detectors above: an honest-majority network that still
+    finalized NOTHING by the horizon has been denied progress (the
+    arXiv 2401.02811 stall; 2409.02217's liveness-failure event).
+
+    Scalar bool, per trial under the fleet vmap.  `finalized` is the
+    final-state `vr.has_finalized` plane (``[N]`` or ``[N, T]``; any
+    polarity — a stalled network finalizes nothing at all), `byzantine`
+    / `alive` the final bool ``[N]`` planes.  Two byzantine exclusions,
+    mirroring the safety detectors' honest-only quantification:
+
+      * only HONEST finalizations count as progress — an adversary
+        "finalizing" its own records proves nothing about liveness;
+      * the verdict only fires while live honest nodes still hold a
+        majority of the population — a network the adversary + churn
+        actually overwhelmed has no liveness guarantee to violate, so
+        reporting it as a detected stall would inflate P(stall) with
+        trials outside the theorem's hypothesis.
+    """
+    honest = jnp.logical_not(byzantine)
+    majority = (honest & alive).sum() * 2 > byzantine.shape[0]
+    fin_rows = finalized if finalized.ndim == 1 else finalized.any(axis=1)
+    return majority & jnp.logical_not((fin_rows & honest).any())
+
+
 class TrialOutcome(NamedTuple):
     """One fleet trial's in-graph reduction (scalars; ``[F]``-stacked
     under the fleet vmap)."""
@@ -174,6 +205,9 @@ class TrialOutcome(NamedTuple):
     finality_round: jax.Array     # int32 — round the LAST honest record
                                   #   finalized; -1 while unsettled
     finalized_fraction: jax.Array  # float32 — honest records finalized
+    stalled: jax.Array            # bool — honest majority exists yet no
+                                  #   honest record finalized by the
+                                  #   horizon (`liveness_stalled`)
     cut_start: Optional[jax.Array] = None  # int32 [Ec] realized windows
     cut_end: Optional[jax.Array] = None    # (None: no stochastic cuts)
     cut_split: Optional[jax.Array] = None  # int32 [Ec] realized node
@@ -223,6 +257,7 @@ def _outcome_snowball(state, cfg: AvalancheConfig) -> TrialOutcome:
         settled=settled,
         finality_round=jnp.where(settled, stamped.max(), jnp.int32(-1)),
         finalized_fraction=(fin & honest).sum() / honest.sum(),
+        stalled=liveness_stalled(fin, state.byzantine, state.alive),
         **_fault_realizations(state.fault_params))
 
 
@@ -237,6 +272,7 @@ def _outcome_avalanche(state, cfg: AvalancheConfig) -> TrialOutcome:
         finality_round=jnp.where(settled, stamped.max(), jnp.int32(-1)),
         finalized_fraction=((fin & honest).sum()
                             / honest.sum() / fin.shape[1]),
+        stalled=liveness_stalled(fin, state.byzantine, state.alive),
         **_fault_realizations(state.fault_params))
 
 
@@ -275,6 +311,12 @@ def _outcome_dag(state, cfg: AvalancheConfig) -> TrialOutcome:
         settled=settled,
         finality_round=jnp.where(settled, stamped.max(), jnp.int32(-1)),
         finalized_fraction=frac,
+        # Any-polarity finalization counts as progress (a resolved set
+        # finalizes its winner accepted and may finalize rivals
+        # rejected); a stalled DAG finalizes neither.
+        stalled=liveness_stalled(
+            vr.has_finalized(base.records.confidence, cfg),
+            base.byzantine, base.alive),
         **_fault_realizations(base.fault_params))
 
 
@@ -304,6 +346,12 @@ def _outcome_backlog(state, cfg: AvalancheConfig) -> TrialOutcome:
         finality_round=jnp.where(settled, out.settle_round.max(),
                                  jnp.int32(-1)),
         finalized_fraction=out.settled.mean().astype(jnp.float32),
+        # A harvested settled tx is progress even after its window slot
+        # recycled, so the stream-level stall gates on BOTH planes.
+        stalled=(liveness_stalled(
+            vr.has_finalized(state.sim.records.confidence, cfg),
+            state.sim.byzantine, state.sim.alive)
+            & jnp.logical_not(out.settled.any())),
         **_fault_realizations(state.sim.fault_params),
         **lat)
 
@@ -402,6 +450,7 @@ class FleetResult:
     settled: np.ndarray             # bool [F]
     finality_round: np.ndarray      # int32 [F]; -1 where unsettled
     finalized_fraction: np.ndarray  # float32 [F]
+    stalled: np.ndarray             # bool [F] — liveness_stalled verdicts
     telemetry: object               # stacked telemetry pytree [F, R]
     cut_windows: Optional[np.ndarray]  # int32 [F, Ec, 2] realized
                                     #   stochastic [start, end) windows
@@ -430,6 +479,8 @@ class FleetResult:
     violation_ci: Tuple[float, float] = (0.0, 0.0)
     p_settled: float = 0.0
     settled_ci: Tuple[float, float] = (0.0, 0.0)
+    p_stall: float = 0.0
+    stall_ci: Tuple[float, float] = (0.0, 0.0)
     finality_mean: Optional[float] = None   # over settled trials
     finality_ci: Optional[Tuple[float, float]] = None
 
@@ -444,6 +495,9 @@ class FleetResult:
             "violation_ci": [round(x, 6) for x in self.violation_ci],
             "p_settled": round(self.p_settled, 6),
             "settled_ci": [round(x, 6) for x in self.settled_ci],
+            "stalls": int(self.stalled.sum()),
+            "p_stall": round(self.p_stall, 6),
+            "stall_ci": [round(x, 6) for x in self.stall_ci],
             "finality_mean": (None if self.finality_mean is None
                               else round(self.finality_mean, 3)),
             "finality_ci": (None if self.finality_ci is None else
@@ -587,6 +641,7 @@ def run_fleet(
         int(window))(keys)
     violations = np.asarray(jax.device_get(outcome.violation))
     settled = np.asarray(jax.device_get(outcome.settled))
+    stalled = np.asarray(jax.device_get(outcome.stalled))
     finality = np.asarray(jax.device_get(outcome.finality_round))
     frac = np.asarray(jax.device_get(outcome.finalized_fraction))
     cut_windows = cut_split = spike_windows = region_windows = None
@@ -615,7 +670,8 @@ def run_fleet(
     res = FleetResult(
         model=model, fleet=fleet, rounds=n_rounds,
         violations=violations, settled=settled, finality_round=finality,
-        finalized_fraction=frac, telemetry=jax.device_get(telemetry),
+        finalized_fraction=frac, stalled=stalled,
+        telemetry=jax.device_get(telemetry),
         cut_windows=cut_windows, cut_split=cut_split,
         spike_windows=spike_windows, region_windows=region_windows,
         lat_percentiles=lat_percentiles, arrived=arrived,
@@ -625,6 +681,8 @@ def run_fleet(
         violation_ci=wilson_interval(int(violations.sum()), fleet),
         p_settled=float(settled.mean()),
         settled_ci=wilson_interval(int(settled.sum()), fleet),
+        p_stall=float(stalled.mean()),
+        stall_ci=wilson_interval(int(stalled.sum()), fleet),
     )
     if settled.any():
         fr = finality[settled].astype(np.float64)
@@ -670,6 +728,7 @@ _GRID_AXES = {
     "churn_probability": float,
     "latency_rounds": int,
     "adversary_strategy": str,
+    "adversary_policy": str,
     "arrival_rate": float,
     "stake_zipf_s": float,
 }
@@ -681,7 +740,7 @@ def phase_points(grid: Dict) -> List[Dict]:
 
     A grid is ``{axis: [value, ...], ...}`` with axes from
     `_GRID_AXES`; entries must be numeric (strings only for
-    `adversary_strategy`).  Raises `ValueError` with the offending
+    `adversary_strategy` and `adversary_policy`).  Raises `ValueError` with the offending
     axis/index — `run_sim --phase-grid` funnels this into
     `parser.error` (the PR 5 rule: a malformed sweep dies at the
     parser, never in the worker).
@@ -705,9 +764,18 @@ def phase_points(grid: Dict) -> List[Dict]:
             if coerce is str:
                 if not isinstance(v, str):
                     raise ValueError(
-                        f"phase-grid {axis}[{i}] must be a strategy "
-                        f"name, got {v!r}")
-                coerced.append(AdversaryStrategy(v).value)
+                        f"phase-grid {axis}[{i}] must be a "
+                        f"{'policy' if axis == 'adversary_policy' else 'strategy'}"
+                        f" name, got {v!r}")
+                if axis == "adversary_policy":
+                    if v not in ADVERSARY_POLICIES:
+                        raise ValueError(
+                            f"phase-grid {axis}[{i}]: unknown adversary "
+                            f"policy {v!r}; policies: "
+                            f"{', '.join(ADVERSARY_POLICIES)}")
+                    coerced.append(v)
+                else:
+                    coerced.append(AdversaryStrategy(v).value)
             else:
                 if isinstance(v, bool) or not isinstance(v, (int, float)):
                     raise ValueError(
@@ -724,6 +792,72 @@ def phase_points(grid: Dict) -> List[Dict]:
         levels.append(coerced)
     return [dict(zip(axes, combo))
             for combo in itertools.product(*levels)]
+
+
+def check_adversary_grid(grid: Dict, *, byz_base: float,
+                         strategy_base: str, flip_base: float,
+                         policy_base: str, async_base: bool,
+                         stake_base: str = "off",
+                         margin_base: int = 1) -> None:
+    """Inert-combination rejections for the adversary phase axes — THE
+    one spelling, shared by `run_phase_grid` and the `run_sim
+    --phase-grid` parser (a drifted copy would let a sweep die
+    mid-grid on a point config's own validator instead of upfront).
+
+    A grid is a cartesian product, so a `byzantine_fraction` axis
+    containing 0 combines with EVERY adversary-knob value — any
+    non-default knob (from another axis or the base config) would make
+    the 0 points reject at construction (`_validate_adversary`), so
+    the whole combination is rejected here before the first point
+    compiles.  Likewise a `timing` policy point needs the base
+    config's async engine (the policy rides the latency plane, which
+    no phase axis can turn on).
+    """
+    byz = grid.get("byzantine_fraction", [byz_base])
+    policies = grid.get("adversary_policy", [policy_base])
+    strategies = grid.get("adversary_strategy", [strategy_base])
+    flips = grid.get("flip_probability", [flip_base])
+    knobs = []
+    if any(p != "off" for p in policies):
+        knobs.append("adversary_policy")
+    if any(st != AdversaryStrategy.FLIP.value for st in strategies):
+        knobs.append("adversary_strategy")
+    if any(f != 1.0 for f in flips):
+        knobs.append("flip_probability")
+    if knobs and any(b == 0.0 for b in byz):
+        raise ValueError(
+            f"the grid combines byzantine_fraction == 0 points with "
+            f"{'/'.join(knobs)} set: with no byzantine nodes every "
+            f"adversary knob is inert, so those points would reject at "
+            f"construction — sweep byzantine_fraction over non-zero "
+            f"values (the 2409.02217 phase boundary starts above 0), "
+            f"or drop the adversary axes")
+    if any(p == "timing" for p in policies) and not async_base:
+        raise ValueError(
+            "an adversary_policy 'timing' point needs the base "
+            "config's async engine (a latency_mode or a scheduled "
+            "cut/spike): the policy delays lies through the in-flight "
+            "latency plane, which no phase axis can turn on")
+    if any(p == "stake_eclipse" for p in policies) and stake_base == "off":
+        raise ValueError(
+            "an adversary_policy 'stake_eclipse' point needs the base "
+            "config's stake_mode set (the eclipse set derives from the "
+            "stake plane, which no phase axis can turn on)")
+    if (margin_base != 1
+            and any(p != "withhold_near_quorum" for p in policies)):
+        raise ValueError(
+            "the base config's adversary_margin is non-default but the "
+            "grid includes adversary_policy points other than "
+            "'withhold_near_quorum' — those points would reject the "
+            "margin as inert at construction")
+    if (any(p == "split_vote" for p in policies)
+            and any(st != AdversaryStrategy.FLIP.value
+                    for st in strategies)):
+        raise ValueError(
+            "the grid combines adversary_policy 'split_vote' points "
+            "with a non-default adversary_strategy: split_vote "
+            "OVERRIDES the lie content, so those points would reject "
+            "the strategy as silently ignored at construction")
 
 
 def point_config(base_cfg: AvalancheConfig, point: Dict) -> AvalancheConfig:
@@ -787,6 +921,14 @@ def run_phase_grid(
                 f"model (the traffic plane is not threaded through "
                 f"{model!r} — every point would measure the same "
                 f"program)")
+    check_adversary_grid(
+        grid, byz_base=base_cfg.byzantine_fraction,
+        strategy_base=base_cfg.adversary_strategy.value,
+        flip_base=base_cfg.flip_probability,
+        policy_base=base_cfg.adversary_policy,
+        async_base=base_cfg.async_queries(),
+        stake_base=base_cfg.stake_mode,
+        margin_base=base_cfg.adversary_margin)
     if (base_cfg.stake_mode != "zipf"
             and any("stake_zipf_s" in p for p in points)):
         # Same inert-knob class as latency_rounds: under any other

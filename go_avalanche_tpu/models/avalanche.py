@@ -375,6 +375,18 @@ def round_step(
         responded &= ~jax.random.bernoulli(k_drop, cfg.drop_probability,
                                            peers.shape)
 
+    # --- adaptive adversary (cfg.adversary_policy, ops/adversary.py):
+    # one per-round context read from the PRE-round state turns the
+    # state-blind lie transforms into state-dependent attacks — who
+    # lies (stake_eclipse), whether a lie is silence instead
+    # (withhold_near_quorum), what it says (split_vote), when it lands
+    # (timing, via the latency plane below).  Statically absent (None)
+    # with the policy off: every archived hlo pin byte-identical.
+    pol = adversary.policy_ctx(cfg, state.records, state.byzantine,
+                               state.latency_weight)
+    lie, responded, withheld = adversary.apply_policy_issue(cfg, pol, lie,
+                                                            responded)
+
     # --- gossip-on-poll: each polled peer admits targets it hasn't seen
     # (`main.go:177`) — one scatter over the flattened (peer, polled-plane)
     # pairs (fused engine, default) or k scatter-ORs (legacy); identical
@@ -407,7 +419,7 @@ def round_step(
         if not inflight.enabled(cfg):
             yes_pack, consider_pack = exchange.gather_vote_packs(
                 packed_prefs, peers, responded, lie, k_byz, cfg,
-                minority_t, t)
+                minority_t, t, pol)
 
     # --- ingest: k fused window updates on polled records only
     # (RegisterVotes, `processor.go:92-117`); finalized records freeze.
@@ -422,13 +434,14 @@ def round_step(
             # over the whole ring.  SEQUENTIAL-only (config-validated).
             lat = inflight.draw_latency(k_sample, cfg, peers,
                                         state.latency_weight, n)
+            lat = adversary.apply_policy_latency(cfg, lat, lie, withheld)
             lat = inflight.apply_faults(lat, cfg, state.round, 0,
                                         peers, n, state.fault_params)
             ring = inflight.enqueue(state.inflight, state.round, peers,
                                     lat, responded, lie, polled)
             records, changed, votes_applied = inflight.deliver_multi_engine(
                 ring, state.records, cfg, packed_prefs, minority_t,
-                k_byz, state.round, t, live_rows=state.alive)
+                k_byz, state.round, t, live_rows=state.alive, ctx=pol)
         elif cfg.vote_mode is VoteMode.SEQUENTIAL:
             records, changed = vr.register_packed_votes_engine(
                 state.records, yes_pack, consider_pack, cfg.k, cfg,

@@ -288,6 +288,15 @@ def round_step(
     # flattened gather by default (`ops/exchange.gather_vote_packs`).
     minority_t = adversary.minority_plane(prefs)
     packed_prefs = pack_bool_plane(prefs)
+
+    # --- adaptive adversary (cfg.adversary_policy, ops/adversary.py):
+    # the split tally reads the PREFERRED-IN-SET response plane (what
+    # responders would actually say), the near-quorum gate the window
+    # vote counts; statically absent (None) with the policy off.
+    pol = adversary.policy_ctx(cfg, base.records, base.byzantine,
+                               base.latency_weight, prefs=prefs)
+    lie, responded, withheld = adversary.apply_policy_issue(cfg, pol, lie,
+                                                            responded)
     ring = base.inflight
     if inflight.enabled(cfg):
         # Async query lifecycle (ops/inflight.py): responses vote the
@@ -295,16 +304,18 @@ def round_step(
         # start (the synchronous round's own observation convention).
         lat = inflight.draw_latency(k_sample, cfg, peers,
                                     base.latency_weight, n)
+        lat = adversary.apply_policy_latency(cfg, lat, lie, withheld)
         lat = inflight.apply_faults(lat, cfg, base.round, 0, peers, n,
                                     base.fault_params)
         ring = inflight.enqueue(base.inflight, base.round, peers, lat,
                                 responded, lie, polled)
         records, changed, votes_applied = inflight.deliver_multi_engine(
             ring, base.records, cfg, packed_prefs, minority_t, k_byz,
-            base.round, t, live_rows=base.alive)
+            base.round, t, live_rows=base.alive, ctx=pol)
     else:
         yes_pack, consider_pack = exchange.gather_vote_packs(
-            packed_prefs, peers, responded, lie, k_byz, cfg, minority_t, t)
+            packed_prefs, peers, responded, lie, k_byz, cfg, minority_t, t,
+            pol)
 
         records, changed = vr.register_packed_votes_engine(
             base.records, yes_pack, consider_pack, cfg.k, cfg,

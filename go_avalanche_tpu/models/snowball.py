@@ -138,6 +138,17 @@ def round_step(
         responded &= ~jax.random.bernoulli(k_drop, cfg.drop_probability,
                                            peers.shape)
 
+    # --- adaptive adversary (cfg.adversary_policy, ops/adversary.py):
+    # per-round context from the pre-round state — scalar honest-split
+    # tally for split_vote, per-querier near-quorum gate for
+    # withholding; statically absent (None) with the policy off.
+    # Snowball carries no stake plane, so stake_eclipse degenerates to
+    # uniform weights (and is config-rejected without stake anyway).
+    pol = adversary.policy_ctx(cfg, state.records, state.byzantine, None,
+                               prefs=prefs)
+    lie, responded, withheld = adversary.apply_policy_issue(cfg, pol, lie,
+                                                            responded)
+
     fin_before = vr.has_finalized(state.records.confidence, cfg)
     update_mask = jnp.logical_not(fin_before) & state.alive
 
@@ -150,18 +161,20 @@ def round_step(
         # uniform weights (all-zero latency).
         lat = inflight.draw_latency(k_sample, cfg, peers,
                                     jnp.ones((n,), jnp.float32), n)
+        lat = adversary.apply_policy_latency(cfg, lat, lie, withheld)
         lat = inflight.apply_faults(lat, cfg, state.round, 0, peers, n,
                                     state.fault_params)
         ring = inflight.enqueue(state.inflight, state.round, peers, lat,
                                 responded, lie, update_mask)
         records, changed = inflight.deliver_1d_engine(ring, state.records, cfg,
                                                prefs, k_byz, state.round,
-                                               live_rows=state.alive)
+                                               live_rows=state.alive,
+                                               ctx=pol)
     elif cfg.vote_mode is VoteMode.SEQUENTIAL:
         # Faithful per-vote window semantics: pack the k votes into uint8 bit
         # planes and run k fused window updates (`processor.go:94-117`).
         peer_votes = adversary.apply_1d(k_byz, prefs[peers], lie, cfg,
-                                        prefs)
+                                        prefs, pol)
         shifts = jnp.arange(cfg.k, dtype=jnp.uint8)
         yes_pack = (peer_votes.astype(jnp.uint8) << shifts).sum(
             axis=1).astype(jnp.uint8)
@@ -173,7 +186,7 @@ def round_step(
         # Paper-style majority chit: one conclusive vote per round when
         # >= ceil(alpha*k) of the sampled peers agree, else neutral.
         peer_votes = adversary.apply_1d(k_byz, prefs[peers], lie, cfg,
-                                        prefs)
+                                        prefs, pol)
         thresh = math.ceil(cfg.alpha * cfg.k)
         yes_cnt = (peer_votes & responded).sum(axis=1)
         no_cnt = (jnp.logical_not(peer_votes) & responded).sum(axis=1)

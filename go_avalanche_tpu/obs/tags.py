@@ -29,6 +29,12 @@ fixed order:
                                untagged (no archived chain used one)
     ", {engine}-inflight"      cfg.inflight_engine != "walk"
     ", partition"              cfg.partition_spec scheduled
+    ", {policy}-adversary"     cfg.adversary_policy != "off" (the
+                               adaptive-adversary context plane and
+                               policy transforms change the timed
+                               program; the static strategy knobs
+                               stay untagged — they predate the tag
+                               and alter only draw values)
     ", {mode}-stake[S]"        cfg.stake_mode != "off" (stake-weighted
                                committee draws change the timed
                                program; S = stake_zipf_s, %g-formatted,
@@ -94,6 +100,8 @@ def tag_from_config(cfg: AvalancheConfig) -> str:
             tag += f", {cfg.inflight_engine}-inflight"
         if cfg.partition_spec is not None:
             tag += ", partition"
+    if cfg.adversary_policy != "off":
+        tag += f", {cfg.adversary_policy}-adversary"
     if cfg.stake_mode != "off":
         tag += f", {cfg.stake_mode}-stake"
         if cfg.stake_mode == "zipf":

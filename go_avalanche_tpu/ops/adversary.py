@@ -20,14 +20,244 @@ family (`models/snowball`, `models/family`, `models/avalanche`,
 Every strategy triggers per (querier, draw) with `cfg.flip_probability`,
 and only for byzantine peers, so `FLIP` with `flip_probability=0.35`
 reproduces the reference hook exactly.
+
+ADAPTIVE POLICIES (`cfg.adversary_policy`, PR 13).  The strategies are
+state-BLIND: a lie's content is a pure per-draw transform.  arXiv
+2401.02811 shows a small adversary choosing votes *as a function of
+observed network state* can stall finality indefinitely, and arXiv
+2409.02217 quantifies the resulting liveness/safety probabilities vs
+(byzantine fraction, k, quorum).  The policy layer adds that class:
+
+  * `policy_ctx` — ONE per-round context (`PolicyCtx`) read from the
+    pre-round state planes (preference tallies, window vote counts,
+    stake weights), shared by every model round; statically None with
+    the policy off, so every archived hlo pin is byte-identical;
+  * `apply_policy_issue` — issue-time effects on the (lie, responded)
+    masks: stake_eclipse restricts lies to the top-stake honest
+    queriers, withhold_near_quorum turns lying draws into SILENCE for
+    near-quorum queriers;
+  * `apply_policy_latency` — latency-plane effects (async engine):
+    timing delays lies to the last deliverable age, withheld draws get
+    the never-delivers sentinel and expire through the existing
+    timeout machinery;
+  * split_vote overrides the lie CONTENT inside the strategy
+    transforms below: lies vote the HONEST population's minority color
+    (fresh equivocation coins on an exact tie), holding the honest
+    split even — the 2401.02811 stall attack.
+
+Every context plane is a pure function of (config, state), so the
+policies are vmap-clean (realized per fleet trial) and the sharded
+drivers reproduce them exactly from psum'd tallies
+(`parallel/sharded._policy_ctx_sharded`).  The in-graph liveness
+detector that catches what these attacks cause lives in
+`fleet.liveness_stalled`.
 """
 
 from __future__ import annotations
+
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 
 from go_avalanche_tpu.config import AdversaryStrategy, AvalancheConfig
+
+# fold_in constant deriving the split_vote tie-breaker coins from the
+# round's adversary key — a stream of its own, like EQUIVOCATE's 0x5A,
+# so turning the policy on never perturbs the strategy draws.
+_SPLIT_FOLD = 0xB511
+
+
+class PolicyCtx(NamedTuple):
+    """Per-round adaptive-adversary context (`cfg.adversary_policy`).
+
+    Built once per round by `policy_ctx` (dense) or
+    `parallel/sharded._policy_ctx_sharded` (psum'd twin) from the
+    PRE-round state, then threaded through the exchange/inflight
+    engines exactly like `minority_t`.  Only the active policy's
+    fields are populated; the rest stay None (statically absent).
+    """
+
+    split_t: Optional[jax.Array] = None
+                         # split_vote: bool [T] (scalar for snowball) —
+                         # the HONEST population's minority color per
+                         # target, the lie content that pulls the
+                         # honest tally toward an even split
+    split_even: Optional[jax.Array] = None
+                         # split_vote: bool [T] / scalar — exact honest
+                         # tie; lies fall back to fresh equivocation
+                         # coins there (a fixed color would break the
+                         # tie the attack is holding)
+    withhold_q: Optional[jax.Array] = None
+                         # withhold_near_quorum: bool [rows] — queriers
+                         # holding a live record within
+                         # cfg.adversary_margin window votes of the
+                         # conclusive quorum; their lying draws go
+                         # silent
+    eclipse_q: Optional[jax.Array] = None
+                         # stake_eclipse: bool [rows] — the top-
+                         # max(1, round(byzantine_fraction * N))-stake
+                         # HONEST queriers lies concentrate on
+
+
+def honest_split_plane(prefs: jax.Array, byzantine: jax.Array):
+    """``(minority, even)`` of the HONEST preference tally.
+
+    `prefs` is the response plane (bool ``[N]`` or ``[N, T]``),
+    `byzantine` bool ``[N]``.  Unlike `minority_plane` (all rows), the
+    tally quantifies over honest rows only — the split the 2401.02811
+    adversary is holding is the honest one; its own rows' preferences
+    are irrelevant.  Ties report `even` (the transforms equivocate
+    there) rather than leaning one color.
+    """
+    honest = jnp.logical_not(byzantine)
+    n_honest = honest.sum()
+    if prefs.ndim == 1:
+        yes = (prefs & honest).sum()
+    else:
+        yes = (prefs & honest[:, None]).sum(axis=0)
+    return yes * 2 < n_honest, yes * 2 == n_honest
+
+
+def near_quorum_rows(records, cfg: AvalancheConfig) -> jax.Array:
+    """Bool ``[rows]`` — queriers holding any LIVE record whose window
+    yes- or no-count is within `cfg.adversary_margin` votes of the
+    conclusive quorum (>= quorum - margin): one more conclusive round
+    could finalize them, so withholding now denies the finishing
+    votes.  Finalized records are excluded (nothing left to deny).
+    On a tx-sharded driver this reduces the LOCAL columns only; the
+    caller psums the any() across tx shards
+    (`parallel/sharded._policy_ctx_sharded`)."""
+    from go_avalanche_tpu.ops import voterecord as vr
+    from go_avalanche_tpu.ops.bitops import popcount8
+
+    yes = popcount8(records.votes & records.consider)
+    cons = popcount8(records.consider)
+    near = (jnp.maximum(yes, cons - yes).astype(jnp.int32)
+            >= jnp.int32(cfg.quorum - cfg.adversary_margin))
+    near = near & jnp.logical_not(
+        vr.has_finalized(records.confidence, cfg))
+    return near if near.ndim == 1 else near.any(axis=1)
+
+
+def eclipse_rows(latency_weight: jax.Array, byzantine: jax.Array,
+                 cfg: AvalancheConfig) -> jax.Array:
+    """Bool ``[N]`` — the top-stake HONEST queriers the eclipse
+    concentrates on.
+
+    The eclipse set holds the ``max(1, round(byzantine_fraction * N))``
+    heaviest honest rows of the sampling-propensity plane (the stake
+    fold, `stake.py`): the most-sampled responders, whose poisoned
+    preferences propagate furthest through stake-weighted committees.
+    Byzantine rows are excluded — under zipf the adversary itself
+    holds the top stake (`av.init`), and lying to itself is wasted
+    budget; the exclusion holds even when the requested set size
+    exceeds the honest population (the threshold then bottoms out at
+    the byzantine -inf fill, and the finite-weight mask SATURATES the
+    set at "every honest querier" rather than leaking byzantine rows
+    in).  Ties at the threshold weight all qualify (deterministic,
+    shard-independent).  NOTE the set size reads cfg.byzantine_fraction
+    at ROUND time, so run configs must keep the init-time fraction.
+    """
+    n = latency_weight.shape[0]
+    m = min(n, max(1, int(round(cfg.byzantine_fraction * n))))
+    w = jnp.where(byzantine, -jnp.inf, latency_weight.astype(jnp.float32))
+    kth = jax.lax.top_k(w, m)[0][-1]
+    return (w >= kth) & jnp.isfinite(w)
+
+
+def policy_ctx(cfg: AvalancheConfig, records, byzantine: jax.Array,
+               latency_weight: Optional[jax.Array],
+               prefs: Optional[jax.Array] = None) -> Optional[PolicyCtx]:
+    """The dense per-round policy context; None (statically) with the
+    policy off — the round's traced program is byte-identical to the
+    pre-policy one.
+
+    `records` is the PRE-round `VoteRecordState`; `prefs` overrides the
+    response plane the split tally reads (the DAG round's
+    preferred-in-set plane — what responders would actually SAY; by
+    default `vr.is_accepted(records.confidence)`, which XLA CSEs with
+    the round's own gather).  `latency_weight` None means a uniform
+    plane (snowball carries none; stake_eclipse is config-rejected
+    without stake anyway).
+    """
+    if cfg.adversary_policy == "off":
+        return None
+    if cfg.adversary_policy == "split_vote":
+        from go_avalanche_tpu.ops import voterecord as vr
+
+        if prefs is None:
+            prefs = vr.is_accepted(records.confidence)
+        split_t, even = honest_split_plane(prefs, byzantine)
+        return PolicyCtx(split_t=split_t, split_even=even)
+    if cfg.adversary_policy == "withhold_near_quorum":
+        return PolicyCtx(withhold_q=near_quorum_rows(records, cfg))
+    if cfg.adversary_policy == "stake_eclipse":
+        if latency_weight is None:
+            latency_weight = jnp.ones(byzantine.shape, jnp.float32)
+        return PolicyCtx(eclipse_q=eclipse_rows(latency_weight,
+                                                byzantine, cfg))
+    return PolicyCtx()   # timing: latency-plane only (apply_policy_latency)
+
+
+def apply_policy_issue(cfg: AvalancheConfig, ctx: Optional[PolicyCtx],
+                       lie: jax.Array, responded: jax.Array):
+    """Issue-time policy effects on the round's ``[rows, k]`` masks;
+    returns ``(lie, responded, withheld)``.
+
+    stake_eclipse restricts the lie mask to the eclipse queriers (the
+    other draws answer honestly — concentration, not amplification);
+    withhold_near_quorum turns the flagged queriers' lying draws into
+    SILENCE — the `responded` bit drops (sync rounds: the drop/absence
+    semantics of `vote.go:56`) and the draw stops lying (it says
+    nothing at all); `withheld` hands the mask to
+    `apply_policy_latency`, which stamps the never-delivers sentinel
+    so async rounds expire it through the timeout machinery instead.
+    Pass-through (statically) when `ctx` is None or the policy has no
+    issue-time effect.
+    """
+    if ctx is None:
+        return lie, responded, None
+    if cfg.adversary_policy == "stake_eclipse":
+        return lie & ctx.eclipse_q[:, None], responded, None
+    if cfg.adversary_policy == "withhold_near_quorum":
+        withheld = lie & ctx.withhold_q[:, None]
+        keep = jnp.logical_not(withheld)
+        return lie & keep, responded & keep, withheld
+    return lie, responded, None
+
+
+def apply_policy_latency(cfg: AvalancheConfig, lat: jax.Array,
+                         lie: jax.Array,
+                         withheld: Optional[jax.Array]) -> jax.Array:
+    """Latency-plane policy effects, applied to the round's issue-time
+    draws BEFORE the fault-script pass (scheduled cuts still override
+    with the sentinel — a partitioned lie is lost like any other
+    query; spikes shifting a timed lie past the timeout expire it).
+
+    timing  — lying draws land at age ``timeout_rounds() - 1``, the
+              last deliverable age: the stalest possible response,
+              maximum time-in-flight per lie.
+    withhold — withheld draws get the never-delivers sentinel
+              (``timeout_rounds()``) and expire unanswered at the
+              timeout age, the host Processor's reap — silence feeds
+              the existing expiry/occupancy telemetry.
+
+    Statically absent otherwise (pins unchanged).
+    """
+    if cfg.adversary_policy == "timing":
+        return jnp.where(lie, jnp.int32(cfg.timeout_rounds() - 1), lat)
+    if withheld is not None:
+        return jnp.where(withheld, jnp.int32(cfg.timeout_rounds()), lat)
+    return lat
+
+
+def _split_content(key: jax.Array, shape, even, split) -> jax.Array:
+    """The split_vote lie content: the honest-minority color, or a
+    fresh coin on an exact honest tie.  `even`/`split` broadcast
+    against `shape`."""
+    coin = jax.random.bernoulli(key, 0.5, shape)
+    return jnp.where(even, coin, split)
 
 
 def lie_mask(
@@ -61,20 +291,38 @@ def minority_plane(prefs: jax.Array) -> jax.Array:
     return prefs.sum(axis=0) * 2 < n
 
 
+def _require_split_ctx(ctx: Optional[PolicyCtx]) -> PolicyCtx:
+    if ctx is None or ctx.split_t is None:
+        raise ValueError(
+            "adversary_policy 'split_vote' needs the round's PolicyCtx "
+            "(policy_ctx / _policy_ctx_sharded) threaded through the "
+            "exchange engine — every model round builds it")
+    return ctx
+
+
 def apply_1d(
     key: jax.Array,
     votes: jax.Array,
     lie: jax.Array,
     cfg: AvalancheConfig,
     prefs: jax.Array,
+    ctx: Optional[PolicyCtx] = None,
 ) -> jax.Array:
     """Adversary transform for single-decree models.
 
     `votes`/`lie` are bool ``[N, k]``; `prefs` is the bool ``[N]`` true
     preference plane (used only by OPPOSE_MAJORITY).  Returns the
     post-adversary ``[N, k]`` votes.  `key` may be the same key used for
-    `lie_mask` — the coin folds in a constant to decorrelate.
+    `lie_mask` — the coin folds in a constant to decorrelate.  Under
+    `cfg.adversary_policy = "split_vote"` the lie content is the
+    policy's instead (`ctx.split_t`/`split_even` scalars): the honest
+    minority color, a fresh coin per (querier, draw) on an exact tie.
     """
+    if cfg.adversary_policy == "split_vote":
+        ctx = _require_split_ctx(ctx)
+        content = _split_content(jax.random.fold_in(key, _SPLIT_FOLD),
+                                 votes.shape, ctx.split_even, ctx.split_t)
+        return jnp.where(lie, content, votes)
     s = cfg.adversary_strategy
     if s is AdversaryStrategy.FLIP:
         return jnp.logical_xor(votes, lie)
@@ -92,6 +340,7 @@ def pack_adversarial_votes(
     key: jax.Array,
     cfg: AvalancheConfig,
     minority_t: jax.Array,
+    ctx: Optional[PolicyCtx] = None,
 ) -> tuple:
     """The k-draw vote-pack loop shared by every multi-target round.
 
@@ -107,7 +356,7 @@ def pack_adversarial_votes(
     consider_pack = jnp.zeros((n, t), jnp.uint8)
     for j in range(cfg.k):
         vote_j = apply_plane(key, j, get_vote_plane(j), lie[:, j], cfg,
-                             minority_t)
+                             minority_t, ctx)
         yes_pack |= vote_j.astype(jnp.uint8) << jnp.uint8(j)
         consider_pack |= (responded[:, j].astype(jnp.uint8)
                           << jnp.uint8(j))[:, None]
@@ -120,6 +369,7 @@ def apply_draw_planes(
     lie: jax.Array,
     cfg: AvalancheConfig,
     minority_t: jax.Array,
+    ctx: Optional[PolicyCtx] = None,
 ) -> jax.Array:
     """Adversary transform for ALL k draws at once (the fused exchange).
 
@@ -128,8 +378,19 @@ def apply_draw_planes(
     calls: pure boolean selects for FLIP / OPPOSE_MAJORITY, and the
     EQUIVOCATE coins are drawn per draw with the identical
     ``fold_in(fold_in(key, 0x5A), draw)`` keys, so the fused engine and the
-    legacy k-pass loop see the same random stream.
+    legacy k-pass loop see the same random stream.  split_vote
+    (`cfg.adversary_policy`) follows the same per-draw key discipline
+    with its own `_SPLIT_FOLD` stream.
     """
+    if cfg.adversary_policy == "split_vote":
+        ctx = _require_split_ctx(ctx)
+        n, k, t = votes.shape
+        base = jax.random.fold_in(key, _SPLIT_FOLD)
+        content = jnp.stack(
+            [_split_content(jax.random.fold_in(base, j), (n, t),
+                            ctx.split_even[None, :], ctx.split_t[None, :])
+             for j in range(k)], axis=1)
+        return jnp.where(lie[:, :, None], content, votes)
     s = cfg.adversary_strategy
     if s is AdversaryStrategy.FLIP:
         return jnp.logical_xor(votes, lie[:, :, None])
@@ -150,6 +411,7 @@ def apply_plane(
     lie_j: jax.Array,
     cfg: AvalancheConfig,
     minority_t: jax.Array,
+    ctx: Optional[PolicyCtx] = None,
 ) -> jax.Array:
     """Adversary transform for one draw of a multi-target model.
 
@@ -158,8 +420,16 @@ def apply_plane(
     mask column, `minority_t` the precomputed bool ``[T]`` minority plane
     (pass anything, e.g. `vote_j`, for non-OPPOSE strategies).  The
     equivocation coin folds `draw` plus a constant into `key` so each draw
-    lies independently and `key` may be shared with `lie_mask`.
+    lies independently and `key` may be shared with `lie_mask`; the
+    split_vote tie coins (`cfg.adversary_policy`) do the same on their
+    own `_SPLIT_FOLD` stream — bit-exact with `apply_draw_planes`.
     """
+    if cfg.adversary_policy == "split_vote":
+        ctx = _require_split_ctx(ctx)
+        content = _split_content(
+            jax.random.fold_in(jax.random.fold_in(key, _SPLIT_FOLD), draw),
+            vote_j.shape, ctx.split_even[None, :], ctx.split_t[None, :])
+        return jnp.where(lie_j[:, None], content, vote_j)
     s = cfg.adversary_strategy
     if s is AdversaryStrategy.FLIP:
         return jnp.logical_xor(vote_j, lie_j[:, None])

@@ -40,6 +40,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from typing import Optional
+
 from go_avalanche_tpu.config import AvalancheConfig
 from go_avalanche_tpu.ops import adversary
 from go_avalanche_tpu.ops.bitops import pack_bool_plane, unpack_bool_plane
@@ -54,6 +56,7 @@ def fused_vote_packs(
     cfg: AvalancheConfig,
     minority_t: jax.Array,
     t: int,
+    ctx: Optional[adversary.PolicyCtx] = None,
 ) -> tuple:
     """Single-gather k-vote collection; returns ``(yes_pack, consider_pack)``.
 
@@ -71,7 +74,8 @@ def fused_vote_packs(
     t8 = packed_prefs.shape[-1]
     flat = packed_prefs[peers.reshape(n * k)]            # THE one gather
     votes = unpack_bool_plane(flat.reshape(n, k, t8), t)   # [N, k, T] bools
-    votes = adversary.apply_draw_planes(key, votes, lie, cfg, minority_t)
+    votes = adversary.apply_draw_planes(key, votes, lie, cfg, minority_t,
+                                        ctx)
     shifts = jnp.arange(k, dtype=jnp.uint8)
     yes_pack = (votes.astype(jnp.uint8) << shifts[None, :, None]).sum(
         axis=1).astype(jnp.uint8)
@@ -90,13 +94,14 @@ def legacy_vote_packs(
     cfg: AvalancheConfig,
     minority_t: jax.Array,
     t: int,
+    ctx: Optional[adversary.PolicyCtx] = None,
 ) -> tuple:
     """The k-pass engine: one row-gather + unpack + adversary pass per draw
     (`adversary.pack_adversarial_votes`).  Kept selectable
     (`cfg.fused_exchange=False`) as the golden-parity reference."""
     return adversary.pack_adversarial_votes(
         lambda j: unpack_bool_plane(packed_prefs[peers[:, j]], t),
-        responded, lie, key, cfg, minority_t)
+        responded, lie, key, cfg, minority_t, ctx)
 
 
 def gather_vote_packs(
@@ -108,6 +113,7 @@ def gather_vote_packs(
     cfg: AvalancheConfig,
     minority_t: jax.Array,
     t: int,
+    ctx: Optional[adversary.PolicyCtx] = None,
 ) -> tuple:
     """The exchange-engine dispatch every multi-target round calls
     (`models/avalanche`, `models/dag`, `parallel/sharded*`): fused
@@ -115,7 +121,7 @@ def gather_vote_packs(
     `cfg.fused_exchange`.  Both return identical bits."""
     engine = fused_vote_packs if cfg.fused_exchange else legacy_vote_packs
     return engine(packed_prefs, peers, responded, lie, key, cfg,
-                  minority_t, t)
+                  minority_t, t, ctx)
 
 
 def fused_gossip_heard(peers: jax.Array, polled_u8: jax.Array) -> jax.Array:

@@ -700,6 +700,7 @@ def deliver_multi(
     round_: jax.Array,
     t: int,
     live_rows: Optional[jax.Array] = None,
+    ctx: Optional[adversary.PolicyCtx] = None,
 ) -> Tuple[vr.VoteRecordState, jax.Array, jax.Array]:
     """One round's delivery+expiry pass for the multi-target models.
 
@@ -745,7 +746,7 @@ def deliver_multi(
 
         yes_pack, consider_pack = exchange.gather_vote_packs(
             packed_prefs, peers, consider, lie,
-            _delivery_key(key, d), cfg, minority_t, t)
+            _delivery_key(key, d), cfg, minority_t, t, ctx)
         present_pack = jnp.broadcast_to(
             _pack_bits(present)[:, None], consider_pack.shape)
         update_mask = polled & jnp.logical_not(
@@ -773,6 +774,7 @@ def deliver_1d(
     key: jax.Array,
     round_: jax.Array,
     live_rows: Optional[jax.Array] = None,
+    ctx: Optional[adversary.PolicyCtx] = None,
 ) -> Tuple[vr.VoteRecordState, jax.Array]:
     """`deliver_multi` for single-decree Snowball (``[N]`` records).
 
@@ -795,7 +797,7 @@ def deliver_1d(
         mask = lax.dynamic_index_in_dim(ring.polled, slot, 0, False)
 
         votes = adversary.apply_1d(_delivery_key(key, d), prefs[peers],
-                                   lie, cfg, prefs)
+                                   lie, cfg, prefs, ctx)
         deliver = (lat == d[None, None]) & (d != timeout)
         expire = (lat >= timeout) & (d == timeout)
         consider = responded & deliver
@@ -835,6 +837,7 @@ def deliver_multi_earlyout(
     round_: jax.Array,
     t: int,
     live_rows: Optional[jax.Array] = None,
+    ctx: Optional[adversary.PolicyCtx] = None,
 ) -> Tuple[vr.VoteRecordState, jax.Array, jax.Array]:
     """`deliver_multi` with a per-age early-out (`cfg.inflight_engine =
     "walk_earlyout"`).
@@ -871,7 +874,7 @@ def deliver_multi_earlyout(
             polled = lax.dynamic_index_in_dim(ring.polled, slot, 0, False)
             yes_pack, consider_pack = exchange.gather_vote_packs(
                 packed_prefs, peers, consider, lie,
-                _delivery_key(key, d), cfg, minority_t, t)
+                _delivery_key(key, d), cfg, minority_t, t, ctx)
             present_pack = jnp.broadcast_to(
                 _pack_bits(present)[:, None], consider_pack.shape)
             update_mask = polled & jnp.logical_not(
@@ -901,6 +904,7 @@ def deliver_1d_earlyout(
     key: jax.Array,
     round_: jax.Array,
     live_rows: Optional[jax.Array] = None,
+    ctx: Optional[adversary.PolicyCtx] = None,
 ) -> Tuple[vr.VoteRecordState, jax.Array]:
     """`deliver_1d` with the per-age early-out (see
     `deliver_multi_earlyout`)."""
@@ -926,7 +930,7 @@ def deliver_1d_earlyout(
             lie = lax.dynamic_index_in_dim(ring.lie, slot, 0, False)
             mask = lax.dynamic_index_in_dim(ring.polled, slot, 0, False)
             votes = adversary.apply_1d(_delivery_key(key, d), prefs[peers],
-                                       lie, cfg, prefs)
+                                       lie, cfg, prefs, ctx)
             update_mask = mask & jnp.logical_not(
                 vr.has_finalized(records.confidence, cfg))
             if live_rows is not None:
@@ -992,6 +996,11 @@ def _static_single_age(cfg: AvalancheConfig):
     such a state (tests/test_inflight.py collision parity).
     """
     if cfg.cut_events() or cfg.spike_events() or cfg.stochastic_events():
+        return None
+    if cfg.adversary_policy in ("timing", "withhold_near_quorum"):
+        # Both stamp PER-DRAW latencies at issue time (timeout - 1 for
+        # timed lies, the sentinel for withheld draws), so a "fixed"
+        # ring carries mixed latencies and more than one age registers.
         return None
     if cfg.latency_mode == "fixed":
         return min(cfg.latency_rounds, cfg.timeout_rounds())
@@ -1111,6 +1120,7 @@ def deliver_multi_coalesced(
     round_: jax.Array,
     t: int,
     live_rows: Optional[jax.Array] = None,
+    ctx: Optional[adversary.PolicyCtx] = None,
 ) -> Tuple[vr.VoteRecordState, jax.Array, jax.Array]:
     """One-pass ring drain for the multi-target models
     (`cfg.inflight_engine = "coalesced"`); same contract and identical
@@ -1172,7 +1182,7 @@ def deliver_multi_coalesced(
             rows, k, packed_prefs.shape[-1])
         votes_adv = adversary.apply_draw_planes(
             _delivery_key(key, d), unpack_bool_plane(cube, t), lie, cfg,
-            minority_t)                                   # [rows, k, T]
+            minority_t, ctx)                              # [rows, k, T]
         votes_applied = votes_applied + jnp.where(
             upd, popcount8(_pack_bits(consider_i))[:, None]
             .astype(jnp.int32), 0).sum()
@@ -1226,6 +1236,7 @@ def deliver_1d_coalesced(
     key: jax.Array,
     round_: jax.Array,
     live_rows: Optional[jax.Array] = None,
+    ctx: Optional[adversary.PolicyCtx] = None,
 ) -> Tuple[vr.VoteRecordState, jax.Array]:
     """`deliver_multi_coalesced` for single-decree Snowball (``[N]``
     records): whole-ring masks, then one static-bound `fori_loop` whose
@@ -1251,7 +1262,7 @@ def deliver_1d_coalesced(
         if live_rows is not None:
             upd = upd & live_rows
         votes_adv = adversary.apply_1d(_delivery_key(key, d),
-                                       prefs[peers], lie, cfg, prefs)
+                                       prefs[peers], lie, cfg, prefs, ctx)
         yes_cnt = popcount8(votes_w & cons_w)
         cons_cnt = popcount8(cons_w)
         for j in range(k):                  # unrolled: k is static
@@ -1292,6 +1303,7 @@ def deliver_multi_engine(
     round_: jax.Array,
     t: int,
     live_rows: Optional[jax.Array] = None,
+    ctx: Optional[adversary.PolicyCtx] = None,
 ) -> Tuple[vr.VoteRecordState, jax.Array, jax.Array]:
     """`cfg.inflight_engine` dispatch for the multi-target delivery pass;
     identical bits whichever engine runs (tests/test_inflight)."""
@@ -1299,7 +1311,7 @@ def deliver_multi_engine(
               "walk_earlyout": deliver_multi_earlyout,
               "coalesced": deliver_multi_coalesced}[cfg.inflight_engine]
     return engine(ring, records, cfg, packed_prefs, minority_t, key,
-                  round_, t, live_rows=live_rows)
+                  round_, t, live_rows=live_rows, ctx=ctx)
 
 
 def deliver_1d_engine(
@@ -1310,6 +1322,7 @@ def deliver_1d_engine(
     key: jax.Array,
     round_: jax.Array,
     live_rows: Optional[jax.Array] = None,
+    ctx: Optional[adversary.PolicyCtx] = None,
 ) -> Tuple[vr.VoteRecordState, jax.Array]:
     """`cfg.inflight_engine` dispatch for the single-decree delivery
     pass (Snowball)."""
@@ -1317,7 +1330,7 @@ def deliver_1d_engine(
               "walk_earlyout": deliver_1d_earlyout,
               "coalesced": deliver_1d_coalesced}[cfg.inflight_engine]
     return engine(ring, records, cfg, prefs, key, round_,
-                  live_rows=live_rows)
+                  live_rows=live_rows, ctx=ctx)
 
 
 class RingTelemetry(NamedTuple):

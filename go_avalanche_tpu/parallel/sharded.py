@@ -151,6 +151,52 @@ def _global_minority_plane(prefs_local: jax.Array,
     return yes_counts * 2 < n_global
 
 
+def _policy_ctx_sharded(
+    cfg: AvalancheConfig,
+    records,
+    prefs_local: jax.Array,
+    byzantine: jax.Array,
+    latency_weight: jax.Array,
+    offset,
+    n_local: int,
+):
+    """The sharded twin of `ops/adversary.policy_ctx` — bit-exact
+    context planes from psum'd tallies; None (statically) with the
+    policy off.
+
+      split_vote — the honest yes tally is a local column sum over this
+        shard's rows psum'd over the nodes axis (the
+        `_global_minority_plane` recipe, honest rows only); the honest
+        COUNT comes from the replicated byzantine plane directly.
+      withhold_near_quorum — the per-querier near-quorum gate reduces
+        this shard's LOCAL record columns, then ORs across tx shards
+        (one [n_local] int32 psum — the querier's row spans them).
+      stake_eclipse — the eclipse set derives from the replicated
+        [N_global] weight/byzantine planes (identical on every shard);
+        only this shard's row slice is kept.
+    """
+    if cfg.adversary_policy == "off":
+        return None
+    if cfg.adversary_policy == "split_vote":
+        honest = jnp.logical_not(byzantine)            # replicated [N]
+        honest_local = lax.dynamic_slice(honest, (offset,), (n_local,))
+        yes = lax.psum(
+            (prefs_local & honest_local[:, None]).sum(axis=0)
+            .astype(jnp.int32), NODES_AXIS)
+        n_honest = honest.sum().astype(jnp.int32)
+        return adversary.PolicyCtx(split_t=yes * 2 < n_honest,
+                                   split_even=yes * 2 == n_honest)
+    if cfg.adversary_policy == "withhold_near_quorum":
+        near_local = adversary.near_quorum_rows(records, cfg)
+        near = lax.psum(near_local.astype(jnp.int32), TXS_AXIS) > 0
+        return adversary.PolicyCtx(withhold_q=near)
+    if cfg.adversary_policy == "stake_eclipse":
+        eclipse = adversary.eclipse_rows(latency_weight, byzantine, cfg)
+        return adversary.PolicyCtx(eclipse_q=lax.dynamic_slice(
+            eclipse, (offset,), (n_local,)))
+    return adversary.PolicyCtx()   # timing: latency-plane only
+
+
 def global_capped_poll_mask(
     pollable: jax.Array,
     score_rank: jax.Array,
@@ -338,10 +384,20 @@ def _local_round(
     else:
         minority_t = jnp.zeros((t_local,), jnp.bool_)  # unused
     # The equivocation coin is per-target, so unlike every other fault draw
-    # it must NOT be identical across txs shards: fold the txs-axis index in.
+    # it must NOT be identical across txs shards: fold the txs-axis index
+    # in.  The split_vote tie coin is per-target too (same argument).
     k_vote = k_byz
-    if cfg.adversary_strategy is AdversaryStrategy.EQUIVOCATE:
+    if (cfg.adversary_strategy is AdversaryStrategy.EQUIVOCATE
+            or cfg.adversary_policy == "split_vote"):
         k_vote = jax.random.fold_in(k_byz, lax.axis_index(TXS_AXIS))
+
+    # --- adaptive adversary (cfg.adversary_policy): psum'd twin of the
+    # dense round's per-round context; statically absent when off.
+    pol = _policy_ctx_sharded(cfg, state.records, prefs_local,
+                              state.byzantine, state.latency_weight,
+                              offset, n_local)
+    lie, responded, withheld = adversary.apply_policy_issue(cfg, pol, lie,
+                                                            responded)
 
     # --- ingest.
     ring = state.inflight
@@ -353,20 +409,21 @@ def _local_round(
         lat = inflight.draw_latency(k_sample, cfg, peers,
                                     state.latency_weight, n_global,
                                     row_offset=offset)
+        lat = adversary.apply_policy_latency(cfg, lat, lie, withheld)
         lat = inflight.apply_faults(lat, cfg, state.round, offset,
                                     peers, n_global, state.fault_params)
         ring = inflight.enqueue(state.inflight, state.round, peers, lat,
                                 responded, lie, polled)
         records, changed, votes_applied = inflight.deliver_multi_engine(
             ring, state.records, cfg, packed_global, minority_t, k_vote,
-            state.round, t_local, live_rows=alive_local)
+            state.round, t_local, live_rows=alive_local, ctx=pol)
     elif cfg.vote_mode is VoteMode.SEQUENTIAL:
         # Engine dispatch (`ops/exchange.gather_vote_packs`): global peer
         # ids index the replicated packed plane — one flattened gather
         # (fused, default) or k row-gathers (legacy).
         yes_pack, consider_pack = exchange.gather_vote_packs(
             packed_global, peers, responded, lie, k_vote, cfg, minority_t,
-            t_local)
+            t_local, pol)
         records, changed = vr.register_packed_votes_engine(
             state.records, yes_pack, consider_pack, cfg.k, cfg,
             update_mask=polled)
@@ -374,7 +431,7 @@ def _local_round(
     else:
         yes_pack, consider_pack = exchange.gather_vote_packs(
             packed_global, peers, responded, lie, k_vote, cfg, minority_t,
-            t_local)
+            t_local, pol)
         thresh = math.ceil(cfg.alpha * cfg.k)
         yes_cnt = popcnt_plane(yes_pack & consider_pack)
         no_cnt = popcnt_plane(~yes_pack & consider_pack)

@@ -197,8 +197,19 @@ def _local_round(
     else:
         minority_t = jnp.zeros((t_local,), jnp.bool_)  # unused
     k_vote = k_byz
-    if cfg.adversary_strategy is AdversaryStrategy.EQUIVOCATE:
+    if (cfg.adversary_strategy is AdversaryStrategy.EQUIVOCATE
+            or cfg.adversary_policy == "split_vote"):
+        # Per-target coins must differ across tx shards (the
+        # `parallel/sharded.py` equivocation rule).
         k_vote = jax.random.fold_in(k_byz, lax.axis_index(TXS_AXIS))
+
+    # --- adaptive adversary: the psum'd context twin, on the
+    # preferred-in-set response plane (`parallel/sharded.py` recipe).
+    pol = sharded._policy_ctx_sharded(cfg, base.records, prefs_local,
+                                      base.byzantine, base.latency_weight,
+                                      offset, n_local)
+    lie, responded, withheld = adversary.apply_policy_issue(cfg, pol, lie,
+                                                            responded)
 
     ring = base.inflight
     if inflight.enabled(cfg):
@@ -208,17 +219,18 @@ def _local_round(
         lat = inflight.draw_latency(k_sample, cfg, peers,
                                     base.latency_weight, n_global,
                                     row_offset=offset)
+        lat = adversary.apply_policy_latency(cfg, lat, lie, withheld)
         lat = inflight.apply_faults(lat, cfg, base.round, offset,
                                     peers, n_global, base.fault_params)
         ring = inflight.enqueue(base.inflight, base.round, peers, lat,
                                 responded, lie, polled)
         records, changed, votes_applied = inflight.deliver_multi_engine(
             ring, base.records, cfg, packed_global, minority_t, k_vote,
-            base.round, t_local, live_rows=alive_local)
+            base.round, t_local, live_rows=alive_local, ctx=pol)
     else:
         yes_pack, consider_pack = exchange.gather_vote_packs(
             packed_global, peers, responded, lie, k_vote, cfg, minority_t,
-            t_local)
+            t_local, pol)
 
         records, changed = vr.register_packed_votes_engine(
             base.records, yes_pack, consider_pack, cfg.k, cfg,

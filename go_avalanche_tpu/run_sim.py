@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from go_avalanche_tpu.config import (
+    ADVERSARY_POLICIES,
     AdversaryStrategy,
     AvalancheConfig,
     VoteMode,
@@ -74,14 +75,24 @@ def _parse_rtt_matrix(spec: str):
             f"(e.g. '1,3;3,1'), got {spec!r}")
 
 
+def _async_on(args: argparse.Namespace) -> bool:
+    """Will this flag set turn the in-flight engine on?  THE one
+    parser-level spelling of `cfg.async_queries()`'s derivation —
+    shared by `build_config` (the timing-knob mapping) and the
+    `--phase-grid` adversary check (the timing-policy mirror), so the
+    two can never desynchronize."""
+    script = getattr(args, "fault_script_events", None)
+    return (args.latency_mode != "none" or args.partition is not None
+            or any(e and e[0] != "churn_burst" for e in script or ()))
+
+
 def build_config(args: argparse.Namespace) -> AvalancheConfig:
     # Async axes: --timeout-rounds R maps to (time_step_s=1.0,
     # request_timeout_s=R-1), which makes cfg.timeout_rounds() == R
     # exactly; the seconds-based fields stay at reference defaults when
     # the async engine is off so the synchronous configs are unchanged.
     script = getattr(args, "fault_script_events", None)
-    async_on = (args.latency_mode != "none" or args.partition is not None
-                or any(e and e[0] != "churn_burst" for e in script or ()))
+    async_on = _async_on(args)
     timing = {}
     if async_on:
         if args.timeout_rounds < 1:
@@ -127,6 +138,8 @@ def build_config(args: argparse.Namespace) -> AvalancheConfig:
         byzantine_fraction=args.byzantine,
         flip_probability=args.flip_probability,
         adversary_strategy=AdversaryStrategy(args.adversary),
+        adversary_policy=getattr(args, "adversary_policy", "off"),
+        adversary_margin=getattr(args, "adversary_margin", 1),
         drop_probability=args.drop,
         churn_probability=args.churn,
         skip_absent_votes=args.skip_absent_votes,
@@ -709,6 +722,35 @@ def main(argv=None) -> Dict:
                         choices=[s.value for s in AdversaryStrategy],
                         default=AdversaryStrategy.FLIP.value,
                         help="what a lying byzantine peer answers")
+    parser.add_argument("--adversary-policy",
+                        choices=list(ADVERSARY_POLICIES),
+                        default="off",
+                        help="adaptive adversary policy "
+                             "(cfg.adversary_policy, ops/adversary.py): "
+                             "a jit-static attack kind that reads the "
+                             "CURRENT network state each round — "
+                             "'split_vote' lies vote the HONEST "
+                             "population's minority color (the arXiv "
+                             "2401.02811 stall attack; overrides "
+                             "--adversary's lie content), "
+                             "'withhold_near_quorum' lying draws go "
+                             "silent when the querier is within "
+                             "--adversary-margin window votes of the "
+                             "conclusive quorum (async configs expire "
+                             "them through the timeout machinery), "
+                             "'stake_eclipse' concentrates lies on the "
+                             "top-stake honest queriers (needs "
+                             "--stake-mode), 'timing' delays lies to "
+                             "land just before --timeout-rounds (needs "
+                             "an async --latency-mode).  Composes with "
+                             "--byzantine/--flip-probability; 'off' = "
+                             "the static strategies only, statically "
+                             "absent from every compiled program")
+    parser.add_argument("--adversary-margin", type=int, default=1,
+                        help="withhold_near_quorum: window votes short "
+                             "of the conclusive quorum at which a "
+                             "querier counts as near-quorum (>= quorum "
+                             "- margin)")
     parser.add_argument("--drop", type=float, default=0.0)
     parser.add_argument("--churn", type=float, default=0.0)
     parser.add_argument("--latency-mode",
@@ -968,6 +1010,32 @@ def main(argv=None) -> Dict:
                          "executes; --chunk dispatches host-driven "
                          "chunks — audit the unchunked spelling")
 
+    # Adversary-knob validation: mirror the config's inert-knob
+    # rejections at the parser (the PR 5 rule — the _validate_adversary
+    # messages would otherwise surface only at build_config below; these
+    # name the flags).
+    if args.byzantine == 0.0:
+        inert = [flag for flag, bad in (
+            ("--flip-probability", args.flip_probability != 1.0),
+            ("--adversary", args.adversary
+             != AdversaryStrategy.FLIP.value),
+            ("--adversary-policy", args.adversary_policy != "off"),
+            ("--adversary-margin", args.adversary_margin != 1),
+        ) if bad]
+        if inert:
+            parser.error(
+                f"{'/'.join(inert)} set with --byzantine 0: with no "
+                f"byzantine nodes every adversary knob is inert and "
+                f"would mislabel the run as attacked — set "
+                f"--byzantine > 0")
+    if (args.adversary_policy != "off"
+            and args.model in ("slush", "snowflake")):
+        parser.error(
+            f"--adversary-policy needs a round body carrying the "
+            f"policy context (models snowball/avalanche/dag/backlog/"
+            f"streaming_dag/node_stream); the family models "
+            f"(slush/snowflake) predate it — got {args.model}")
+
     # Fleet-mode validation: everything parser-level (the PR 5 rule).
     args.phase_grid_parsed = None
     if args.fleet is not None:
@@ -1195,6 +1263,24 @@ def main(argv=None) -> Dict:
             args.rtt_matrix_parsed = _parse_rtt_matrix(args.rtt_matrix)
         except (OSError, json.JSONDecodeError, ValueError, TypeError) as e:
             parser.error(f"--rtt-matrix: {e}")
+    if args.phase_grid_parsed is not None:
+        # Adversary-axis inert combinations (the fleet's one spelling,
+        # fleet.check_adversary_grid) die HERE, not mid-sweep.  Sits
+        # after the fault-script parse: the timing-policy check reads
+        # `_async_on` (build_config's own derivation).
+        from go_avalanche_tpu.fleet import check_adversary_grid
+
+        try:
+            check_adversary_grid(
+                args.phase_grid_parsed, byz_base=args.byzantine,
+                strategy_base=args.adversary,
+                flip_base=args.flip_probability,
+                policy_base=args.adversary_policy,
+                async_base=_async_on(args),
+                stake_base=args.stake_mode,
+                margin_base=args.adversary_margin)
+        except ValueError as e:
+            parser.error(f"--phase-grid: {e}")
     try:
         cfg = build_config(args)
     except (ValueError, TypeError) as e:

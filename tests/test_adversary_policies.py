@@ -1,0 +1,767 @@
+"""Adaptive adversary policies (PR 13: `cfg.adversary_policy`,
+ops/adversary.py) and the in-graph liveness/stall detector
+(fleet.liveness_stalled).
+
+Four layers:
+
+  * config hygiene — the inert-knob rejections (adversary knobs with
+    byzantine_fraction == 0; margin under the wrong policy; timing
+    without the async engine; eclipse without stake);
+  * transform semantics — what each policy does to the lie/responded/
+    latency planes and the lie content;
+  * bit-parity matrices — per policy: fused vs legacy exchange, the
+    three inflight delivery engines, vmapped fleet vs stacked single
+    runs, and the dense vs sharded policy-context planes (the psum'd
+    twin);
+  * detector TP/TN — a planted stall via split_vote fires the
+    detector, a benign run does not, and byzantine-only finalization
+    does NOT count as progress (the exclusion the safety detectors
+    established).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_avalanche_tpu import fleet
+from go_avalanche_tpu.config import (
+    ADVERSARY_POLICIES,
+    AdversaryStrategy,
+    AvalancheConfig,
+)
+from go_avalanche_tpu.models import avalanche as av
+from go_avalanche_tpu.models import dag as dag_model
+from go_avalanche_tpu.models import snowball as sb
+from go_avalanche_tpu.ops import adversary
+from go_avalanche_tpu.ops import voterecord as vr
+
+TIMING = dict(time_step_s=1.0, request_timeout_s=3.0)  # timeout_rounds 4
+
+
+def async_cfg(**kw):
+    kw.setdefault("latency_mode", "fixed")
+    kw.setdefault("latency_rounds", 1)
+    return AvalancheConfig(**TIMING, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Config hygiene: inert-knob rejections (satellite 1).
+
+
+def test_inert_adversary_knobs_rejected_without_byzantine():
+    with pytest.raises(ValueError, match="inert"):
+        AvalancheConfig(adversary_policy="split_vote")
+    with pytest.raises(ValueError, match="inert"):
+        AvalancheConfig(flip_probability=0.3)
+    with pytest.raises(ValueError, match="inert"):
+        AvalancheConfig(
+            adversary_strategy=AdversaryStrategy.OPPOSE_MAJORITY)
+    with pytest.raises(ValueError, match="inert"):
+        AvalancheConfig(adversary_margin=2)
+    # value-based, not passed-based: explicit defaults are fine
+    AvalancheConfig(flip_probability=1.0,
+                    adversary_strategy=AdversaryStrategy.FLIP,
+                    adversary_policy="off", adversary_margin=1)
+
+
+def test_policy_knob_validation():
+    with pytest.raises(ValueError, match="adversary_policy"):
+        AvalancheConfig(byzantine_fraction=0.2, adversary_policy="bogus")
+    with pytest.raises(ValueError, match="adversary_margin"):
+        AvalancheConfig(byzantine_fraction=0.2, adversary_margin=-1)
+    # margin is withhold-only
+    with pytest.raises(ValueError, match="adversary_margin"):
+        AvalancheConfig(byzantine_fraction=0.2, adversary_margin=3,
+                        adversary_policy="split_vote")
+    AvalancheConfig(byzantine_fraction=0.2, adversary_margin=3,
+                    adversary_policy="withhold_near_quorum")
+    # timing needs the async engine
+    with pytest.raises(ValueError, match="timing"):
+        AvalancheConfig(byzantine_fraction=0.2, adversary_policy="timing")
+    async_cfg(byzantine_fraction=0.2, adversary_policy="timing")
+    # eclipse needs a stake distribution
+    with pytest.raises(ValueError, match="stake"):
+        AvalancheConfig(byzantine_fraction=0.2,
+                        adversary_policy="stake_eclipse")
+    AvalancheConfig(byzantine_fraction=0.2,
+                    adversary_policy="stake_eclipse", stake_mode="zipf")
+    with pytest.raises(ValueError, match="byzantine_fraction"):
+        AvalancheConfig(byzantine_fraction=1.5)
+    with pytest.raises(ValueError, match="flip_probability"):
+        AvalancheConfig(byzantine_fraction=0.2, flip_probability=2.0)
+    # split_vote OVERRIDES the lie content: a non-default strategy
+    # under it would be silently ignored — rejected like the margin
+    with pytest.raises(ValueError, match="split_vote"):
+        AvalancheConfig(byzantine_fraction=0.2,
+                        adversary_policy="split_vote",
+                        adversary_strategy=AdversaryStrategy.EQUIVOCATE)
+
+
+# ---------------------------------------------------------------------------
+# Transform semantics.
+
+
+def test_split_vote_lies_vote_honest_minority():
+    cfg = AvalancheConfig(byzantine_fraction=0.25,
+                          adversary_policy="split_vote")
+    # honest rows 1..3 prefer yes/yes/no -> minority among honest is NO
+    byz = jnp.array([True, False, False, False])
+    prefs = jnp.array([False, True, True, False])
+    split, even = adversary.honest_split_plane(prefs, byz)
+    assert not bool(even)
+    assert not bool(split)   # minority color is... (2 yes of 3: no)
+    ctx = adversary.PolicyCtx(split_t=split, split_even=even)
+    votes = jnp.ones((4, 2), jnp.bool_)
+    lie = jnp.ones((4, 2), jnp.bool_)
+    out = adversary.apply_1d(jax.random.key(0), votes, lie, cfg, prefs,
+                             ctx)
+    assert not np.asarray(out).any()   # every lie says the minority: no
+
+
+def test_split_vote_equivocates_on_exact_tie():
+    cfg = AvalancheConfig(byzantine_fraction=0.5,
+                          adversary_policy="split_vote")
+    byz = jnp.array([True, True, False, False])
+    prefs = jnp.array([True, True, True, False])   # honest tie: 1 yes 1 no
+    split, even = adversary.honest_split_plane(prefs, byz)
+    assert bool(even)
+    ctx = adversary.PolicyCtx(split_t=split, split_even=even)
+    n = 512
+    votes = jnp.ones((n, 1), jnp.bool_)
+    lie = jnp.ones((n, 1), jnp.bool_)
+    out = np.asarray(adversary.apply_1d(jax.random.key(1), votes, lie,
+                                        cfg, prefs, ctx))
+    assert 0.35 < out.mean() < 0.65, out.mean()
+
+
+def test_split_vote_plane_honest_only_tally():
+    # Per-target plane form: byzantine rows must not move the tally.
+    byz = jnp.array([True, False, False])
+    prefs = jnp.array([[True, True],     # byz row: ignored
+                       [True, False],
+                       [False, False]])
+    split, even = adversary.honest_split_plane(prefs, byz)
+    # target 0: honest 1 yes / 1 no -> tie; target 1: 0 yes -> minority yes
+    assert np.asarray(even).tolist() == [True, False]
+    assert np.asarray(split).tolist() == [False, True]
+
+
+def test_split_vote_requires_ctx():
+    cfg = AvalancheConfig(byzantine_fraction=0.25,
+                          adversary_policy="split_vote")
+    with pytest.raises(ValueError, match="PolicyCtx"):
+        adversary.apply_1d(jax.random.key(0), jnp.ones((2, 2), jnp.bool_),
+                           jnp.ones((2, 2), jnp.bool_), cfg,
+                           jnp.ones((2,), jnp.bool_))
+
+
+def test_near_quorum_rows_and_withhold_issue():
+    cfg = AvalancheConfig(byzantine_fraction=0.5,
+                          adversary_policy="withhold_near_quorum",
+                          finalization_score=16)
+    # Hand-built records: node 0 has 6 yes of 6 considered (quorum 7,
+    # margin 1 -> near); node 1 has 3 of 6 (far); node 2 empty window.
+    votes = jnp.array([[0b00111111], [0b00000111], [0b00000000]],
+                      jnp.uint8)
+    cons = jnp.array([[0b00111111], [0b00111111], [0b00000000]],
+                     jnp.uint8)
+    conf = jnp.ones((3, 1), jnp.uint16)
+    records = vr.VoteRecordState(votes, cons, conf)
+    near = adversary.near_quorum_rows(records, cfg)
+    assert np.asarray(near).tolist() == [True, False, False]
+
+    ctx = adversary.PolicyCtx(withhold_q=near)
+    lie = jnp.array([[True, False], [True, True], [False, False]])
+    responded = jnp.ones((3, 2), jnp.bool_)
+    lie2, resp2, withheld = adversary.apply_policy_issue(cfg, ctx, lie,
+                                                         responded)
+    # only node 0's lying draw goes silent; honest draws untouched
+    assert np.asarray(withheld).tolist() == [[True, False],
+                                             [False, False],
+                                             [False, False]]
+    assert np.asarray(resp2).tolist() == [[False, True], [True, True],
+                                          [True, True]]
+    assert not np.asarray(lie2)[0, 0]          # silent draws do not lie
+    assert np.asarray(lie2)[1].all()           # far queriers still lied to
+
+
+def test_near_quorum_excludes_finalized_records():
+    cfg = AvalancheConfig(byzantine_fraction=0.5,
+                          adversary_policy="withhold_near_quorum",
+                          finalization_score=4)
+    votes = jnp.full((1, 1), 0b01111111, jnp.uint8)
+    cons = jnp.full((1, 1), 0b01111111, jnp.uint8)
+    conf = jnp.array([[4 << 1 | 1]], jnp.uint16)   # finalized accepted
+    near = adversary.near_quorum_rows(
+        vr.VoteRecordState(votes, cons, conf), cfg)
+    assert not np.asarray(near).any()
+
+
+def test_eclipse_rows_targets_top_stake_honest():
+    cfg = AvalancheConfig(byzantine_fraction=0.25,
+                          adversary_policy="stake_eclipse",
+                          stake_mode="zipf")
+    n = 8
+    byz = jnp.arange(n) < 2                      # the top-stake rows
+    weights = 1.0 / (jnp.arange(n, dtype=jnp.float32) + 1.0)   # zipf s=1
+    targets = np.asarray(adversary.eclipse_rows(weights, byz, cfg))
+    # ceil(0.25 * 8) = 2 targets: the two heaviest HONEST rows (2, 3)
+    assert targets.tolist() == [False, False, True, True,
+                                False, False, False, False]
+
+
+def test_eclipse_rows_saturates_without_leaking_byzantine():
+    # Requested set size (round(0.75 * 8) = 6) exceeds the 2 honest
+    # rows: the set saturates at "every honest querier" — byzantine
+    # rows must NOT leak in when the threshold bottoms out at the
+    # -inf byzantine fill.
+    cfg = AvalancheConfig(byzantine_fraction=0.75,
+                          adversary_policy="stake_eclipse",
+                          stake_mode="zipf")
+    n = 8
+    byz = jnp.arange(n) < 6
+    weights = 1.0 / (jnp.arange(n, dtype=jnp.float32) + 1.0)
+    targets = np.asarray(adversary.eclipse_rows(weights, byz, cfg))
+    assert targets.tolist() == [False] * 6 + [True, True]
+
+
+def test_timing_policy_stamps_last_deliverable_age():
+    cfg = async_cfg(byzantine_fraction=0.5, adversary_policy="timing")
+    lat = jnp.zeros((2, 3), jnp.int32)
+    lie = jnp.array([[True, False, True], [False, False, False]])
+    out = adversary.apply_policy_latency(cfg, lat, lie, None)
+    expect = cfg.timeout_rounds() - 1
+    assert np.asarray(out).tolist() == [[expect, 0, expect], [0, 0, 0]]
+
+
+def test_withhold_latency_stamps_expiry_sentinel():
+    cfg = async_cfg(byzantine_fraction=0.5,
+                    adversary_policy="withhold_near_quorum")
+    lat = jnp.zeros((1, 2), jnp.int32)
+    withheld = jnp.array([[True, False]])
+    out = adversary.apply_policy_latency(cfg, lat, jnp.zeros_like(withheld),
+                                         withheld)
+    assert np.asarray(out).tolist() == [[cfg.timeout_rounds(), 0]]
+
+
+def test_policy_off_is_statically_absent():
+    assert adversary.policy_ctx(AvalancheConfig(), None, None, None) is None
+    lie = jnp.ones((2, 2), jnp.bool_)
+    resp = jnp.ones((2, 2), jnp.bool_)
+    l2, r2, w = adversary.apply_policy_issue(AvalancheConfig(), None, lie,
+                                             resp)
+    assert l2 is lie and r2 is resp and w is None
+    lat = jnp.zeros((2, 2), jnp.int32)
+    assert adversary.apply_policy_latency(AvalancheConfig(), lat, lie,
+                                          None) is lat
+
+
+# ---------------------------------------------------------------------------
+# Round-level behavior.
+
+
+def _policy_cfgs(fin=16):
+    return {
+        "split_vote": AvalancheConfig(
+            finalization_score=fin, byzantine_fraction=0.25,
+            adversary_policy="split_vote"),
+        "withhold_near_quorum": AvalancheConfig(
+            finalization_score=fin, byzantine_fraction=0.25,
+            adversary_policy="withhold_near_quorum", adversary_margin=4),
+        "stake_eclipse": AvalancheConfig(
+            finalization_score=fin, byzantine_fraction=0.25,
+            adversary_policy="stake_eclipse", stake_mode="zipf"),
+    }
+
+
+@pytest.mark.parametrize("policy", [
+    "split_vote",
+    pytest.param("withhold_near_quorum", marks=pytest.mark.slow),
+    pytest.param("stake_eclipse", marks=pytest.mark.slow)])
+def test_dense_rounds_run_under_policy(policy):
+    cfg = _policy_cfgs()[policy]
+    st = av.init(jax.random.key(0), 24, 12, cfg,
+                 init_pref=av.contested_init_pref(0, 24, 12))
+    s2, tel = jax.jit(av.round_step, static_argnames="cfg")(st, cfg)
+    assert int(s2.round) == 1
+    assert int(tel.polls) == 24 * 12
+    st = sb.init(jax.random.key(0), 24, cfg, yes_fraction=0.5)
+    s2, _ = jax.jit(sb.round_step, static_argnames="cfg")(st, cfg)
+    assert int(s2.round) == 1
+    st = dag_model.init(jax.random.key(0), 24,
+                        jnp.arange(12, dtype=jnp.int32) // 2, cfg)
+    s2, _ = jax.jit(dag_model.round_step, static_argnames="cfg")(st, cfg)
+    assert int(s2.base.round) == 1
+
+
+@pytest.mark.parametrize("policy", [
+    "split_vote",
+    pytest.param("withhold_near_quorum", marks=pytest.mark.slow),
+    pytest.param("stake_eclipse", marks=pytest.mark.slow)])
+def test_exchange_engine_parity_under_policy(policy):
+    """Fused vs legacy exchange: identical trajectories per policy."""
+    base = _policy_cfgs()[policy]
+    pref = av.contested_init_pref(0, 16, 16)
+    finals = []
+    for fused in (True, False):
+        cfg = dataclasses.replace(base, fused_exchange=fused)
+        st = av.init(jax.random.key(3), 16, 16, cfg, init_pref=pref)
+        final, _ = av.run_scan(st, cfg, n_rounds=10)
+        finals.append(np.asarray(jax.device_get(
+            final.records.confidence)))
+    np.testing.assert_array_equal(finals[0], finals[1])
+
+
+@pytest.mark.parametrize("policy", [
+    "split_vote",
+    pytest.param("withhold_near_quorum", marks=pytest.mark.slow),
+    "timing"])
+def test_inflight_engine_parity_under_policy(policy):
+    """walk vs walk_earlyout vs coalesced: identical trajectories per
+    policy — including the policies that stamp per-draw latencies
+    (timing / withhold), which disable the coalesced engine's
+    fixed-latency single-age shortcut."""
+    kw = dict(finalization_score=16, byzantine_fraction=0.25,
+              adversary_policy=policy)
+    if policy == "withhold_near_quorum":
+        kw["adversary_margin"] = 4
+    base = async_cfg(**kw)
+    pref = av.contested_init_pref(1, 16, 16)
+    finals = []
+    for engine in ("walk", "walk_earlyout", "coalesced"):
+        cfg = dataclasses.replace(base, inflight_engine=engine)
+        st = av.init(jax.random.key(4), 16, 16, cfg, init_pref=pref)
+        final, tel = av.run_scan(st, cfg, n_rounds=10)
+        finals.append((np.asarray(jax.device_get(
+            final.records.confidence)),
+            int(np.asarray(tel.deliveries).sum()),
+            int(np.asarray(tel.expiries).sum())))
+    np.testing.assert_array_equal(finals[0][0], finals[1][0])
+    np.testing.assert_array_equal(finals[0][0], finals[2][0])
+    assert finals[0][1:] == finals[1][1:] == finals[2][1:]
+
+
+def test_timing_policy_delays_lies_to_pre_expiry_age():
+    """Under pure timing, byzantine responses deliver exactly at age
+    timeout-1: with flip_probability 1 no byzantine draw delivers
+    before that age, and none expires (the lie still lands)."""
+    cfg = async_cfg(finalization_score=0x7FFE, byzantine_fraction=0.5,
+                    adversary_policy="timing", latency_rounds=0)
+    st = av.init(jax.random.key(0), 16, 8, cfg)
+    _, tel = av.run_scan(st, cfg, n_rounds=cfg.timeout_rounds() + 2)
+    deliveries = np.asarray(tel.deliveries)
+    expiries = np.asarray(tel.expiries)
+    total_draws = 16 * cfg.k
+    # rounds before age timeout-1 is reachable carry only the honest
+    # latency-0 deliveries — the ~50% byzantine draws are all in flight
+    early = deliveries[:cfg.timeout_rounds() - 1]
+    late = deliveries[cfg.timeout_rounds() - 1:]
+    assert (early <= 0.8 * total_draws).all(), early
+    # once age timeout-1 is reachable, the delayed lies land on top
+    assert late.mean() > early.mean() + 0.25 * total_draws, (early, late)
+    assert expiries.sum() == 0
+
+
+def test_withhold_feeds_timeout_expiries():
+    """Withheld draws EXPIRE through the inflight machinery (never
+    deliver), visible in the expiries counter."""
+    cfg = async_cfg(finalization_score=0x7FFE, byzantine_fraction=0.5,
+                    adversary_policy="withhold_near_quorum",
+                    adversary_margin=8, latency_rounds=0)
+    st = av.init(jax.random.key(0), 16, 8, cfg,
+                 init_pref=av.contested_init_pref(0, 16, 8))
+    _, tel = av.run_scan(st, cfg, n_rounds=cfg.timeout_rounds() + 3)
+    assert int(np.asarray(tel.expiries).sum()) > 0
+
+
+@pytest.mark.parametrize("policy", [
+    "split_vote",
+    pytest.param("withhold_near_quorum", marks=pytest.mark.slow)])
+def test_vmapped_fleet_matches_stacked_runs(policy):
+    """vmap-cleanliness per policy: vmap(run_scan) over trial keys is
+    bit-identical to running each trial alone."""
+    cfg = _policy_cfgs()[policy]
+    keys = jax.random.split(jax.random.key(7), 3)
+
+    def one(key):
+        st = av.init(key, 12, 8, cfg,
+                     init_pref=av.contested_init_pref_from_key(key, 12, 8))
+        final, _ = av.run_scan(st, cfg, n_rounds=8)
+        return final.records.confidence
+
+    batched = np.asarray(jax.device_get(jax.vmap(one)(keys)))
+    single = np.stack([np.asarray(jax.device_get(one(k))) for k in keys])
+    np.testing.assert_array_equal(batched, single)
+
+
+def test_streaming_schedulers_inherit_policy():
+    """The backlog / streaming_dag / node_stream schedulers wrap the
+    dense rounds, so the policy threads through them untouched."""
+    from go_avalanche_tpu.models import backlog as bl
+    from go_avalanche_tpu.models import node_stream as ns
+    from go_avalanche_tpu.models import streaming_dag as sdg
+
+    cfg = _policy_cfgs()["split_vote"]
+    st = bl.init(jax.random.key(0), 16, 8,
+                 bl.make_backlog(jnp.arange(24, dtype=jnp.int32)), cfg)
+    s2, _ = jax.jit(bl.step, static_argnames="cfg")(st, cfg)
+    assert int(s2.sim.round) == 1
+
+    backlog = sdg.make_set_backlog(
+        jnp.arange(24, dtype=jnp.int32).reshape(12, 2))
+    st = sdg.init(jax.random.key(0), 16, 4, backlog, cfg)
+    s2, _ = jax.jit(sdg.step, static_argnames="cfg")(st, cfg)
+    assert int(s2.dag.base.round) == 1
+
+    ns_cfg = dataclasses.replace(cfg, stake_mode="zipf",
+                                 registry_nodes=32, active_nodes=16,
+                                 node_churn_rate=0.1)
+    st = ns.init(jax.random.key(0), 8, ns_cfg)
+    s2, _ = jax.jit(ns.step, static_argnames="cfg")(st, ns_cfg)
+    assert int(s2.sim.round) == 1
+
+
+# ---------------------------------------------------------------------------
+# Sharded parity: the psum'd context twin and driver determinism.
+
+
+def _mesh():
+    from go_avalanche_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(n_node_shards=4, n_tx_shards=2)
+
+
+@pytest.mark.parametrize("policy", ["split_vote", "withhold_near_quorum",
+                                    "stake_eclipse"])
+def test_sharded_policy_ctx_matches_dense(policy):
+    """`_policy_ctx_sharded` == `policy_ctx` on the same state — the
+    dense-vs-sharded bit-parity of the context planes themselves."""
+    from jax import lax
+    from go_avalanche_tpu.parallel import sharded
+    from go_avalanche_tpu.parallel.mesh import (
+        NODES_AXIS,
+        TXS_AXIS,
+        shard_map,
+    )
+    from jax.sharding import PartitionSpec as P
+
+    cfg = _policy_cfgs()[policy]
+    n, t = 16, 16
+    state = av.init(jax.random.key(5), n, t, cfg,
+                    init_pref=av.contested_init_pref(5, n, t))
+    prefs = vr.is_accepted(state.records.confidence)
+    dense = adversary.policy_ctx(cfg, state.records, state.byzantine,
+                                 state.latency_weight, prefs=prefs)
+
+    mesh = _mesh()
+    sh_state = sharded.shard_state(state, mesh)
+
+    def ctx_fn(records, byzantine, latency_weight):
+        n_local = records.votes.shape[0]
+        offset = lax.axis_index(NODES_AXIS) * n_local
+        prefs_local = vr.is_accepted(records.confidence)
+        ctx = sharded._policy_ctx_sharded(
+            cfg, records, prefs_local, byzantine, latency_weight,
+            offset, n_local)
+        if policy == "split_vote":
+            return ctx.split_t, ctx.split_even      # [t_local] planes
+        field = (ctx.withhold_q if policy == "withhold_near_quorum"
+                 else ctx.eclipse_q)
+        return (field,)                             # [n_local] planes
+
+    if policy == "split_vote":
+        out_specs = (P(TXS_AXIS), P(TXS_AXIS))
+        expect = (dense.split_t, dense.split_even)
+    else:
+        out_specs = (P(NODES_AXIS),)
+        expect = ((dense.withhold_q
+                   if policy == "withhold_near_quorum"
+                   else dense.eclipse_q),)
+    got = shard_map(
+        ctx_fn, mesh=mesh,
+        in_specs=(sharded.state_specs().records, P(), P()),
+        out_specs=out_specs)(
+        sh_state.records, sh_state.byzantine, sh_state.latency_weight)
+    for g, e in zip(got, expect):
+        np.testing.assert_array_equal(np.asarray(jax.device_get(g)),
+                                      np.asarray(jax.device_get(e)))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("policy", ["split_vote", "withhold_near_quorum",
+                                    "stake_eclipse", "timing"])
+def test_sharded_round_deterministic_under_policy(policy):
+    """The sharded avalanche driver under every policy: runs, and
+    reruns bit-identically (the `test_sharded_determinism` contract
+    extended to the policy engine)."""
+    from go_avalanche_tpu.parallel import sharded
+
+    if policy == "timing":
+        cfg = async_cfg(finalization_score=16, byzantine_fraction=0.25,
+                        adversary_policy="timing")
+    else:
+        cfg = _policy_cfgs()[policy]
+    mesh = _mesh()
+    make = lambda: sharded.shard_state(     # noqa: E731
+        av.init(jax.random.key(6), 16, 16, cfg,
+                init_pref=av.contested_init_pref(6, 16, 16)), mesh)
+    a, _ = sharded.run_scan_sharded(mesh, make(), cfg, n_rounds=8)
+    b, _ = sharded.run_scan_sharded(mesh, make(), cfg, n_rounds=8)
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(a.records.confidence)),
+        np.asarray(jax.device_get(b.records.confidence)))
+
+
+@pytest.mark.slow
+def test_sharded_dag_runs_under_split_vote():
+    from go_avalanche_tpu.parallel import sharded_dag
+
+    cfg = _policy_cfgs()["split_vote"]
+    mesh = _mesh()
+    cs = jnp.arange(16, dtype=jnp.int32) // 2
+    st = sharded_dag.shard_dag_state(
+        dag_model.init(jax.random.key(2), 16, cs, cfg), mesh)
+    s2, tel = sharded_dag.make_sharded_dag_round_step(mesh, cfg)(st)
+    assert int(s2.base.round) == 1
+
+
+# ---------------------------------------------------------------------------
+# Liveness/stall detector: TP / TN / byzantine exclusion.
+
+
+def _snowball_state(conf_rows, byz_rows, n=8):
+    """Hand-built final SnowballState: `conf_rows` finalized-accepted
+    rows, `byz_rows` byzantine rows."""
+    cfg = AvalancheConfig(finalization_score=4)
+    conf = jnp.where(jnp.isin(jnp.arange(n), jnp.asarray(conf_rows)),
+                     jnp.uint16(4 << 1 | 1), jnp.uint16(1))
+    records = vr.VoteRecordState(jnp.zeros((n,), jnp.uint8),
+                                 jnp.zeros((n,), jnp.uint8), conf)
+    return sb.SnowballState(
+        records=records,
+        byzantine=jnp.isin(jnp.arange(n), jnp.asarray(byz_rows)),
+        alive=jnp.ones((n,), jnp.bool_),
+        finalized_at=jnp.where(conf > 1, 3, -1).astype(jnp.int32),
+        round=jnp.int32(10), key=jax.random.key(0)), cfg
+
+
+def test_stall_detector_byzantine_only_finalization_counts_as_stall():
+    # ONLY byzantine rows finalized: no honest progress -> stalled.
+    state, cfg = _snowball_state(conf_rows=[0, 1], byz_rows=[0, 1])
+    out = fleet._outcome_snowball(state, cfg)
+    assert bool(out.stalled)
+    # one honest row finalized -> progress -> not stalled
+    state, cfg = _snowball_state(conf_rows=[0, 1, 5], byz_rows=[0, 1])
+    assert not bool(fleet._outcome_snowball(state, cfg).stalled)
+
+
+def test_stall_detector_requires_honest_majority():
+    # 5 of 8 byzantine: the overwhelmed network has no liveness claim
+    # to violate — the detector abstains.
+    state, cfg = _snowball_state(conf_rows=[], byz_rows=[0, 1, 2, 3, 4])
+    assert not bool(fleet._outcome_snowball(state, cfg).stalled)
+    # honest majority, nothing finalized: the stall event.
+    state, cfg = _snowball_state(conf_rows=[], byz_rows=[0, 1])
+    assert bool(fleet._outcome_snowball(state, cfg).stalled)
+
+
+def test_liveness_stalled_multitarget_reduction():
+    byz = jnp.array([True, False, False])
+    alive = jnp.ones((3,), jnp.bool_)
+    fin = jnp.zeros((3, 4), jnp.bool_)
+    assert bool(fleet.liveness_stalled(fin, byz, alive))
+    assert not bool(fleet.liveness_stalled(fin.at[2, 1].set(True), byz,
+                                           alive))
+    # byzantine finalization alone is not progress
+    assert bool(fleet.liveness_stalled(
+        jnp.zeros((3, 4), jnp.bool_).at[0, :].set(True), byz, alive))
+
+
+def test_fleet_stall_tp_tn():
+    """Planted stall (split_vote at high byz) fires the detector; the
+    benign fleet never does.  The summary row carries the Wilson-CI'd
+    P(stall)."""
+    cfg = AvalancheConfig(finalization_score=64, byzantine_fraction=0.4,
+                          adversary_policy="split_vote")
+    res = fleet.run_fleet("snowball", cfg, fleet=8, n_nodes=64,
+                          n_rounds=100, yes_fraction=0.5)
+    assert res.p_stall >= 0.75, res.p_stall
+    assert res.stall_ci[0] > 0.3
+    row = res.summary()
+    assert row["stalls"] == int(res.stalled.sum())
+    assert row["p_stall"] == pytest.approx(res.p_stall, abs=1e-6)
+
+    benign = AvalancheConfig(finalization_score=64)
+    res = fleet.run_fleet("snowball", benign, fleet=8, n_nodes=64,
+                          n_rounds=100, yes_fraction=0.5)
+    assert res.p_stall == 0.0
+    assert res.p_settled == 1.0
+
+
+@pytest.mark.slow
+def test_fleet_stall_monotone_in_byzantine_fraction():
+    """The 2409.02217 phase structure at CPU shape: P(stall) under
+    split_vote is monotone-increasing in byzantine fraction at fixed
+    (k, quorum) — the atlas acceptance, pinned small."""
+    base = AvalancheConfig(finalization_score=64, byzantine_fraction=0.05,
+                           adversary_policy="split_vote")
+    rows = fleet.run_phase_grid(
+        "snowball", base, {"byzantine_fraction": [0.05, 0.25, 0.45]},
+        fleet=16, n_nodes=64, n_rounds=120, yes_fraction=0.5)
+    stalls = [r["p_stall"] for r in rows]
+    assert stalls == sorted(stalls), stalls
+    assert stalls[0] <= 0.2 and stalls[-1] >= 0.8, stalls
+
+
+@pytest.mark.slow
+def test_fleet_stall_detector_agrees_with_trace_plane():
+    """The atlas spot-check as a pin: per trial, the stall verdict and
+    the trace-plane finality curve tell one story (a stalled trial's
+    cumulative finalizations can only carry byzantine rows)."""
+    n, byz = 48, 0.45
+    cfg = AvalancheConfig(finalization_score=64, byzantine_fraction=byz,
+                          adversary_policy="split_vote", trace_every=1)
+    res = fleet.run_fleet("snowball", cfg, fleet=8, n_nodes=n,
+                          n_rounds=90, yes_fraction=0.5)
+    records = res.trace_records()
+    n_byz = int(round(byz * n))
+    for i in range(8):
+        total_fin = sum(rec["finalizations"][i] for rec in records)
+        if bool(res.stalled[i]):
+            assert total_fin <= n_byz, (i, total_fin)
+        elif res.finalized_fraction[i] > 0:
+            assert total_fin > 0, i
+
+
+@pytest.mark.parametrize("model", ["avalanche", "dag", "backlog"])
+def test_fleet_stalled_field_present_every_model(model):
+    cfg = AvalancheConfig(finalization_score=16)
+    res = fleet.run_fleet(model, cfg, fleet=4, n_nodes=16, n_txs=8,
+                          n_rounds=40, window=8)
+    assert res.stalled.shape == (4,)
+    assert not res.stalled.any()     # benign: no stalls anywhere
+
+
+# ---------------------------------------------------------------------------
+# Phase-grid axes + inert-combination rejections (satellite 2).
+
+
+def test_phase_grid_adversary_policy_axis():
+    base = AvalancheConfig(finalization_score=16, byzantine_fraction=0.3)
+    rows = fleet.run_phase_grid(
+        "snowball", base, {"adversary_policy": ["off", "split_vote"]},
+        fleet=4, n_nodes=24, n_rounds=40, yes_fraction=0.5)
+    assert [r["point"]["adversary_policy"] for r in rows] \
+        == ["off", "split_vote"]
+    # the policy point is tagged; the off point is not
+    assert "split_vote-adversary" in rows[1]["tag"]
+    assert "adversary" not in rows[0]["tag"]
+
+
+def test_phase_grid_rejects_unknown_policy():
+    with pytest.raises(ValueError, match="unknown adversary policy"):
+        fleet.phase_points({"adversary_policy": ["nope"]})
+
+
+def test_phase_grid_rejects_inert_adversary_combinations():
+    base = AvalancheConfig(finalization_score=16, byzantine_fraction=0.2,
+                           adversary_policy="split_vote")
+    with pytest.raises(ValueError, match="byzantine_fraction == 0"):
+        fleet.run_phase_grid("snowball", base,
+                             {"byzantine_fraction": [0.0, 0.2]},
+                             fleet=2, n_nodes=16, n_rounds=10)
+    # base byz 0 + policy axis: same rejection
+    with pytest.raises(ValueError, match="byzantine_fraction == 0"):
+        fleet.run_phase_grid(
+            "snowball", AvalancheConfig(finalization_score=16),
+            {"adversary_policy": ["split_vote"]},
+            fleet=2, n_nodes=16, n_rounds=10)
+    # timing policy axis needs the base config's async engine
+    with pytest.raises(ValueError, match="timing"):
+        fleet.run_phase_grid(
+            "snowball",
+            AvalancheConfig(finalization_score=16,
+                            byzantine_fraction=0.2),
+            {"adversary_policy": ["timing"]},
+            fleet=2, n_nodes=16, n_rounds=10)
+    # stake_eclipse policy axis needs the base config's stake plane —
+    # rejected UPFRONT, not mid-sweep at the point config's validator
+    with pytest.raises(ValueError, match="stake_mode"):
+        fleet.run_phase_grid(
+            "avalanche",
+            AvalancheConfig(finalization_score=16,
+                            byzantine_fraction=0.2),
+            {"adversary_policy": ["split_vote", "stake_eclipse"]},
+            fleet=2, n_nodes=16, n_rounds=10)
+    # a non-default base margin rejects non-withhold policy points
+    with pytest.raises(ValueError, match="adversary_margin"):
+        fleet.run_phase_grid(
+            "snowball",
+            AvalancheConfig(finalization_score=16,
+                            byzantine_fraction=0.2,
+                            adversary_policy="withhold_near_quorum",
+                            adversary_margin=3),
+            {"adversary_policy": ["withhold_near_quorum",
+                                  "split_vote"]},
+            fleet=2, n_nodes=16, n_rounds=10)
+    # split_vote points cannot combine with a swept non-FLIP strategy
+    with pytest.raises(ValueError, match="OVERRIDES"):
+        fleet.run_phase_grid(
+            "snowball",
+            AvalancheConfig(finalization_score=16,
+                            byzantine_fraction=0.2),
+            {"adversary_policy": ["split_vote"],
+             "adversary_strategy": ["equivocate"]},
+            fleet=2, n_nodes=16, n_rounds=10)
+
+
+# ---------------------------------------------------------------------------
+# run_sim parser mirrors (satellite 1b) + end-to-end CLI.
+
+
+def test_run_sim_rejects_inert_adversary_flags():
+    from go_avalanche_tpu import run_sim
+
+    with pytest.raises(SystemExit):
+        run_sim.main(["--byzantine", "0", "--adversary-policy",
+                      "split_vote"])
+    with pytest.raises(SystemExit):
+        run_sim.main(["--byzantine", "0", "--flip-probability", "0.5"])
+    with pytest.raises(SystemExit):
+        run_sim.main(["--byzantine", "0", "--adversary",
+                      "oppose_majority"])
+    with pytest.raises(SystemExit):   # timing without async
+        run_sim.main(["--byzantine", "0.2", "--adversary-policy",
+                      "timing"])
+    with pytest.raises(SystemExit):   # family models predate the policy
+        run_sim.main(["--model", "slush", "--byzantine", "0.2",
+                      "--adversary-policy", "split_vote"])
+    with pytest.raises(SystemExit):   # inert grid combination
+        run_sim.main(["--model", "snowball", "--fleet", "2",
+                      "--byzantine", "0.2",
+                      "--adversary-policy", "split_vote",
+                      "--phase-grid",
+                      '{"byzantine_fraction": [0.0, 0.2]}'])
+
+
+def test_run_sim_fleet_reports_stall(tmp_path):
+    from go_avalanche_tpu import run_sim
+
+    out = run_sim.main(["--model", "snowball", "--fleet", "4",
+                        "--nodes", "32", "--max-rounds", "40",
+                        "--finalization-score", "64",
+                        "--yes-fraction", "0.5",
+                        "--byzantine", "0.4",
+                        "--adversary-policy", "split_vote", "--json"])
+    assert "p_stall" in out and "stall_ci" in out
+    assert out["p_stall"] >= 0.5
+
+
+def test_adversary_policies_constant_matches_config():
+    assert ADVERSARY_POLICIES[0] == "off"
+    for p in ("split_vote", "withhold_near_quorum", "stake_eclipse",
+              "timing"):
+        assert p in ADVERSARY_POLICIES
