@@ -145,6 +145,7 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
           inflight: str = "walk", fleet: int | None = None,
           arrival: float | None = None, arrival_window: int = 1024,
           stake: str = "off", stake_clusters: int = 1,
+          adversary: str = "off", byzantine: float = 0.0,
           metrics: str | None = None, metrics_every: int = 0,
           metrics_tap: str = "callback",
           profile: bool = False) -> dict:
@@ -194,6 +195,10 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
             raise ValueError("--arrival times the streaming scheduler; "
                              "the --stake lane times the flagship scan "
                              "— pick one lane")
+        if adversary != "off" or byzantine:
+            raise ValueError("--arrival times the streaming scheduler; "
+                             "the --adversary lane rides the flagship "
+                             "scan — pick one lane")
         window = min(arrival_window, n_txs)
         state, cfg = traffic_backlog_state(n_nodes, n_txs, window, k,
                                            rate=arrival,
@@ -214,12 +219,17 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
             fleet, n_nodes, n_txs, k, latency,
             latency_mode=latency_mode, timeout_rounds=timeout_rounds,
             inflight_engine=inflight, stake=stake,
-            clusters=stake_clusters)
+            clusters=stake_clusters, adversary=adversary,
+            byzantine=byzantine)
     else:
         # `stake`/`stake_clusters` ride the flagship lane: the same
         # timed scan under the stake-weighted committee draw
         # (hierarchical two-level engine when clusters > 1) — pinned
         # as flagship_stake; stake "off" IS the flagship program.
+        # `adversary`/`byzantine` likewise (the adaptive-adversary A/B
+        # lane, pinned as flagship_adversary): the per-round policy
+        # context plane rides the timed scan, and the byzantine mask
+        # enters at init — both off IS the flagship program.
         state, cfg = flagship_state(n_nodes, n_txs, k, latency,
                                     latency_mode=latency_mode,
                                     timeout_rounds=timeout_rounds,
@@ -228,7 +238,9 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
                                     trace_every=trace_every,
                                     trace_rounds=trace_rounds,
                                     stake=stake,
-                                    clusters=stake_clusters)
+                                    clusters=stake_clusters,
+                                    adversary=adversary,
+                                    byzantine=byzantine)
     if exchange != "fused":
         cfg = dataclasses.replace(cfg, fused_exchange=False)
     if ingest != "u8":
@@ -289,6 +301,41 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
             buf = state.trace if arrival is None else state.sim.trace
             obs_trace.write_trace(sink, buf)
 
+    profile_payload = None
+    if profile:
+        # Two views of the same canonical phases (obs.tags.PHASE_SPANS):
+        # the eager wall-clock replay (relative breakdown, dispatch
+        # overhead rides along) and the DEVICE-time harvest — one extra
+        # profiled sweep of THE timed program under jax.profiler, its
+        # xplane op events joined to the phases through the compiled
+        # HLO's op_name metadata (utils/tracing.device_phase_times).
+        profile_payload = {"tag": engine_tag,
+                           "eager_ms": _phase_profile(av, state, cfg)}
+        if trace_every:
+            # The trace buffer is sized for exactly warmup + repeats
+            # sweeps; the harvest's extra sweep would overrun it.
+            profile_payload["device_error"] = (
+                "skipped: the on-device trace plane is sized for the "
+                "timed sweeps only")
+        else:
+            try:
+                from go_avalanche_tpu.utils import tracing
+
+                # One AOT compile, outside the timed window (opt-in
+                # lane): the profiled sweep runs THIS executable, so
+                # the op-name join is against the exact program that
+                # produced the xplane events — no determinism
+                # assumption between two compilations.
+                compiled = run.lower(state).compile()
+                state, device_ms = tracing.device_phase_times(
+                    compiled, state, compiled_text=compiled.as_text())
+                profile_payload["device_ms"] = device_ms
+            except Exception as e:  # noqa: BLE001 — the harvest must
+                # never sink the measurement it annotates (profiler
+                # availability differs per backend)
+                profile_payload["device_error"] = \
+                    f"{type(e).__name__}: {e}"
+
     if metrics:
         # Provenance next to the trace: config, topology, pin hashes,
         # git sha (obs/manifest.py).
@@ -298,6 +345,7 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
                          "repeats": repeats,
                          "sweeps": repeats + 1},
             "tag": engine_tag,
+            **({"profile": profile_payload} if profile_payload else {}),
         })
 
     if arrival is not None:
@@ -310,15 +358,32 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
         votes = n_nodes * n_txs * k * n_rounds * (fleet or 1)
         shape = f"{n_nodes} nodes x {n_txs} txs, k={k}, {n_rounds} rounds, "
     votes_per_sec = votes / best_dt
+    devices = jax.devices()
     result = {
         "metric": f"sustained vote ingest ({shape}"
-                  f"{jax.devices()[0].platform}{engine_tag})",
+                  f"{devices[0].platform}{engine_tag})",
         "value": round(votes_per_sec, 1),
         "unit": "votes/sec",
         "vs_baseline": round(votes_per_sec / NORTH_STAR_VOTES_PER_SEC, 4),
+        # Self-describing provenance (the ledger row contract,
+        # benchmarks/ledger.py): backend/devices/tag as FIELDS, so no
+        # consumer ever re-parses them out of the metric string.  Old
+        # artifacts without these read as backend="unknown" and are
+        # gate-excluded, never silently compared.
+        "backend": devices[0].platform,
+        "devices": {"platform": devices[0].platform,
+                    "device_kind": getattr(devices[0], "device_kind",
+                                           None),
+                    "device_count": len(devices)},
+        "tag": engine_tag,
     }
-    if profile:
-        result["profile_ms"] = _phase_profile(av, state, cfg)
+    if profile_payload is not None:
+        result["profile_ms"] = profile_payload["eager_ms"]
+        if "device_ms" in profile_payload:
+            result["profile_device_ms"] = profile_payload["device_ms"]
+        elif "device_error" in profile_payload:
+            result["profile_device_error"] = profile_payload[
+                "device_error"]
     return result
 
 
@@ -355,6 +420,7 @@ def _worker_main(args: argparse.Namespace) -> None:
                    arrival=args.arrival,
                    arrival_window=args.arrival_window,
                    stake=args.stake, stake_clusters=args.stake_clusters,
+                   adversary=args.adversary, byzantine=args.byzantine,
                    metrics=args.metrics, metrics_every=args.metrics_every,
                    metrics_tap=args.metrics_tap,
                    profile=args.profile)
@@ -461,6 +527,19 @@ def _attach_prev_delta(parsed: dict, search_dir: str | None = None) -> dict:
     except Exception:  # noqa: BLE001 — the delta is best-effort; never
         pass           # break the one-line contract over an annotation
     return parsed
+
+
+def _ledger_append(parsed: dict) -> None:
+    """One schema-versioned row per bench run into the perf ledger
+    (benchmarks/ledger.py; `GO_AVALANCHE_TPU_LEDGER` redirects — tests
+    point it at a tmpdir).  Best-effort on purpose: the ledger is an
+    annotation, and nothing may break the one-line stdout contract."""
+    try:
+        from benchmarks import ledger
+
+        ledger.append(ledger.row_from_result(parsed, source="bench"))
+    except Exception:  # noqa: BLE001
+        pass
 
 
 def main() -> None:
@@ -579,6 +658,30 @@ def main() -> None:
                              "over this many contiguous clusters (the "
                              "hierarchical two-level engine; 1 = flat "
                              "CDF)")
+    parser.add_argument("--adversary",
+                        choices=("off", "split_vote",
+                                 "withhold_near_quorum", "stake_eclipse",
+                                 "timing"),
+                        default="off",
+                        help="adaptive-adversary A/B lane "
+                             "(cfg.adversary_policy, ops/adversary.py): "
+                             "time the flagship scan with the per-round "
+                             "policy context plane in the timed program "
+                             "— prices the state-reading adversary's "
+                             "overhead (the PR 13 follow-up).  Needs "
+                             "--byzantine > 0 (who lies); the metric "
+                             "gains ', {policy}-adversary' so "
+                             "same-metric deltas never cross threat "
+                             "models.  'timing' needs --latency (it "
+                             "delays ring deliveries); 'stake_eclipse' "
+                             "needs --stake (it reads the stake-folded "
+                             "propensity plane).  The pinned spelling "
+                             "is flagship_adversary: --latency 2 "
+                             "--inflight-engine coalesced --adversary "
+                             "split_vote --byzantine 0.125")
+    parser.add_argument("--byzantine", type=float, default=0.0,
+                        help="with --adversary: byzantine node "
+                             "fraction (the mask enters at init)")
     parser.add_argument("--metrics", type=str, default=None, metavar="PATH",
                         help="stream per-round telemetry to this JSONL "
                              "file through the in-graph metrics tap "
@@ -701,6 +804,34 @@ def main() -> None:
         parser.error("--arrival times the streaming scheduler; the "
                      "--stake lane times the flagship scan — pick one "
                      "lane")
+    # Adversary-lane rejections (the PR 5 rule: inert combos die at the
+    # parser, never as a worker ValueError that reads as an accelerator
+    # failure and spins the retry loop).
+    if not 0.0 <= args.byzantine < 1.0:
+        parser.error(f"--byzantine must be a fraction in [0, 1), got "
+                     f"{args.byzantine}")
+    if args.adversary != "off" and args.byzantine == 0.0:
+        parser.error("--adversary set with --byzantine 0: with no "
+                     "byzantine nodes the policy context plane is inert "
+                     "and the run would be mislabeled as attacked — set "
+                     "--byzantine > 0")
+    if args.adversary == "off" and args.byzantine > 0.0:
+        parser.error("--byzantine without --adversary would time the "
+                     "static-adversary draws UNTAGGED (the static knobs "
+                     "predate the metric tag) — the bench A/B lane "
+                     "prices adaptive policies; pick one with "
+                     "--adversary")
+    if args.adversary == "timing" and not args.latency:
+        parser.error("--adversary timing delays in-flight ring "
+                     "deliveries; without --latency there is no ring — "
+                     "the policy would be silently inert")
+    if args.adversary == "stake_eclipse" and args.stake == "off":
+        parser.error("--adversary stake_eclipse reads the stake-folded "
+                     "sampling-propensity plane; it needs --stake")
+    if args.adversary != "off" and args.arrival is not None:
+        parser.error("--arrival times the streaming scheduler; the "
+                     "--adversary lane rides the flagship scan — pick "
+                     "one lane")
     if args.metrics_every < 0:
         # Reject here: the worker subprocess's ValueError would read as
         # an accelerator failure and spin the retry/fallback loop.
@@ -734,6 +865,9 @@ def main() -> None:
         + ([f"--stake={args.stake}",
             f"--stake-clusters={args.stake_clusters}"]
            if args.stake != "off" else []) \
+        + ([f"--adversary={args.adversary}",
+            f"--byzantine={args.byzantine}"]
+           if args.adversary != "off" else []) \
         + ([f"--fleet={args.fleet}"] if args.fleet is not None else []) \
         + ([f"--arrival={args.arrival}",
             f"--arrival-window={args.arrival_window}"]
@@ -753,7 +887,9 @@ def main() -> None:
     for attempt in range(args.attempts):
         parsed, diag = _run_attempt(size, args.attempt_timeout)
         if parsed is not None:
-            print(json.dumps(_attach_prev_delta(parsed)))
+            parsed = _attach_prev_delta(parsed)
+            print(json.dumps(parsed))
+            _ledger_append(parsed)
             return
         errors.append(f"attempt {attempt + 1}: {diag}")
         if attempt + 1 < args.attempts:
@@ -771,7 +907,8 @@ def main() -> None:
         parsed["metric"] += " [CPU FALLBACK — accelerator unavailable" \
             + (": " + "; ".join(errors) if errors else "") + "]"
         print(json.dumps(parsed))
-        return
+        _ledger_append(parsed)  # the label marks the row as an
+        return                  # availability datum; the gate refuses it
     errors.append(f"cpu fallback: {diag}")
 
     # Nothing ran — still emit the one-line contract.

@@ -1,0 +1,354 @@
+"""Perf ledger + regression gate: every bench number, one append-only file.
+
+The BENCH_r01–r05 chain already produced one incomparable CPU-vs-TPU
+sequence that only a human footnote in ROADMAP caught (r04/r05 ran on
+the CPU fallback during the accelerator outage; the 0.74 B numbers sit
+next to r03's 56.8 B with nothing machine-readable saying they must
+never be compared).  The ledger makes the trajectory a dataset and the
+footgun a hard error:
+
+  * every `bench.py` run APPENDS a schema-versioned row to
+    ``benchmarks/ledger.jsonl`` (metric, value, tag, backend, device
+    topology, git sha, manifest path) — override the destination with
+    ``GO_AVALANCHE_TPU_LEDGER=/path`` (tests do);
+  * ``--gate`` compares each lane chain's adjacent rows within
+    a noise band — same-backend pairs only.  A chain whose backend
+    CHANGES between comparable rows is a HARD ERROR, rows with
+    ``backend="unknown"`` (pre-ledger artifacts) and labeled CPU
+    fallbacks are REFUSED from comparison and reported, never
+    silently compared;
+  * ``--table`` renders the round-over-round trajectory the PERF_NOTES
+    tables were maintaining by hand;
+  * ``--import BENCH_r*.json`` backfills the archived driver rounds
+    (how the committed seed rows were produced).
+
+Lane identity: the metric string with its backend token and fallback
+label stripped (shape and engine tags stay — a shape change is a new
+lane, exactly like `bench._attach_prev_delta`'s same-metric rule).
+
+    python benchmarks/ledger.py --table
+    python benchmarks/ledger.py --gate
+    python benchmarks/ledger.py --import BENCH_r0*.json --table
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+SCHEMA = 1
+DEFAULT_LEDGER = Path(__file__).with_name("ledger.jsonl")
+DEFAULT_BAND = 0.10  # |delta| fraction treated as same-lane noise
+
+_BACKEND_TOKEN = re.compile(r", (cpu|tpu|gpu|axon)(?=[,)])")
+_FALLBACK_LABEL = re.compile(r"\s*\[CPU FALLBACK[^\]]*\]")
+
+
+def ledger_path() -> Path:
+    """The append destination: ``GO_AVALANCHE_TPU_LEDGER`` if set (how
+    tests and scratch runs stay out of the committed ledger), else the
+    repo archive."""
+    override = os.environ.get("GO_AVALANCHE_TPU_LEDGER")
+    return Path(override) if override else DEFAULT_LEDGER
+
+
+def split_metric(metric: str) -> Tuple[str, Optional[str], bool]:
+    """``(lane, backend_or_None, fallback)`` from a bench metric string.
+
+    The backend rides inside the metric's parenthetical
+    (``"... 20 rounds, tpu)"``) and the availability label outside it
+    (``"[CPU FALLBACK — ...]"``); the LANE is the metric with both
+    removed — what two rows must share before their values may ever be
+    compared."""
+    fallback = bool(_FALLBACK_LABEL.search(metric))
+    lane = _FALLBACK_LABEL.sub("", metric)
+    m = _BACKEND_TOKEN.search(lane)
+    backend = m.group(1) if m else None
+    if m:
+        lane = lane[:m.start()] + lane[m.end():]
+    return lane.strip(), backend, fallback
+
+
+def row_from_result(parsed: Dict, source: str = "bench",
+                    bench_round: Optional[int] = None) -> Dict:
+    """A ledger row from one bench JSON-line result.  Self-describing
+    results (the post-PR-14 contract: explicit ``backend`` /
+    ``devices`` / ``tag`` keys) are taken at their word; older
+    artifacts fall back to parsing the metric string, and rows whose
+    backend cannot be established read ``"unknown"`` — the gate
+    excludes them rather than ever silently comparing."""
+    metric = parsed.get("metric", "")
+    lane, metric_backend, fallback = split_metric(metric)
+    backend = parsed.get("backend") or metric_backend or "unknown"
+    row = {
+        "schema": SCHEMA,
+        "ts": round(time.time(), 3),
+        "metric": metric,
+        "lane": lane,
+        "value": parsed.get("value"),
+        "unit": parsed.get("unit"),
+        "tag": parsed.get("tag", ""),
+        "backend": backend,
+        "fallback": fallback,
+        "devices": parsed.get("devices"),
+        "git_sha": _git_sha(),
+        "source": source,
+    }
+    if bench_round is not None:
+        row["round"] = bench_round
+    if parsed.get("manifest"):
+        row["manifest"] = parsed["manifest"]
+    if parsed.get("error"):
+        row["note"] = parsed["error"]
+    return row
+
+
+def _git_sha() -> Optional[str]:
+    from go_avalanche_tpu.obs import manifest
+
+    return manifest._git_sha()
+
+
+def append(row: Dict, path: Optional[Path] = None) -> Path:
+    path = Path(path) if path else ledger_path()
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with path.open("a") as fh:
+        fh.write(json.dumps(row, sort_keys=True) + "\n")
+    return path
+
+
+def load(path: Optional[Path] = None) -> List[Dict]:
+    path = Path(path) if path else ledger_path()
+    if not path.exists():
+        return []
+    rows = []
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # a torn write must not sink the whole ledger
+        if isinstance(row, dict):
+            rows.append(row)
+    return rows
+
+
+def import_bench(paths) -> List[Dict]:
+    """Backfill rows from archived driver artifacts (``BENCH_r{N}.json``:
+    ``{"n": round, "parsed": result-or-null, ...}``).  A round whose
+    worker produced no parseable result (r01's rc=1 stack trace) still
+    gets a row — value None, backend unknown — so the trajectory table
+    shows the failure instead of skipping the round."""
+    rows = []
+    for path in paths:
+        data = json.loads(Path(path).read_text())
+        n = data.get("n")
+        parsed = data.get("parsed")
+        if isinstance(parsed, dict):
+            row = row_from_result(parsed, source=f"import:{Path(path).name}",
+                                  bench_round=n)
+        else:
+            row = {"schema": SCHEMA, "ts": round(time.time(), 3),
+                   "metric": None, "lane": None, "value": None,
+                   "unit": None, "tag": "", "backend": "unknown",
+                   "fallback": False, "devices": None,
+                   "git_sha": None, "round": n,
+                   "source": f"import:{Path(path).name}",
+                   "note": f"no parseable result (rc={data.get('rc')})"}
+        rows.append(row)
+    return rows
+
+
+def _sort_key(row: Dict):
+    return (row.get("round") if row.get("round") is not None else 1 << 30,
+            row.get("ts") or 0.0)
+
+
+def gate(rows: List[Dict], band: float = DEFAULT_BAND
+         ) -> Tuple[List[str], List[str], List[str]]:
+    """``(failures, refused, report)`` over the ledger.
+
+    Chains are LANE groups ordered by (round, ts) — the engine tag is
+    embedded in the lane string, so tagged lanes are already distinct
+    chains (the explicit ``tag`` field is row metadata, not a second
+    key: old artifacts carry it only inside the metric).  Within a
+    chain: rows with an unknown backend or a fallback label are
+    REFUSED from comparison (listed, never compared); adjacent
+    comparable rows with DIFFERENT backends are a hard failure (the
+    r04/r05 class: a trajectory must not change backend mid-chain —
+    open a new lane or re-measure); same-backend adjacent rows gate on
+    the noise band (a drop beyond it is a regression failure, growth
+    is reported)."""
+    failures: List[str] = []
+    refused: List[str] = []
+    report: List[str] = []
+
+    chains: Dict[str, List[Dict]] = {}
+    for row in rows:
+        if row.get("lane") is None:
+            refused.append(
+                f"{_rowid(row)}: refused — no metric (failed round); "
+                f"never compared")
+            continue
+        chains.setdefault(row["lane"], []).append(row)
+
+    for lane, chain in sorted(chains.items()):
+        chain = sorted(chain, key=_sort_key)
+        comparable = []
+        for row in chain:
+            if row.get("backend") in (None, "unknown"):
+                refused.append(
+                    f"{_rowid(row)}: refused — backend unknown "
+                    f"(pre-ledger artifact); never compared")
+            elif row.get("fallback"):
+                refused.append(
+                    f"{_rowid(row)}: refused — CPU-fallback "
+                    f"availability datum, not a perf measurement; "
+                    f"never compared")
+            elif not isinstance(row.get("value"), (int, float)):
+                refused.append(f"{_rowid(row)}: refused — no numeric "
+                               f"value; never compared")
+            else:
+                comparable.append(row)
+        for prev, cur in zip(comparable, comparable[1:]):
+            if prev["backend"] != cur["backend"]:
+                failures.append(
+                    f"lane {lane!r}: cross-backend "
+                    f"comparison refused — {_rowid(prev)} ran on "
+                    f"{prev['backend']}, {_rowid(cur)} on "
+                    f"{cur['backend']}; a trajectory must not change "
+                    f"backend mid-chain (the BENCH r04/r05 footgun)")
+                continue
+            delta = (cur["value"] - prev["value"]) / prev["value"]
+            line = (f"lane {lane!r} [{cur['backend']}]: "
+                    f"{_rowid(prev)} {_human(prev['value'])} -> "
+                    f"{_rowid(cur)} {_human(cur['value'])} "
+                    f"({delta * 100:+.1f}%)")
+            if delta < -band:
+                failures.append(
+                    f"{line} — regression beyond the {band:.0%} noise "
+                    f"band")
+            else:
+                report.append(line)
+    return failures, refused, report
+
+
+def table(rows: List[Dict]) -> str:
+    """The round-over-round trajectory table (the hand-maintained
+    PERF_NOTES format, machine-rendered).  Deltas only between
+    same-lane same-backend non-fallback neighbours — everything else
+    renders with the reason a delta is absent."""
+    lines = [f"{'row':>5} {'value':>10} {'backend':>8} {'delta':>8}  note"]
+    last_by_chain: Dict[Tuple, float] = {}
+    for row in sorted(rows, key=_sort_key):
+        rid = _rowid(row)
+        if row.get("value") is None:
+            lines.append(f"{rid:>5} {'—':>10} {'—':>8} {'—':>8}  "
+                         f"{row.get('note', 'no result')}")
+            continue
+        backend = row.get("backend", "unknown")
+        note = row.get("tag") or ""
+        delta = "—"
+        if row.get("fallback"):
+            note = (note + " " if note else "") + "[CPU fallback — " \
+                "availability datum, excluded from deltas]"
+        elif backend == "unknown":
+            note = (note + " " if note else "") + "[backend unknown — " \
+                "excluded from deltas]"
+        else:
+            chain = (row.get("lane"), backend)
+            prev = last_by_chain.get(chain)
+            if prev:
+                delta = f"{100 * (row['value'] - prev) / prev:+.1f}%"
+            last_by_chain[chain] = row["value"]
+        lines.append(f"{rid:>5} {_human(row['value']):>10} "
+                     f"{backend:>8} {delta:>8}  {note}".rstrip())
+    return "\n".join(lines)
+
+
+def _rowid(row: Dict) -> str:
+    if row.get("round") is not None:
+        return f"r{row['round']:02d}"
+    ts = row.get("ts")
+    return f"@{ts:.0f}" if ts else "@?"
+
+
+def _human(value: float) -> str:
+    for cut, suffix in ((1e12, "T"), (1e9, "B"), (1e6, "M"), (1e3, "k")):
+        if abs(value) >= cut:
+            return f"{value / cut:.2f}{suffix}"
+    return f"{value:.1f}"
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--ledger", type=str, default=None,
+                        help="ledger file (default: "
+                             "$GO_AVALANCHE_TPU_LEDGER or "
+                             "benchmarks/ledger.jsonl)")
+    parser.add_argument("--gate", action="store_true",
+                        help="regression gate: exit 1 on a same-lane "
+                             "regression beyond the noise band or a "
+                             "cross-backend chain; refused rows are "
+                             "listed, never compared")
+    parser.add_argument("--band", type=float, default=DEFAULT_BAND,
+                        help=f"noise band as a fraction "
+                             f"(default {DEFAULT_BAND})")
+    parser.add_argument("--table", action="store_true",
+                        help="render the round-over-round trajectory")
+    parser.add_argument("--import", dest="import_paths", nargs="+",
+                        metavar="BENCH_rN.json", default=None,
+                        help="backfill archived driver rounds into the "
+                             "ledger, then run the other modes")
+    args = parser.parse_args()
+    if not (args.gate or args.table or args.import_paths):
+        parser.error("nothing to do: pass --gate, --table and/or "
+                     "--import")
+
+    path = Path(args.ledger) if args.ledger else ledger_path()
+    if args.import_paths:
+        # Idempotent: a round already imported from the same artifact
+        # is skipped, so re-running the docstring's one-liner can never
+        # duplicate the committed trajectory.
+        have = {(r.get("round"), r.get("source")) for r in load(path)}
+        imported = skipped = 0
+        for row in import_bench(args.import_paths):
+            if (row.get("round"), row.get("source")) in have:
+                skipped += 1
+                continue
+            append(row, path)
+            imported += 1
+        print(f"imported {imported} round(s) into {path}"
+              + (f" ({skipped} already present, skipped)" if skipped
+                 else ""))
+
+    rows = load(path)
+    if args.table:
+        print(table(rows))
+    if args.gate:
+        failures, refused, report = gate(rows, band=args.band)
+        for line in report:
+            print(f"ok: {line}")
+        for line in refused:
+            print(f"refused: {line}")
+        if failures:
+            print("LEDGER GATE FAILURES:\n  " + "\n  ".join(failures),
+                  file=sys.stderr)
+            sys.exit(1)
+        print(f"gate ok: {len(report)} comparison(s) within the "
+              f"{args.band:.0%} band, {len(refused)} row(s) refused "
+              f"from comparison")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,327 @@
+"""Machine-checked MEMORY pins of the hot-path programs.
+
+`benchmarks/hlo_pin.py` pins what each timed program COMPUTES; this
+archive pins what it ALLOCATES: `compiled.memory_analysis()` (argument
+/ output / temp / generated-code / aliased bytes plus the
+donation-adjusted live peak) for every program in the hlo-pin registry
+AND the five sharded drivers' audit-mesh programs, next to the ANALYTIC
+per-plane footprint model (`obs/resources.py` — state pytree bytes from
+config shapes, per-device for the sharded entries).
+
+Why both sides: the compiled record alone says how much; the analytic
+model says how much it SHOULD be.  `--update` asserts they agree before
+archiving (`resources.check_memory`) — a mismatch means an unaccounted
+buffer clone (an undonated copy, a silently un-donated plane), the
+exact class the PR-4 fori-loop work chased by hand — and the tier-1
+check (`tests/test_bench.py`) recomputes a subset each run with
+tolerance bands: argument/output/alias bytes are shape arithmetic and
+must match EXACTLY; temp/generated-code bytes are compiler decisions
+and may drift within the band before the pin is declared moved.
+
+Each platform record carries the `hlo` hash of the lowering it was
+harvested from, so a program change that re-pins `hlo_pin.json` is
+forced to re-pin its memory record too (the coupling is tier-1
+checked, no compile needed).
+
+    python benchmarks/mem_pin.py                  # check all pins
+    python benchmarks/mem_pin.py --list           # show pinned programs
+    python benchmarks/mem_pin.py --stale          # metadata-only rot check
+    python benchmarks/mem_pin.py --update         # re-pin all programs
+    python benchmarks/mem_pin.py --update flagship sharded_avalanche
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+ARCHIVE = Path(__file__).with_name("mem_pin.json")
+SHARDED_PREFIX = "sharded_"
+
+# Comparison band for the compiler-owned record fields (temp /
+# generated code); the interface fields (argument / output / alias)
+# always compare exactly.  One spelling — the tier-1 test imports it.
+TEMP_BAND = 0.10
+
+
+def pinned_names() -> list:
+    from benchmarks import hlo_pin
+
+    return sorted(hlo_pin.PROGRAMS)
+
+
+def sharded_names() -> list:
+    from go_avalanche_tpu.analysis import hlo_audit
+
+    return [SHARDED_PREFIX + d for d in hlo_audit.SHARDED_DRIVERS]
+
+
+def all_names() -> list:
+    return pinned_names() + sharded_names()
+
+
+def expectations(name: str):
+    """``(donated, extra_output_ok)`` for `resources.check_memory`:
+    every pinned bench program donates its state and returns exactly
+    the evolved state; `streaming_step` is the one undonated pin (a
+    bare one-step jit); the sharded scan/settle programs donate and
+    return stacked telemetry NEXT TO the state."""
+    if name.startswith(SHARDED_PREFIX):
+        return True, True
+    return name != "streaming_step", False
+
+
+def harvest(name: str, workload=None) -> dict:
+    """``{"record", "footprint", "hlo"}`` for one program: compile it
+    (pin workload for hlo-pin programs, the 2x2 audit mesh for
+    `sharded_*`), read `memory_analysis()`, and run the analytic
+    footprint model over the same abstract state."""
+    from benchmarks import hlo_pin
+    from go_avalanche_tpu.obs import resources
+
+    if name.startswith(SHARDED_PREFIX):
+        driver = name[len(SHARDED_PREFIX):]
+        return resources.sharded_driver_records([driver])[driver]
+
+    from go_avalanche_tpu.analysis import hlo_audit
+
+    workload = dict(workload or hlo_pin.PROGRAMS[name][0])
+    lowered, state_abs = hlo_audit.lower_pinned(name, workload)
+    return {
+        "record": resources.memory_record(lowered.compile()),
+        "footprint": resources.footprint(state_abs),
+        "hlo": hlo_pin.hlo_hash(lowered.as_text()),
+    }
+
+
+def check_one(name: str, entry: dict, platform: str) -> list:
+    """Re-harvest one archived program and compare against its pin:
+    banded record comparison, exact analytic-footprint equality, and
+    the analytic-vs-compiled clone check.  Returns failure strings."""
+    from go_avalanche_tpu.obs import resources
+
+    archived = entry.get("records", {}).get(platform)
+    if archived is None:
+        return []
+    current = harvest(name, entry.get("workload")
+                      if not name.startswith(SHARDED_PREFIX) else None)
+    failures = resources.banded_compare(archived, current["record"],
+                                        band=TEMP_BAND, what=name)
+    pinned_fp = entry.get("footprint", {})
+    if pinned_fp.get("total_bytes") != current["footprint"]["total_bytes"]:
+        failures.append(
+            f"{name}: analytic footprint moved "
+            f"{pinned_fp.get('total_bytes')} -> "
+            f"{current['footprint']['total_bytes']} bytes — the state "
+            f"pytree changed shape (re-pin with --update if intended)")
+    donated, extra_out = expectations(name)
+    failures += resources.check_memory(
+        current["record"], current["footprint"]["total_bytes"],
+        donated=donated, extra_output_ok=extra_out, what=name)
+    archived_hlo = entry.get("hlo", {}).get(platform)
+    if archived_hlo is not None and archived_hlo != current["hlo"]:
+        failures.append(
+            f"{name}: the program moved under its memory pin (hlo "
+            f"{archived_hlo[:12]}... -> {current['hlo'][:12]}...) — "
+            f"re-pin memory with --update alongside the hlo_pin update")
+    return failures
+
+
+def stale_pins(archive: dict) -> list:
+    """Archived memory pins whose harvest path no longer exists —
+    programs unknown to `hlo_pin.PROGRAMS` / drivers unknown to
+    `hlo_audit.SHARDED_DRIVERS`, or pinned workload builders that were
+    renamed away (delegates to `hlo_pin.PROGRAM_BUILDERS`).  Pure
+    metadata, no jax import — gate-cheap like `hlo_pin.py --stale`."""
+    from benchmarks import hlo_pin, workload as wl
+    from go_avalanche_tpu.analysis import hlo_audit
+
+    stale = []
+    for name in sorted(archive.get("programs", {})):
+        if name.startswith(SHARDED_PREFIX):
+            driver = name[len(SHARDED_PREFIX):]
+            if driver not in hlo_audit.SHARDED_DRIVERS:
+                stale.append(f"{name}: archived but {driver!r} is not a "
+                             f"sharded driver (hlo_audit.SHARDED_DRIVERS)"
+                             f" — the memory pin can no longer harvest")
+            continue
+        if name not in hlo_pin.PROGRAMS:
+            stale.append(f"{name}: archived but unknown to "
+                         f"hlo_pin.PROGRAMS (builder removed?)")
+            continue
+        for builder in hlo_pin.PROGRAM_BUILDERS.get(name, ()):
+            if not hasattr(wl, builder):
+                stale.append(
+                    f"{name}: workload builder {builder!r} no longer "
+                    f"exists in benchmarks/workload.py — the memory pin "
+                    f"can no longer harvest")
+    return stale
+
+
+def _load_archive() -> dict:
+    if not ARCHIVE.exists():
+        return {"schema": 1, "programs": {}}
+    return json.loads(ARCHIVE.read_text())
+
+
+def _ensure_devices() -> None:
+    """The sharded entries need the 2x2 audit mesh; mirror
+    tests/conftest.py's virtual 8-device CPU setup (forced after the
+    jax import — see the conftest NOTE about the axon plugin)."""
+    if os.environ.get("GO_AVALANCHE_TPU_ANALYSIS_HW"):
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--update", nargs="*", metavar="PROGRAM",
+                        default=None,
+                        help="re-pin: harvest + archive the current "
+                             "platform's memory records (asserting the "
+                             "analytic model first).  With names, only "
+                             "those programs; bare --update re-pins "
+                             "everything")
+    parser.add_argument("--list", action="store_true",
+                        help="list archived programs and their records")
+    parser.add_argument("--stale", action="store_true",
+                        help="flag archived memory pins whose harvest "
+                             "path no longer exists (metadata-only, "
+                             "gate-cheap; composes with --list)")
+    args = parser.parse_args()
+    if args.stale and args.update is not None:
+        parser.error("--stale composes with --list only; run --update "
+                     "as its own invocation")
+
+    archive = _load_archive()
+
+    if args.list:
+        stale = set()
+        if args.stale:
+            stale = {s.split(":", 1)[0] for s in stale_pins(archive)}
+        for name, entry in sorted(archive.get("programs", {}).items()):
+            rot = "  [STALE]" if name in stale else ""
+            total = entry.get("footprint", {}).get("total_bytes")
+            print(f"{name}{rot}  (analytic {total} B)")
+            for platform, rec in sorted(entry.get("records", {}).items()):
+                print(f"  {platform}: arg {rec['argument_bytes']} "
+                      f"temp {rec['temp_bytes']} "
+                      f"alias {rec['alias_bytes']} "
+                      f"live-peak {rec['live_peak_bytes']}")
+        if args.stale and stale:
+            sys.exit(1)
+        return
+
+    if args.stale:
+        stale = stale_pins(archive)
+        if stale:
+            print("STALE MEMORY PINS:\n  " + "\n  ".join(stale),
+                  file=sys.stderr)
+            sys.exit(1)
+        print(f"ok: all {len(archive.get('programs', {}))} archived "
+              f"memory pins have live harvest paths")
+        return
+
+    _ensure_devices()
+    import jax
+
+    platform = jax.default_backend()
+
+    if args.update is not None:
+        names = args.update or all_names()
+        unknown = [n for n in names if n not in all_names()]
+        if unknown:
+            print(f"unknown program(s): {', '.join(unknown)}; known: "
+                  f"{', '.join(all_names())}", file=sys.stderr)
+            sys.exit(2)
+        for name in names:
+            current = harvest(name)
+            donated, extra_out = expectations(name)
+            failures = resources_check(current, name, donated, extra_out)
+            if failures:
+                print("REFUSING TO PIN (the analytic model disputes "
+                      "the program):\n  " + "\n  ".join(failures),
+                      file=sys.stderr)
+                sys.exit(1)
+            entry = archive.setdefault("programs", {}).setdefault(
+                name, {})
+            # OVERWRITE the workload, never setdefault: harvest() read
+            # the CURRENT hlo_pin workload, so a pin-shape change that
+            # kept the old dict here would leave the check path
+            # re-harvesting a shape the records were never taken at.
+            if not name.startswith(SHARDED_PREFIX):
+                from benchmarks import hlo_pin
+
+                entry["workload"] = dict(hlo_pin.PROGRAMS[name][0])
+            else:
+                entry["workload"] = {"driver": name[len(SHARDED_PREFIX):],
+                                     "mesh": "2x2", "variant": "base"}
+            entry["footprint"] = current["footprint"]
+            entry.setdefault("records", {})[platform] = current["record"]
+            entry.setdefault("hlo", {})[platform] = current["hlo"]
+            print(f"pinned {name} [{platform}]: arg "
+                  f"{current['record']['argument_bytes']} B, live-peak "
+                  f"{current['record']['live_peak_bytes']} B")
+        archive["schema"] = 1
+        archive["jax"] = jax.__version__
+        archive["live_peak_doc"] = _live_peak_doc()
+        ARCHIVE.write_text(json.dumps(archive, indent=2, sort_keys=True)
+                           + "\n")
+        return
+
+    failures = []
+    checked = 0
+    for name, entry in sorted(archive.get("programs", {}).items()):
+        if name not in all_names():
+            failures.append(f"{name}: archived but unknown to mem_pin.py")
+            continue
+        if entry.get("records", {}).get(platform) is None:
+            print(f"skip {name}: no {platform} record (run --update "
+                  f"{name} to create one)")
+            continue
+        fails = check_one(name, entry, platform)
+        checked += 1
+        if fails:
+            failures.extend(fails)
+        else:
+            print(f"ok: {name} [{platform}] matches its memory pin")
+    if failures:
+        print("MEMORY DRIFT:\n  " + "\n  ".join(failures)
+              + "\nIf intended, re-pin with: python benchmarks/"
+                "mem_pin.py --update", file=sys.stderr)
+        sys.exit(1)
+    if not checked:
+        print(f"no memory records for platform '{platform}' in "
+              f"{ARCHIVE.name}; run with --update to create them",
+              file=sys.stderr)
+        sys.exit(2)
+
+
+def resources_check(current: dict, name: str, donated: bool,
+                    extra_out: bool) -> list:
+    from go_avalanche_tpu.obs import resources
+
+    return resources.check_memory(
+        current["record"], current["footprint"]["total_bytes"],
+        donated=donated, extra_output_ok=extra_out, what=name)
+
+
+def _live_peak_doc() -> str:
+    from go_avalanche_tpu.obs.resources import LIVE_PEAK_DOC
+
+    return LIVE_PEAK_DOC
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,203 @@
+"""VMEM/HBM-knee predictor: largest safe `[F, N, T]` fleet shapes.
+
+The fleet-of-sharded-sims refactor (ROADMAP top item) lays the
+Monte-Carlo TRIAL axis out along a mesh axis — F whole sims of
+``[N, T]`` planes per device group.  Before a TPU window opens, the
+shapes have to come from somewhere better than guessing; this tool
+sweeps the ANALYTIC footprint model (`obs/resources.py` — exact state
+pytree bytes from config shapes, nothing allocates) over the
+``[F, N, T]`` cube and emits, per device profile, the largest N = T
+square whose per-device live peak fits the HBM budget.
+
+Model, per cube point (documented so a TPU window can falsify it):
+
+  * per-trial state bytes: `footprint(flagship_state(N, T))` — exact
+    (the fleet vmap stacks EVERY leaf on the trial axis, so a fleet
+    state is exactly F x per-trial; machine-checked against the
+    compiled `fleet_small` record in benchmarks/mem_pin.json);
+  * trials per device: ``ceil(F / devices)`` — the trial axis shards
+    across the profile's mesh (the fleet x mesh composition);
+  * live peak: per-device state x ``(1 + temp_ratio)``, donation
+    collapsing output into argument.  ``temp_ratio`` (XLA scratch per
+    state byte) is harvested from the archived `fleet_small` memory
+    record for the profile's platform when one exists, else the
+    profile's documented provisional default — the TPU window's
+    `mem_pin.py --update` re-pins it and this table re-derives;
+  * ``vmem_resident``: whether ONE trial's hot consensus planes
+    (votes u8 + consider u8 + confidence u16 + added bool = 5 B per
+    (node, tx) element) fit in half the profile's VMEM — below that
+    knee a whole sim's working set can stay VMEM-resident between
+    rounds, which is where the fleet's dispatch amortization pays
+    most (PERF_NOTES PR 7, roofline "gathers ride VMEM residency").
+
+    python benchmarks/vmem_knee.py                   # both profiles
+    python benchmarks/vmem_knee.py --profile v5e-8
+    python benchmarks/vmem_knee.py --update          # archive the JSON
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+OUT = Path(__file__).with_name("vmem_knee.json")
+MEM_PIN = Path(__file__).with_name("mem_pin.json")
+
+GIB = 1024 ** 3
+MIB = 1024 ** 2
+
+# Device profiles.  v5e numbers are the public chip constants (16 GiB
+# HBM2, 128 MiB VMEM per chip; 8 chips per v5e-8 host — the bench
+# target topology).  cpu-ci is the tier-1 container: one virtual
+# device, budgeted at 8 GiB so the CI table exercises the same code
+# path at shapes the container could actually hold.
+DEVICE_PROFILES = {
+    "v5e-8": {"platform": "tpu", "devices": 8, "hbm_bytes": 16 * GIB,
+              "vmem_bytes": 128 * MIB, "default_temp_ratio": 1.0},
+    "cpu-ci": {"platform": "cpu", "devices": 1, "hbm_bytes": 8 * GIB,
+               "vmem_bytes": None, "default_temp_ratio": 4.5},
+}
+
+HEADROOM = 0.90          # fraction of HBM the live peak may claim
+HOT_BYTES_PER_ELEM = 5   # votes u8 + consider u8 + confidence u16 + added
+FLEETS = (1, 8, 64, 256, 1024, 4096)
+SQUARES = tuple(2 ** p for p in range(6, 17))  # 64 .. 65536
+
+
+def per_trial_footprint(nt: int, k: int = 8) -> int:
+    """Exact state bytes of ONE flagship trial at N = T = nt
+    (`jax.eval_shape` — no allocation; ~ms per point)."""
+    import jax
+
+    from benchmarks.workload import flagship_state
+    from go_avalanche_tpu.obs import resources
+
+    state_abs = jax.eval_shape(lambda: flagship_state(nt, nt, k)[0])
+    return resources.footprint(state_abs)["total_bytes"]
+
+
+def temp_ratio_for(profile: dict) -> dict:
+    """``{"ratio": float, "source": str}`` — the XLA scratch-per-state
+    ratio: harvested from the archived `fleet_small` memory record for
+    this platform when one exists (temp / argument bytes), else the
+    profile's provisional default."""
+    try:
+        archive = json.loads(MEM_PIN.read_text())
+        rec = archive["programs"]["fleet_small"]["records"][
+            profile["platform"]]
+        return {"ratio": rec["temp_bytes"] / rec["argument_bytes"],
+                "source": f"mem_pin.json fleet_small "
+                          f"[{profile['platform']}]"}
+    except (OSError, KeyError, ValueError, ZeroDivisionError):
+        return {"ratio": profile["default_temp_ratio"],
+                "source": "profile default (PROVISIONAL — no "
+                          "mem_pin record for this platform yet; the "
+                          "hardware window's mem_pin.py --update "
+                          "re-derives this table)"}
+
+
+def knee_table(profile_name: str, fleets=FLEETS, squares=SQUARES,
+               k: int = 8) -> dict:
+    """The largest-safe-shape table for one device profile."""
+    profile = DEVICE_PROFILES[profile_name]
+    tr = temp_ratio_for(profile)
+    budget = profile["hbm_bytes"] * HEADROOM
+    per_trial = {nt: per_trial_footprint(nt, k) for nt in squares}
+
+    rows = []
+    for f in fleets:
+        trials_per_device = math.ceil(f / profile["devices"])
+        best = None
+        for nt in squares:
+            live_peak = (trials_per_device * per_trial[nt]
+                         * (1.0 + tr["ratio"]))
+            if live_peak <= budget:
+                best = (nt, live_peak)
+        if best is None:
+            rows.append({"fleet": f,
+                         "trials_per_device": trials_per_device,
+                         "largest_nt": None,
+                         "note": "no swept square fits"})
+            continue
+        nt, live_peak = best
+        hot = HOT_BYTES_PER_ELEM * nt * nt
+        row = {
+            "fleet": f,
+            "trials_per_device": trials_per_device,
+            "largest_nt": nt,
+            "per_trial_state_bytes": per_trial[nt],
+            "per_device_state_bytes": trials_per_device * per_trial[nt],
+            "modeled_live_peak_bytes": int(live_peak),
+            "trial_hot_plane_bytes": hot,
+        }
+        if profile["vmem_bytes"]:
+            row["vmem_resident"] = hot <= profile["vmem_bytes"] // 2
+        rows.append(row)
+    return {"profile": profile_name, **profile, "headroom": HEADROOM,
+            "temp_ratio": tr, "k": k, "rows": rows}
+
+
+def render(table: dict) -> str:
+    lines = [f"[{table['profile']}] {table['devices']} device(s), "
+             f"HBM {table['hbm_bytes'] / GIB:.0f} GiB x "
+             f"{table['headroom']:.0%} headroom, temp ratio "
+             f"{table['temp_ratio']['ratio']:.2f} "
+             f"({table['temp_ratio']['source']})",
+             f"{'F':>6} {'trials/dev':>10} {'largest N=T':>12} "
+             f"{'per-dev state':>14} {'live peak':>11} {'VMEM-res':>9}"]
+    for r in table["rows"]:
+        if r.get("largest_nt") is None:
+            lines.append(f"{r['fleet']:>6} "
+                         f"{r['trials_per_device']:>10} "
+                         f"{'—':>12}  {r['note']}")
+            continue
+        vmem = ("yes" if r.get("vmem_resident")
+                else "no" if "vmem_resident" in r else "n/a")
+        lines.append(
+            f"{r['fleet']:>6} {r['trials_per_device']:>10} "
+            f"{r['largest_nt']:>12} "
+            f"{r['per_device_state_bytes'] / GIB:>11.2f}GiB "
+            f"{r['modeled_live_peak_bytes'] / GIB:>8.2f}GiB "
+            f"{vmem:>9}")
+    return "\n".join(lines)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--profile", choices=sorted(DEVICE_PROFILES),
+                        default=None,
+                        help="one device profile (default: all)")
+    parser.add_argument("--update", action="store_true",
+                        help=f"write the swept tables to {OUT.name}")
+    parser.add_argument("--out", type=str, default=str(OUT),
+                        help="with --update: destination JSON")
+    args = parser.parse_args()
+
+    names = [args.profile] if args.profile else sorted(DEVICE_PROFILES)
+    tables = {name: knee_table(name) for name in names}
+    for name in names:
+        print(render(tables[name]))
+        print()
+    if args.update:
+        # Merge into the existing archive: a single-profile --update
+        # must not silently drop the other profile's table.
+        out_path = Path(args.out)
+        try:
+            payload = json.loads(out_path.read_text())
+        except (OSError, ValueError):
+            payload = {}
+        payload.update({"schema": 1, "headroom": HEADROOM,
+                        "hot_bytes_per_elem": HOT_BYTES_PER_ELEM})
+        payload.setdefault("tables", {}).update(tables)
+        out_path.write_text(json.dumps(payload, indent=2,
+                                       sort_keys=True) + "\n")
+        print(f"wrote {out_path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -72,6 +72,12 @@ def run_audit(compile_donation: bool = True) -> list:
     if compile_donation:
         for name in DONATION_COMPILE_PROGRAMS:
             failures += hlo_audit.audit_donation_compiled(name)
+        # The resource plane's byte-level twin (obs/resources.py): the
+        # analytic state footprint must account for each lane's
+        # compiled memory_analysis() — argument/output/alias bytes, not
+        # just the alias-table leaf count.
+        for name in hlo_audit.MEMORY_BUDGET_PROGRAMS:
+            failures += hlo_audit.audit_memory_budget(name)
     return failures
 
 

@@ -431,6 +431,8 @@ PINNED_UNDONATED = frozenset({"streaming_step"})
 _SMALL_DIMS = dict(nodes=64, txs=64, rounds=2)
 _SMALL_FLEET = dict(fleet=4, nodes=32, txs=32, rounds=2)
 _SMALL_TRAFFIC = dict(nodes=64, txs=256, window=64, rounds=4, rate=4.0)
+_SMALL_STREAMING = dict(nodes=64, backlog_sets=256, set_cap=2,
+                        window_sets=32)
 
 
 def small_workload(name: str) -> Dict:
@@ -444,6 +446,8 @@ def small_workload(name: str) -> Dict:
         workload.update(_SMALL_FLEET)
     elif name == "flagship_traffic":
         workload.update(_SMALL_TRAFFIC)
+    elif name == "streaming_step":
+        workload.update(_SMALL_STREAMING)
     else:
         workload.update(_SMALL_DIMS)
     return workload
@@ -535,10 +539,47 @@ def audit_donation_compiled(name: str) -> List[str]:
     return failures
 
 
+# The memory-budget acceptance set (the resource plane,
+# obs/resources.py): one program per timed lane whose analytic state
+# footprint must account for the compiled buffer interface.  The five
+# sharded drivers get the same check per-device through
+# `obs.resources.sharded_driver_records` (benchmarks/mem_pin.py).
+MEMORY_BUDGET_PROGRAMS = ("flagship", "fleet_small", "flagship_traffic",
+                          "streaming_step")
+
+
+def audit_memory_budget(name: str) -> List[str]:
+    """Compile the pinned program at audit shape and assert the
+    ANALYTIC footprint model (`obs.resources.footprint` — state pytree
+    bytes from config shapes) accounts for the compiled
+    `memory_analysis()` numbers: argument == state, output == state,
+    and (donated programs) aliased bytes covering the state.  The
+    byte-level twin of the `input_output_alias` leaf count above — an
+    undonated COPY of one plane passes the leaf count (every leaf still
+    aliased) but shows up here as surplus output or short alias."""
+    from go_avalanche_tpu.obs import resources
+
+    workload = small_workload(name)
+    lowered, state_abs = lower_pinned(name, workload)
+    record = resources.memory_record(lowered.compile())
+    analytic = resources.footprint(state_abs)["total_bytes"]
+    return resources.check_memory(
+        record, analytic, donated=name not in PINNED_UNDONATED,
+        rel_tol=0.02, abs_tol=2048, what=f"{name}@audit-shape")
+
+
 def _compile_pinned(name: str, workload: Dict) -> str:
     """Optimized-HLO text of the pinned program compiled at `workload`
-    shape (mirrors the lowering spelling in benchmarks/hlo_pin.py, but
-    keeps the Lowered object so `.compile()` is available)."""
+    shape (see `lower_pinned`)."""
+    return lower_pinned(name, workload)[0].compile().as_text()
+
+
+def lower_pinned(name: str, workload: Dict):
+    """``(Lowered, abstract state)`` of a pinned program at `workload`
+    shape — mirrors the lowering spelling in benchmarks/hlo_pin.py but
+    keeps the Lowered object so ``.compile()`` (the donation proof, the
+    resource plane's `memory_analysis`) and the state (the analytic
+    footprint model) are both available from ONE lowering."""
     import dataclasses as _dc
 
     import jax
@@ -548,10 +589,25 @@ def _compile_pinned(name: str, workload: Dict) -> str:
         flagship_config,
         flagship_state,
         fleet_flagship_state,
+        northstar_config,
+        northstar_state,
         traffic_backlog_state,
         traffic_config,
     )
 
+    if name == "streaming_step":
+        from go_avalanche_tpu.models import streaming_dag as sdg
+
+        cfg = northstar_config(workload["window_sets"],
+                               workload["set_cap"])
+        state_abs = jax.eval_shape(lambda: northstar_state(
+            nodes=workload["nodes"],
+            backlog_sets=workload["backlog_sets"],
+            set_cap=workload["set_cap"],
+            window_sets=workload["window_sets"],
+            track_finality=False)[0])
+        return (jax.jit(lambda s: sdg.step(s, cfg)[0]).lower(state_abs),
+                state_abs)
     if name == "fleet_small":
         cfg = flagship_config(workload["txs"], workload["k"])
         state_abs = jax.eval_shape(lambda: fleet_flagship_state(
@@ -559,6 +615,7 @@ def _compile_pinned(name: str, workload: Dict) -> str:
             workload["k"])[0])
         lowered = bench.fleet_program(cfg, workload["rounds"],
                                       workload["fleet"]).lower(state_abs)
+        return lowered, state_abs
     elif name == "flagship_traffic":
         cfg = traffic_config(workload["window"], workload["k"],
                              workload["rate"])
@@ -567,6 +624,7 @@ def _compile_pinned(name: str, workload: Dict) -> str:
             workload["k"], workload["rate"])[0])
         lowered = bench.traffic_program(cfg,
                                         workload["rounds"]).lower(state_abs)
+        return lowered, state_abs
     else:
         cfg = flagship_config(workload["txs"], workload["k"],
                               workload.get("latency", 0),
@@ -596,7 +654,7 @@ def _compile_pinned(name: str, workload: Dict) -> str:
             trace_rounds=workload["rounds"])[0])
         lowered = bench.flagship_program(cfg,
                                          workload["rounds"]).lower(state_abs)
-    return lowered.compile().as_text()
+    return lowered, state_abs
 
 
 def audit_off_path(platform: str, archive: Optional[Dict] = None

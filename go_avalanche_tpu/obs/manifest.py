@@ -83,6 +83,13 @@ def manifest_dict(cfg=None, extra: Optional[dict] = None) -> dict:
     manifest = {
         "jax": jax.__version__,
         "jaxlib": getattr(jax, "jaxlib_version", None) or _jaxlib_version(),
+        # `backend` duplicates devices.platform ON PURPOSE: it is the
+        # perf ledger's comparison key (benchmarks/ledger.py), and a
+        # consumer must never have to dig through the topology dict —
+        # or worse, the metric string — to learn it.  Manifests
+        # predating the field read as backend="unknown" and are
+        # gate-excluded, never silently compared.
+        "backend": topology["platform"] if topology else "unknown",
         "devices": topology,
         "git_sha": _git_sha(),
         "hlo_pins": _pin_hashes(),

@@ -1,0 +1,215 @@
+"""Resource-observability plane: analytic footprints vs compiled memory.
+
+The flight recorder (sink/trace/manifest) sees every EVENT; this module
+is the first plane that sees RESOURCES.  Three pieces:
+
+  * `footprint` — the ANALYTIC per-plane footprint model: byte counts
+    for every leaf of a sim-state pytree, derived purely from config
+    shapes (`jax.eval_shape` — nothing allocates, full bench shape
+    costs milliseconds on any host).  With a `PartitionSpec` tree and a
+    mesh it accounts PER-DEVICE bytes (sharded planes divide by their
+    mesh axes, replicated planes count whole) — the same arithmetic
+    the XLA allocator does for a `shard_map` program.
+  * `memory_record` — the COMPILED side: `compiled.memory_analysis()`
+    (argument / output / temp / generated-code / aliased bytes) plus
+    the donation-adjusted live peak.
+  * `check_memory` — the assertion joining the two: the compiled
+    argument bytes must equal the analytic state bytes, and for a
+    donated program the aliased bytes must COVER the state.  A failure
+    means an unaccounted buffer clone — an undonated copy, a plane
+    XLA silently double-buffers, a leaked intermediate — exactly the
+    class the PR-4 fori-loop work chased by hand through HLO dumps.
+
+`benchmarks/mem_pin.py` archives `memory_record` for every pinned
+program + the five sharded drivers (`benchmarks/mem_pin.json`);
+`benchmarks/vmem_knee.py` sweeps `footprint` over the `[F, N, T]` cube;
+`run_sim --report-memory` prints both for the exact program a flag
+selection runs.  The plane only READS programs — no archived HLO pin
+moves.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+LIVE_PEAK_DOC = ("argument + output - aliased + temp bytes: what the "
+                 "allocator must hold at the program's high-water mark "
+                 "once donation collapses each aliased output into its "
+                 "argument buffer")
+
+
+def plane_bytes(state_abs, specs=None, mesh=None) -> Dict[str, int]:
+    """Per-plane byte counts for a (possibly abstract) state pytree.
+
+    Keys are `jax.tree_util.keystr` paths (the spelling the trace
+    plane's column manifest and the watchdog reports already use).
+    With `specs` (a `PartitionSpec` pytree matching `state_abs`, e.g. a
+    driver's `state_specs(...)`) and `mesh`, each leaf is counted at
+    its PER-DEVICE shard shape — sharded dims divide by their mesh
+    axes, replicated leaves count whole, exactly as placed.
+    """
+    import jax
+    from jax.tree_util import keystr, tree_flatten_with_path
+
+    shardings = None
+    if specs is not None:
+        if mesh is None:
+            raise ValueError("plane_bytes: specs without a mesh — "
+                             "per-device accounting needs axis sizes")
+        from jax.sharding import NamedSharding
+
+        shardings = [
+            NamedSharding(mesh, s)
+            for _, s in tree_flatten_with_path(
+                specs, is_leaf=lambda x: x is None)[0]
+            if s is not None]
+
+    out: Dict[str, int] = {}
+    leaves = tree_flatten_with_path(state_abs)[0]
+    if shardings is not None and len(shardings) != len(leaves):
+        raise ValueError(
+            f"plane_bytes: {len(shardings)} partition specs for "
+            f"{len(leaves)} state leaves — the spec tree does not "
+            f"match the state")
+    for i, (path, leaf) in enumerate(leaves):
+        shape = tuple(leaf.shape)
+        if shardings is not None:
+            shape = shardings[i].shard_shape(shape)
+        n = 1
+        for d in shape:
+            n *= d
+        out[keystr(path)] = int(n) * int(
+            jax.dtypes.canonicalize_dtype(leaf.dtype).itemsize
+            if not hasattr(leaf.dtype, "itemsize") else leaf.dtype.itemsize)
+    return out
+
+
+def footprint(state_abs, specs=None, mesh=None) -> Dict:
+    """``{"total_bytes": N, "planes": {path: bytes}}`` for a state
+    pytree — the analytic footprint model (see `plane_bytes`)."""
+    planes = plane_bytes(state_abs, specs, mesh)
+    return {"total_bytes": sum(planes.values()), "planes": planes}
+
+
+def memory_record(compiled) -> Dict[str, int]:
+    """The compiled program's memory ledger, from
+    ``compiled.memory_analysis()`` (an XLA `CompiledMemoryStats`).
+
+    ``live_peak_bytes`` is the donation-adjusted high-water mark:
+    argument + output - aliased + temp (aliased output buffers ARE
+    their argument buffers at runtime, so they count once).
+    """
+    ma = compiled.memory_analysis()
+    rec = {
+        "argument_bytes": int(ma.argument_size_in_bytes),
+        "output_bytes": int(ma.output_size_in_bytes),
+        "temp_bytes": int(ma.temp_size_in_bytes),
+        "alias_bytes": int(ma.alias_size_in_bytes),
+        "generated_code_bytes": int(ma.generated_code_size_in_bytes),
+    }
+    rec["live_peak_bytes"] = (rec["argument_bytes"] + rec["output_bytes"]
+                              - rec["alias_bytes"] + rec["temp_bytes"])
+    return rec
+
+
+def check_memory(record: Dict[str, int], analytic_total: int, *,
+                 donated: bool = True, extra_output_ok: bool = False,
+                 rel_tol: float = 0.02, abs_tol: int = 4096,
+                 what: str = "program") -> List[str]:
+    """Assert a compiled `memory_record` against the analytic footprint.
+
+    * the ARGUMENT bytes must match `analytic_total` within tolerance —
+      a surplus means the program takes buffers the state model does
+      not account for, a deficit means a state plane never reached the
+      device;
+    * the OUTPUT bytes must match too (`extra_output_ok=True` relaxes
+      to >=, for scan programs that return stacked telemetry next to
+      the evolved state);
+    * with `donated=True`, the ALIASED bytes must COVER the state: an
+      undonated copy (jit without donate_argnums, a plane silently
+      un-donated by a dtype/layout mismatch, an explicit clone) leaves
+      alias short of argument and fails loudly.
+
+    Returns failure strings (empty = clean).  Tolerance is
+    ``max(rel_tol * analytic_total, abs_tol)`` — XLA may pad tiny
+    bookkeeping buffers (tuple tables, predicates) that are real but
+    not planes.
+    """
+    tol = max(int(rel_tol * analytic_total), abs_tol)
+    failures: List[str] = []
+    arg = record["argument_bytes"]
+    out = record["output_bytes"]
+    alias = record["alias_bytes"]
+    if abs(arg - analytic_total) > tol:
+        failures.append(
+            f"{what}: compiled argument bytes {arg} != analytic state "
+            f"footprint {analytic_total} (tol {tol}) — "
+            f"{'an unaccounted input buffer rides the program' if arg > analytic_total else 'a state plane never reached the entry signature'}")
+    if extra_output_ok:
+        if out + tol < analytic_total:
+            failures.append(
+                f"{what}: compiled output bytes {out} < analytic state "
+                f"footprint {analytic_total} (tol {tol}) — the evolved "
+                f"state is not among the outputs")
+    elif abs(out - analytic_total) > tol:
+        failures.append(
+            f"{what}: compiled output bytes {out} != analytic state "
+            f"footprint {analytic_total} (tol {tol}) — "
+            f"{'an unaccounted buffer clone is returned next to the state' if out > analytic_total else 'a state plane is missing from the outputs'}")
+    if donated and alias + tol < analytic_total:
+        failures.append(
+            f"{what}: aliased bytes {alias} do not cover the analytic "
+            f"state footprint {analytic_total} (tol {tol}) — "
+            f"{analytic_total - alias} bytes of state double-buffer "
+            f"instead of updating in place (an undonated copy)")
+    return failures
+
+
+def banded_compare(archived: Dict[str, int], current: Dict[str, int],
+                   band: float = 0.10, what: str = "program"
+                   ) -> List[str]:
+    """Tolerance-banded comparison of two memory records (the mem-pin
+    tier-1 check).  Argument/output/alias bytes are shape arithmetic
+    and must match EXACTLY; temp and generated-code bytes are compiler
+    decisions and may drift within `band` (fractional) before the pin
+    is declared moved."""
+    failures: List[str] = []
+    for key in ("argument_bytes", "output_bytes", "alias_bytes"):
+        if archived.get(key) != current.get(key):
+            failures.append(
+                f"{what}: {key} moved {archived.get(key)} -> "
+                f"{current.get(key)} — the program's buffer interface "
+                f"changed (re-pin with --update if intended)")
+    for key in ("temp_bytes", "generated_code_bytes"):
+        a, c = archived.get(key, 0), current.get(key, 0)
+        lo = min(a, c)
+        if abs(a - c) > max(band * max(a, 1), 64):
+            failures.append(
+                f"{what}: {key} drifted {a} -> {c} "
+                f"({100.0 * abs(a - c) / max(lo, 1):.1f}% > "
+                f"{100 * band:.0f}% band) — the compiler's scratch "
+                f"plan changed (re-pin with --update if intended)")
+    return failures
+
+
+def sharded_driver_records(drivers: Optional[List[str]] = None) -> Dict:
+    """`memory_record` + analytic per-device footprint for each sharded
+    driver's base audit-shape program on the 2x2 audit mesh
+    (`parallel.footprint_cases` — the same states and program seams the
+    contract auditor lowers).  Returns ``{driver: {"record": ...,
+    "footprint": ..., "hlo": sha256}}``; raises
+    `analysis.hlo_audit.AuditUnavailable` under 4 devices.
+    """
+    from benchmarks.hlo_pin import hlo_hash
+    from go_avalanche_tpu import parallel
+
+    out: Dict[str, Dict] = {}
+    for name, case in parallel.footprint_cases(drivers).items():
+        lowered = case.program_builder(case.mesh).lower(case.state_abs)
+        out[name] = {
+            "record": memory_record(lowered.compile()),
+            "footprint": footprint(case.state_abs, case.specs,
+                                   case.mesh),
+            "hlo": hlo_hash(lowered.as_text()),
+        }
+    return out

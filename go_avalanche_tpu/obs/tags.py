@@ -64,6 +64,24 @@ from __future__ import annotations
 
 from go_avalanche_tpu.config import AvalancheConfig
 
+# The canonical phase-span names (`utils/tracing.annotate` REJECTS any
+# other spelling).  One registry so every per-phase surface joins on
+# the same keys: the eager wall timers (`bench.py --profile`,
+# `tracing.collect_phase_times`), the device-time xplane harvest
+# (`tracing.device_phase_times` — HLO `op_name` metadata carries these
+# as named-scope path segments), and the profiler timeline itself.
+# The strings are FROZEN: they are embedded in archived profile
+# artifacts and in the HLO metadata of every pinned program — renaming
+# one silently orphans both (and moving a pin's hash is the loud
+# version of the same mistake).
+PHASE_SPANS = (
+    "poll_mask",          # capped per-(node, tx) pollable mask
+    "sample_peers",       # committee peer draw (uniform/stake/hier)
+    "gossip_admission",   # gossip scatter-max admission (gossip on)
+    "gather_prefs",       # peer-preference gathers (exchange engines)
+    "ingest_votes",       # RegisterVotes window ingest (u8/swar32)
+)
+
 
 def default_timeout_rounds(latency_rounds: int) -> int:
     """The bench lane's derived timeout default: 2 * latency + 2 rounds

@@ -1,1 +1,94 @@
-"""Mesh sharding of the simulators (ICI/DCN scale-out)."""
+"""Mesh sharding of the simulators (ICI/DCN scale-out).
+
+Also home of the per-driver FOOTPRINT registry (`footprint_cases`):
+for each of the five sharded drivers, the abstract audit-shape state,
+its `PartitionSpec` tree, and the exact scan/settle program seam the
+contract auditor lowers — everything the resource plane
+(`obs/resources.py`, `benchmarks/mem_pin.py`) needs to compare a
+driver's compiled `memory_analysis()` against the analytic per-device
+footprint model, without re-deriving either per call site.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class FootprintCase:
+    """One sharded driver's resource-accounting case: lower
+    ``program_builder(mesh)`` over ``state_abs`` for the compiled side;
+    feed ``(state_abs, specs, mesh)`` to `obs.resources.footprint` for
+    the analytic per-device side."""
+
+    driver: str
+    mesh: object
+    state_abs: object
+    specs: object
+    program_builder: object  # mesh -> jitted donated program
+
+
+def _specs_for(driver: str, state):
+    """The driver's `state_specs` tree for exactly this state variant —
+    optional planes (finalized_at / inflight / fault_params / trace)
+    mirrored from the state so both trees unflatten identically."""
+    from go_avalanche_tpu.obs import trace as obs_trace
+
+    def _sim_flags(sim):
+        return (sim.finalized_at is not None, sim.inflight is not None,
+                sim.fault_params is not None,
+                obs_trace.replicated_spec(sim.trace))
+
+    if driver == "avalanche":
+        from go_avalanche_tpu.parallel import sharded
+
+        return sharded.state_specs(*_sim_flags(state))
+    if driver == "dag":
+        from go_avalanche_tpu.parallel import sharded_dag
+
+        track, infl, fault, trace_spec = _sim_flags(state.base)
+        return sharded_dag.dag_state_specs(
+            state.n_sets, state.set_size, track, infl, fault, trace_spec)
+    if driver == "backlog":
+        from go_avalanche_tpu.parallel import sharded_backlog
+
+        track, infl, fault, trace_spec = _sim_flags(state.sim)
+        return sharded_backlog.backlog_state_specs(
+            track, infl, fault, state.traffic is not None, trace_spec)
+    if driver == "streaming_dag":
+        from go_avalanche_tpu.parallel import sharded_streaming_dag
+
+        track, infl, fault, trace_spec = _sim_flags(state.dag.base)
+        return sharded_streaming_dag.streaming_dag_state_specs(
+            state.dag.n_sets, state.dag.set_size, track, infl, fault,
+            state.traffic is not None, trace_spec)
+    if driver == "node_stream":
+        from go_avalanche_tpu.parallel import sharded_node_stream
+
+        track, infl, fault, trace_spec = _sim_flags(state.sim)
+        return sharded_node_stream.node_stream_state_specs(
+            track, infl, fault, trace_spec)
+    raise ValueError(f"unknown sharded driver {driver!r}")
+
+
+def footprint_cases(drivers: Optional[Sequence[str]] = None
+                    ) -> Dict[str, FootprintCase]:
+    """The five sharded drivers' footprint entries on the 2x2 audit
+    mesh, base variant each — states and program builders come from the
+    contract auditor's case table (`analysis.hlo_audit._sharded_case`),
+    so the resource plane accounts THE audited programs, never a
+    reconstruction.  Raises `hlo_audit.AuditUnavailable` under 4
+    devices (run under the tier-1 harness or on hardware)."""
+    from go_avalanche_tpu.analysis import hlo_audit
+
+    mesh = hlo_audit._audit_mesh()
+    out: Dict[str, FootprintCase] = {}
+    for driver in (drivers or hlo_audit.SHARDED_DRIVERS):
+        variants, _, _ = hlo_audit._sharded_case(driver)
+        _, builder, state_abs = variants[0]  # the base variant
+        out[driver] = FootprintCase(
+            driver=driver, mesh=mesh, state_abs=state_abs,
+            specs=_specs_for(driver, state_abs),
+            program_builder=builder)
+    return out

@@ -561,6 +561,60 @@ def run_fleet_mode(args, cfg: AvalancheConfig) -> Dict:
     return row
 
 
+def _report_memory(args, cfg) -> None:
+    """`--report-memory`: compile the exact program the parsed flags
+    select (the `--audit` program seams, analysis/hlo_audit.py) and
+    print its compiled memory ledger + the analytic per-plane state
+    footprint (obs/resources.py) to stderr.  Reporting only — the
+    assertions live in `benchmarks/mem_pin.py` and the contract
+    auditor's memory budget; stdout keeps the one-result contract."""
+    from go_avalanche_tpu.analysis import hlo_audit
+    from go_avalanche_tpu.obs import resources
+
+    specs = mesh = state_abs = None
+    if args.fleet is not None:
+        from go_avalanche_tpu import fleet as fl
+
+        keys_abs = jax.eval_shape(
+            lambda: jax.random.split(jax.random.key(args.seed),
+                                     args.fleet))
+        jitted = fl._compiled_fleet(
+            args.model, cfg, int(args.nodes), int(args.txs),
+            int(args.max_rounds), int(args.conflict_size),
+            float(args.yes_fraction), bool(args.contested),
+            int(args.slots))
+        compiled = jitted.lower(keys_abs).compile()
+        scope = (f"fleet{args.fleet} (argument = the per-trial key "
+                 f"plane; states build in-graph)")
+    elif args.mesh:
+        from go_avalanche_tpu import parallel
+
+        mesh, program, state_abs = hlo_audit._run_sim_mesh_program(
+            args, cfg)
+        specs = parallel._specs_for(args.model, state_abs)
+        compiled = program.lower(state_abs).compile()
+        scope = "per-device (sharded planes divide by their mesh axes)"
+    else:
+        program, state_abs = hlo_audit._run_sim_dense_program(args, cfg)
+        compiled = program.lower(state_abs).compile()
+        scope = "single device"
+
+    rec = resources.memory_record(compiled)
+    print(f"memory report [{args.model}, {scope}]:", file=sys.stderr)
+    for key in ("argument_bytes", "output_bytes", "temp_bytes",
+                "alias_bytes", "generated_code_bytes",
+                "live_peak_bytes"):
+        print(f"  {key:>22}: {rec[key]:>15,}", file=sys.stderr)
+    if state_abs is not None:
+        fp = resources.footprint(state_abs, specs, mesh)
+        print(f"  analytic state footprint: {fp['total_bytes']:,} B "
+              f"across {len(fp['planes'])} planes; aliased "
+              f"{rec['alias_bytes']:,} B update in place", file=sys.stderr)
+        top = sorted(fp["planes"].items(), key=lambda kv: -kv[1])[:5]
+        for path, nbytes in top:
+            print(f"    {path:>24}: {nbytes:>15,}", file=sys.stderr)
+
+
 def main(argv=None) -> Dict:
     parser = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -974,6 +1028,17 @@ def main(argv=None) -> Dict:
                              "an interleaved mix in one file would "
                              "carry duplicate rounds under one "
                              "manifest")
+    parser.add_argument("--report-memory", action="store_true",
+                        help="resource report (obs/resources.py): "
+                             "compile the EXACT program these flags "
+                             "select, print its memory_analysis() "
+                             "ledger (argument / output / temp / "
+                             "aliased / donation-adjusted live peak) "
+                             "and the analytic per-plane state "
+                             "footprint to stderr, then run.  Same "
+                             "single-program rule as --audit "
+                             "(rejected with --phase-grid / "
+                             "--check-invariants / --chunk)")
     parser.add_argument("--check-invariants", action="store_true",
                         help="debug mode (obs/watchdog.py): step the sim "
                              "one jitted round at a time and assert the "
@@ -988,27 +1053,30 @@ def main(argv=None) -> Dict:
                              "refilled columns)")
     args = parser.parse_args(argv)
 
-    # --audit validation: everything parser-level (the PR 5 rule).  The
-    # audit lowers ONE program; flag combinations with no single-program
-    # meaning are rejected here, never discovered in the worker.
-    if args.audit:
+    # --audit / --report-memory validation: everything parser-level
+    # (the PR 5 rule).  Both lower ONE program; flag combinations with
+    # no single-program meaning are rejected here, never discovered in
+    # the worker.
+    if args.audit or args.report_memory:
+        what, verb = (("--audit", "audit") if args.audit
+                      else ("--report-memory", "analyze"))
         if args.phase_grid is not None:
             parser.error(
-                "--audit with --phase-grid would compile twice per "
-                "point: every grid point re-jits its own fleet program, "
-                "so auditing the sweep means lowering the whole grid "
-                "before the sweep compiles it again — audit a single "
-                "--fleet point (one program, lowered once, compiled "
-                "once) instead")
+                f"{what} with --phase-grid would compile twice per "
+                f"point: every grid point re-jits its own fleet "
+                f"program, so {verb}ing the sweep means lowering the "
+                f"whole grid before the sweep compiles it again — "
+                f"{verb} a single --fleet point (one program, lowered "
+                f"once, compiled once) instead")
         if args.check_invariants:
-            parser.error("--audit lowers the one fused program the run "
-                         "executes; --check-invariants dispatches "
-                         "per-round jits — there is no single program "
-                         "to audit")
+            parser.error(f"{what} lowers the one fused program the run "
+                         f"executes; --check-invariants dispatches "
+                         f"per-round jits — there is no single program "
+                         f"to {verb}")
         if args.chunk:
-            parser.error("--audit lowers the one fused program the run "
-                         "executes; --chunk dispatches host-driven "
-                         "chunks — audit the unchunked spelling")
+            parser.error(f"{what} lowers the one fused program the run "
+                         f"executes; --chunk dispatches host-driven "
+                         f"chunks — {verb} the unchunked spelling")
 
     # Adversary-knob validation: mirror the config's inert-knob
     # rejections at the parser (the PR 5 rule — the _validate_adversary
@@ -1317,6 +1385,12 @@ def main(argv=None) -> Dict:
             raise SystemExit(1)
         print(f"audit ok: {args.model} program passes its contracts "
               f"(callbacks/dtype/collectives/donation)", file=sys.stderr)
+
+    if args.report_memory:
+        # Resource report of the exact program the flags above selected
+        # (obs/resources.py) — BEFORE execution, like --audit, so an
+        # out-of-budget shape is visible without paying for the run.
+        _report_memory(args, cfg)
 
     ctx = tracing.trace(args.trace) if args.trace else contextlib.nullcontext()
     if args.metrics:

@@ -21,8 +21,9 @@ TPU-native replacements:
 from __future__ import annotations
 
 import contextlib
+import re
 import time
-from typing import Callable, Dict, Iterator, List, Optional
+from typing import Callable, Dict, Iterator, List, Optional, Sequence
 
 import jax
 import numpy as np
@@ -46,7 +47,22 @@ def annotate(name: str):
     simulators annotate their phases with this.  Under
     `collect_phase_times`, the same spans double as wall-clock phase timers
     (bench.py --profile) with no changes to the annotated code.
+
+    `name` must be one of the canonical `obs.tags.PHASE_SPANS` — the
+    span strings are the join key between the eager wall timers, the
+    device-time xplane harvest (`device_phase_times`) and the archived
+    profile artifacts, so an ad-hoc spelling here would mint a phase
+    row nothing else can join against (it would also stamp the drifted
+    name into every pinned program's HLO metadata).
     """
+    from go_avalanche_tpu.obs.tags import PHASE_SPANS
+
+    if name not in PHASE_SPANS:
+        raise ValueError(
+            f"unknown phase span {name!r}: annotate() names are the "
+            f"canonical obs.tags.PHASE_SPANS "
+            f"({', '.join(PHASE_SPANS)}) — register a new phase there "
+            f"(one spelling) before annotating with it")
     if _PHASE_SINK is not None:
         return _TimedPhase(name)
     return jax.named_scope(name)
@@ -117,6 +133,214 @@ def collect_phase_times() -> Iterator[Dict[str, float]]:
 def start_server(port: int = 9999):
     """Start the live profiler server (connect with TensorBoard capture)."""
     return jax.profiler.start_server(port)
+
+
+# --------------------------------------------------------------------------
+# Device-time profile harvest (the resource-observability plane).
+#
+# `collect_phase_times` above measures WALL time of an eager replay —
+# dispatch overhead rides along and the timed program itself is never
+# touched.  The harvest below reads the same phases out of the REAL timed
+# program: `jax.profiler.trace` writes an XSpace protobuf containing one
+# event per executed HLO op with its device duration; the compiled HLO's
+# `op_name` metadata carries the `annotate` scope path; joining the two
+# gives per-phase DEVICE time for the exact program `bench.py` times.
+# This container's jax (0.4.37) has no `jax.profiler.ProfileData`, so the
+# XSpace is read with a minimal protobuf wire-format walk — only the
+# fields the join needs (plane/line/event/stat + the two metadata maps).
+# --------------------------------------------------------------------------
+
+
+def _varint(buf: bytes, i: int):
+    shift = val = 0
+    while True:
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7
+
+
+def _proto_fields(buf: bytes):
+    """Yield (field_number, wire_type, value) over one message's bytes."""
+    i = 0
+    n = len(buf)
+    while i < n:
+        key, i = _varint(buf, i)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val, i = _varint(buf, i)
+        elif wire == 2:
+            ln, i = _varint(buf, i)
+            val = buf[i:i + ln]
+            i += ln
+        elif wire == 5:
+            val = buf[i:i + 4]
+            i += 4
+        elif wire == 1:
+            val = buf[i:i + 8]
+            i += 8
+        else:  # groups (3/4) never appear in XSpace
+            raise ValueError(f"unsupported protobuf wire type {wire}")
+        yield field, wire, val
+
+
+def _metadata_name(entry: bytes):
+    """(id, name) from one XEventMetadata / XStatMetadata map entry."""
+    mid, name = 0, ""
+    for f, _, v in _proto_fields(entry):
+        if f == 2:
+            for mf, _, mv in _proto_fields(v):
+                if mf == 1:
+                    mid = mv
+                elif mf == 2:
+                    name = mv.decode(errors="replace")
+    return mid, name
+
+
+def xplane_op_durations(log_dir, module_name: Optional[str] = None
+                        ) -> Dict[str, int]:
+    """Total device duration in PICOSECONDS per executed HLO op, from
+    every ``*.xplane.pb`` under a `trace(log_dir)` capture.
+
+    Only events carrying an ``hlo_op`` stat count (the per-op execution
+    events XLA emits on the device/runtime lines); python host-trace
+    events have no such stat and are ignored.  `module_name` restricts
+    the sum to events whose ``hlo_module`` stat matches (the profiled
+    block may execute helper programs — e.g. the sync fetch — whose op
+    names would otherwise collide).
+    """
+    import pathlib
+
+    totals: Dict[str, int] = {}
+    for path in sorted(pathlib.Path(log_dir).rglob("*.xplane.pb")):
+        data = path.read_bytes()
+        for f, _, plane in _proto_fields(data):
+            if f != 1:
+                continue
+            lines = []
+            stat_names: Dict[int, str] = {}
+            for pf, _, pv in _proto_fields(plane):
+                if pf == 3:
+                    lines.append(pv)
+                elif pf == 5:
+                    mid, name = _metadata_name(pv)
+                    stat_names[mid] = name
+            if not lines or not stat_names:
+                continue
+            by_name = {name: mid for mid, name in stat_names.items()}
+            op_key = by_name.get("hlo_op")
+            mod_key = by_name.get("hlo_module")
+            if op_key is None:
+                continue  # no op-level events on this plane
+            for line in lines:
+                for lf, _, lv in _proto_fields(line):
+                    if lf != 4:
+                        continue
+                    dur = 0
+                    op = mod = None
+                    for ef, _, ev in _proto_fields(lv):
+                        if ef == 3:
+                            dur = ev
+                        elif ef == 4:
+                            smid = ref = None
+                            for sf, _, sv in _proto_fields(ev):
+                                if sf == 1:
+                                    smid = sv
+                                elif sf == 7:
+                                    ref = sv
+                            if smid == op_key and ref is not None:
+                                op = stat_names.get(ref)
+                            elif smid == mod_key and ref is not None:
+                                mod = stat_names.get(ref)
+                    if op is None:
+                        continue
+                    if module_name is not None and mod != module_name:
+                        continue
+                    totals[op] = totals.get(op, 0) + dur
+    return totals
+
+
+_HLO_INSTR_RE = re.compile(
+    r'%([\w.-]+)\s*=.*?metadata=\{[^}]*op_name="([^"]*)"')
+_HLO_MODULE_RE = re.compile(r"^HloModule\s+([\w.-]+)", re.MULTILINE)
+
+
+def hlo_phase_map(compiled_text: str,
+                  phases: Optional[Sequence[str]] = None
+                  ) -> Dict[str, str]:
+    """Map compiled-HLO instruction name -> canonical phase span.
+
+    `compiled_text` is the optimized HLO (``lowered.compile().as_text()``
+    — the instruction names there are the ones the profiler's op events
+    carry).  An instruction belongs to a phase iff that span name appears
+    as a path segment of its ``op_name`` metadata (the `annotate`
+    scope path survives lowering and fusion).  `phases` defaults to
+    `obs.tags.PHASE_SPANS`.
+    """
+    if phases is None:
+        from go_avalanche_tpu.obs.tags import PHASE_SPANS as phases
+
+    phase_set = set(phases)
+    mapping: Dict[str, str] = {}
+    for instr, op_name in _HLO_INSTR_RE.findall(compiled_text):
+        for segment in op_name.split("/"):
+            if segment in phase_set:
+                mapping[instr] = segment
+                break
+    return mapping
+
+
+def hlo_module_name(compiled_text: str) -> Optional[str]:
+    """The ``HloModule`` name of a compiled program's text (the
+    ``hlo_module`` stat the profiler stamps on its op events)."""
+    m = _HLO_MODULE_RE.search(compiled_text)
+    return m.group(1) if m else None
+
+
+def device_phase_times(fn: Callable, *args, compiled_text: str,
+                       phases: Optional[Sequence[str]] = None):
+    """Execute ``fn(*args)`` once under the JAX profiler and return
+    ``(result, {phase: device ms})`` for the program `compiled_text`
+    describes.
+
+    The returned dict carries one entry per canonical phase observed,
+    plus ``other_device_ms`` (op time outside every annotated span —
+    scan plumbing, donation copies, un-annotated phases) and
+    ``device_total_ms``.  The caller must pass the OPTIMIZED HLO text of
+    the jitted `fn` (``fn.lower(*args).compile().as_text()``) — the
+    instruction-name join is only valid against the program that
+    actually ran.  Works with donated `fn` (the consumed args are
+    replaced by the returned result, which the caller keeps).
+    """
+    import shutil
+    import tempfile
+
+    log_dir = tempfile.mkdtemp(prefix="xplane_phase_")
+    try:
+        with trace(log_dir):
+            result = fn(*args)
+            jax.block_until_ready(result)
+        per_op = xplane_op_durations(
+            log_dir, module_name=hlo_module_name(compiled_text))
+    finally:
+        shutil.rmtree(log_dir, ignore_errors=True)
+
+    phase_of = hlo_phase_map(compiled_text, phases)
+    out: Dict[str, float] = {}
+    other = total = 0
+    for op, ps in per_op.items():
+        total += ps
+        phase = phase_of.get(op)
+        if phase is None:
+            other += ps
+        else:
+            out[phase] = out.get(phase, 0.0) + ps
+    ms = {name: round(ps / 1e9, 3) for name, ps in sorted(out.items())}
+    ms["other_device_ms"] = round(other / 1e9, 3)
+    ms["device_total_ms"] = round(total / 1e9, 3)
+    return result, ms
 
 
 class TelemetryRecorder:

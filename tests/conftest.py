@@ -17,6 +17,15 @@ mode; run that one file alone).
 """
 
 import os
+import tempfile
+
+# Bench runs append a perf-ledger row (benchmarks/ledger.py); the e2e
+# bench contract tests must not grow the COMMITTED benchmarks/
+# ledger.jsonl, so the whole test process (and every subprocess it
+# spawns — the env inherits) writes to a scratch ledger instead.
+os.environ.setdefault(
+    "GO_AVALANCHE_TPU_LEDGER",
+    os.path.join(tempfile.gettempdir(), "go_avalanche_test_ledger.jsonl"))
 
 _tpu_mode = bool(os.environ.get("GO_AVALANCHE_TPU_TESTS"))
 _flags = os.environ.get("XLA_FLAGS", "")

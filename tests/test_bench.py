@@ -443,6 +443,37 @@ def test_bench_stake_lane_parser_rejections():
         assert msg in out.stderr, (argv, out.stderr[-500:])
 
 
+def test_bench_adversary_lane_parser_rejections():
+    """The --adversary A/B lane's guards (the PR 5 rule): inert combos
+    — a policy with no byzantine nodes, byzantine nodes with no tagged
+    policy, a policy whose required engine is absent — die at argparse,
+    before any jax import."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for argv, msg in (
+            (["--adversary", "split_vote"], "--byzantine 0"),
+            (["--byzantine", "0.2"], "without --adversary"),
+            (["--adversary", "timing", "--byzantine", "0.1"],
+             "no ring"),
+            (["--adversary", "stake_eclipse", "--byzantine", "0.1"],
+             "needs --stake"),
+            (["--adversary", "split_vote", "--byzantine", "0.1",
+              "--arrival", "8"], "pick one lane"),
+            (["--adversary", "split_vote", "--byzantine", "1.5"],
+             "fraction in [0, 1)")):
+        out = subprocess.run(
+            [sys.executable, str(repo / "bench.py"), *argv],
+            capture_output=True, text=True, timeout=60, cwd=str(repo),
+            env=env)
+        assert out.returncode == 2, argv
+        assert msg in out.stderr, (argv, out.stderr[-500:])
+
+
 def test_hlo_pin_stale_rejects_other_modes():
     """--stale short-circuits before any lowering, so combining it
     with --update / --verify-off-path must be a parser error — a CI

@@ -1,0 +1,465 @@
+"""Resource-observability plane tests (PR 14).
+
+Four surfaces: the analytic per-plane footprint model vs compiled
+`memory_analysis()` (obs/resources.py — including the planted-clone
+negative: an undonated copy MUST trip the check), the memory-pin
+archive (benchmarks/mem_pin.py), the perf ledger + regression gate
+(benchmarks/ledger.py — the BENCH r04/r05 cross-backend footgun as a
+machine-checked error), and the `[F, N, T]` VMEM-knee predictor
+(benchmarks/vmem_knee.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import pytest
+
+from benchmarks import ledger, mem_pin, vmem_knee
+from go_avalanche_tpu.obs import resources
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# ------------------------------------------------------ footprint model
+
+# The flagship state's per-plane byte ledger at (16 nodes, 8 txs) —
+# PINNED by hand from the dtype table (votes/consider u8, confidence
+# u16, added bool, 3 poll-order vectors i32, byzantine/alive bool,
+# latency_weight f32, finalized_at i32, round i32, key 2xu32).  A
+# change here means the state pytree itself changed shape — re-derive
+# and update alongside the mem_pin re-pin.
+FLAGSHIP_PLANES_16x8 = {
+    ".records.votes": 128, ".records.consider": 128,
+    ".records.confidence": 256, ".added": 128, ".valid": 8,
+    ".score_rank": 32, ".poll_order": 32, ".poll_order_inv": 32,
+    ".byzantine": 16, ".alive": 16, ".latency_weight": 64,
+    ".finalized_at": 512, ".round": 4, ".key": 8,
+}
+
+# Pinned totals at two shapes per state family (the satellite's
+# two-shape coverage): flagship, the async in-flight ring (latency 2,
+# coalesced — ring depth 7), the trace-plane state (stride 2 over 8
+# rounds = 4 slots x 10 columns x i32 + cursor), and the 4-trial fleet
+# stack (exactly 4x the per-trial bytes — vmap stacks EVERY leaf).
+PINNED_TOTALS = {
+    ("flagship", 16, 8): 1364, ("flagship", 64, 32): 19244,
+    ("async", 16, 8): 10436, ("async", 64, 32): 56876,
+    ("trace", 16, 8): 1528, ("trace", 64, 32): 19408,
+    ("fleet4", 16, 8): 5456, ("fleet4", 64, 32): 76976,
+}
+
+
+def _state_abs(family: str, nodes: int, txs: int):
+    from benchmarks.workload import flagship_state, fleet_flagship_state
+
+    if family == "flagship":
+        return jax.eval_shape(lambda: flagship_state(nodes, txs, 8)[0])
+    if family == "async":
+        return jax.eval_shape(lambda: flagship_state(
+            nodes, txs, 8, 2, inflight_engine="coalesced")[0])
+    if family == "trace":
+        return jax.eval_shape(lambda: flagship_state(
+            nodes, txs, 8, trace_every=2, trace_rounds=8)[0])
+    if family == "fleet4":
+        return jax.eval_shape(
+            lambda: fleet_flagship_state(4, nodes, txs, 8)[0])
+    raise AssertionError(family)
+
+
+def test_footprint_flagship_per_plane_bytes_pinned():
+    fp = resources.footprint(_state_abs("flagship", 16, 8))
+    assert fp["planes"] == FLAGSHIP_PLANES_16x8
+    assert fp["total_bytes"] == sum(FLAGSHIP_PLANES_16x8.values())
+
+
+@pytest.mark.parametrize("family,nodes,txs",
+                         sorted(PINNED_TOTALS))
+def test_footprint_totals_pinned_two_shapes(family, nodes, txs):
+    fp = resources.footprint(_state_abs(family, nodes, txs))
+    assert fp["total_bytes"] == PINNED_TOTALS[(family, nodes, txs)]
+    assert fp["total_bytes"] == sum(fp["planes"].values())
+
+
+def test_fleet_footprint_is_exactly_trials_times_per_trial():
+    """The fleet vmap stacks EVERY leaf on the trial axis — the knee
+    predictor's linear-in-F model is exact, not approximate."""
+    per_trial = resources.footprint(_state_abs("flagship", 16, 8))
+    fleet = resources.footprint(_state_abs("fleet4", 16, 8))
+    assert fleet["total_bytes"] == 4 * per_trial["total_bytes"]
+
+
+def test_async_ring_planes_present_and_accounted():
+    fp = resources.footprint(_state_abs("async", 16, 8))
+    ring = {k: v for k, v in fp["planes"].items() if ".inflight" in k}
+    assert set(ring) == {".inflight.peers", ".inflight.lat",
+                         ".inflight.responded", ".inflight.lie",
+                         ".inflight.polled"}
+    assert sum(ring.values()) == (fp["total_bytes"]
+                                  - PINNED_TOTALS[("flagship", 16, 8)])
+
+
+# ------------------------------------- analytic vs compiled (+ negative)
+
+def _mini_flagship(latency: int = 0):
+    from benchmarks.workload import flagship_config, flagship_state
+
+    cfg = flagship_config(64, 8, latency)
+    state_abs = jax.eval_shape(lambda: flagship_state(64, 64, 8,
+                                                      latency)[0])
+    return cfg, state_abs
+
+
+def test_donated_flagship_passes_memory_check():
+    import bench
+
+    cfg, state_abs = _mini_flagship()
+    compiled = bench.flagship_program(cfg, 2).lower(state_abs).compile()
+    rec = resources.memory_record(compiled)
+    analytic = resources.footprint(state_abs)["total_bytes"]
+    assert resources.check_memory(rec, analytic, donated=True,
+                                  abs_tol=256) == []
+    assert rec["alias_bytes"] == rec["argument_bytes"]
+
+
+def test_planted_undonated_clone_trips_the_check():
+    """The negative the tentpole demands: the SAME scan compiled
+    without donation double-buffers every plane — alias coverage
+    collapses and the analytic-vs-compiled assertion must fail."""
+    import functools
+
+    from go_avalanche_tpu.models import avalanche as av
+
+    cfg, state_abs = _mini_flagship()
+
+    @functools.partial(jax.jit)  # no donate_argnums: the planted clone
+    def undonated(s):
+        def body(st, _):
+            new_s, _ = av.round_step(st, cfg)
+            return new_s, None
+        out, _ = jax.lax.scan(body, s, None, length=2)
+        return out
+
+    rec = resources.memory_record(undonated.lower(state_abs).compile())
+    analytic = resources.footprint(state_abs)["total_bytes"]
+    failures = resources.check_memory(rec, analytic, donated=True,
+                                      abs_tol=256)
+    assert failures, "an undonated program must fail the alias check"
+    assert any("double-buffer" in f for f in failures)
+
+
+def test_planted_extra_output_clone_trips_the_check():
+    """A donated program that RETURNS an extra copy of a plane (the
+    undonated-copy-next-to-the-state class) shows up as surplus output
+    bytes."""
+    import functools
+
+    from go_avalanche_tpu.models import avalanche as av
+
+    cfg, state_abs = _mini_flagship()
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def cloning(s):
+        def body(st, _):
+            new_s, _ = av.round_step(st, cfg)
+            return new_s, None
+        out, _ = jax.lax.scan(body, s, None, length=2)
+        return out, out.records.votes + 1  # the planted clone
+
+    rec = resources.memory_record(cloning.lower(state_abs).compile())
+    analytic = resources.footprint(state_abs)["total_bytes"]
+    failures = resources.check_memory(rec, analytic, donated=True,
+                                      abs_tol=256)
+    assert any("output bytes" in f for f in failures)
+
+
+def test_sharded_driver_footprint_matches_compiled_per_device():
+    """One sharded program (the acceptance criterion's 'one sharded
+    program'): per-device analytic footprint == compiled argument
+    bytes, full alias coverage, on the 2x2 audit mesh."""
+    recs = resources.sharded_driver_records(["avalanche"])["avalanche"]
+    analytic = recs["footprint"]["total_bytes"]
+    assert resources.check_memory(recs["record"], analytic,
+                                  donated=True, extra_output_ok=True,
+                                  abs_tol=64, what="sharded_avalanche"
+                                  ) == []
+    assert recs["record"]["argument_bytes"] == analytic
+
+
+# --------------------------------------------------------- memory pins
+
+def test_mem_pin_stale_archive_is_clean():
+    assert mem_pin.stale_pins(mem_pin._load_archive()) == []
+
+
+def test_mem_pin_stale_flags_rot():
+    stale = mem_pin.stale_pins({"programs": {
+        "ghost": {}, "sharded_ghost_driver": {}}})
+    assert len(stale) == 2
+    assert any("ghost:" in s for s in stale)
+    assert any("sharded_ghost_driver" in s for s in stale)
+
+
+def test_mem_pin_archive_covers_every_program_and_driver():
+    """The acceptance criterion: a memory record for every hlo_pin
+    program AND all five sharded drivers."""
+    archive = mem_pin._load_archive()
+    assert set(archive["programs"]) == set(mem_pin.all_names())
+    for name, entry in archive["programs"].items():
+        assert entry.get("records"), name
+        assert entry.get("footprint", {}).get("total_bytes", 0) > 0, name
+
+
+def test_mem_pin_hlo_coupling():
+    """Each archived memory record names the hlo hash it was harvested
+    at; for the pinned programs that hash must equal the CURRENT
+    program hash — a program change that re-pins hlo_pin.json cannot
+    leave a stale memory record behind.  (Cheap: the lowering is
+    memoized with the hlo-pin drift test's.)"""
+    from benchmarks import hlo_pin
+
+    platform = jax.default_backend()
+    archive = mem_pin._load_archive()
+    checked = 0
+    for name, entry in sorted(archive["programs"].items()):
+        if name.startswith(mem_pin.SHARDED_PREFIX):
+            continue
+        pinned = entry.get("hlo", {}).get(platform)
+        if pinned is None:
+            continue
+        assert pinned == hlo_pin.program_hash(
+            name, entry.get("workload")), (
+            f"{name}: memory record harvested from a different program "
+            f"than the current lowering — re-pin with "
+            f"benchmarks/mem_pin.py --update")
+        checked += 1
+    if not checked:
+        pytest.skip(f"no {platform} memory records archived")
+
+
+@pytest.mark.parametrize("name", ["fleet_small", "flagship_traffic",
+                                  "sharded_avalanche"])
+def test_mem_pin_subset_recheck_within_band(name):
+    """Tier-1 recomputes a fast subset of the archive each run
+    (argument/output/alias exact, temp banded, analytic model
+    asserted) — the full sweep is `python benchmarks/mem_pin.py`."""
+    platform = jax.default_backend()
+    archive = mem_pin._load_archive()
+    entry = archive["programs"][name]
+    if entry.get("records", {}).get(platform) is None:
+        pytest.skip(f"no {platform} record for {name}")
+    assert mem_pin.check_one(name, entry, platform) == []
+
+
+def test_mem_pin_stale_cli():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, str(REPO / "benchmarks" / "mem_pin.py"),
+         "--stale"],
+        capture_output=True, text=True, timeout=120, cwd=str(REPO),
+        env=env)
+    assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]
+    assert "live harvest paths" in out.stdout
+    reject = subprocess.run(
+        [sys.executable, str(REPO / "benchmarks" / "mem_pin.py"),
+         "--stale", "--update"],
+        capture_output=True, text=True, timeout=120, cwd=str(REPO),
+        env=env)
+    assert reject.returncode == 2
+    assert "composes with --list only" in reject.stderr
+
+
+# -------------------------------------------------------------- ledger
+
+def _row(value, backend="tpu", lane="lane-a", tag="", rnd=None,
+         fallback=False, ts=1.0):
+    return {"schema": 1, "ts": ts, "lane": lane, "metric": lane,
+            "value": value, "unit": "votes/sec", "tag": tag,
+            "backend": backend, "fallback": fallback, "round": rnd}
+
+
+def test_gate_passes_fresh_same_backend_pair():
+    failures, refused, report = ledger.gate(
+        [_row(100.0, ts=1.0), _row(98.0, ts=2.0)])
+    assert failures == [] and refused == []
+    assert len(report) == 1 and "-2.0%" in report[0]
+
+
+def test_gate_errors_on_cross_backend_pair():
+    failures, _, _ = ledger.gate(
+        [_row(100.0, backend="tpu", ts=1.0),
+         _row(90.0, backend="cpu", ts=2.0)])
+    assert len(failures) == 1
+    assert "cross-backend" in failures[0]
+    assert "r04/r05 footgun" in failures[0]
+
+
+def test_gate_fails_regression_beyond_band():
+    failures, _, _ = ledger.gate(
+        [_row(100.0, ts=1.0), _row(80.0, ts=2.0)], band=0.10)
+    assert len(failures) == 1 and "regression" in failures[0]
+
+
+def test_gate_refuses_unknown_backend_and_fallback_rows():
+    """Old artifacts (backend unknown) and CPU-fallback availability
+    rows are EXCLUDED with a reason — never silently compared."""
+    failures, refused, report = ledger.gate(
+        [_row(100.0, ts=1.0),
+         _row(1.0, backend="unknown", ts=2.0),
+         _row(2.0, fallback=True, backend="cpu", ts=3.0),
+         _row(97.0, ts=4.0)])
+    assert failures == []
+    assert len(refused) == 2
+    assert any("backend unknown" in r for r in refused)
+    assert any("fallback" in r for r in refused)
+    # the two tpu rows still compare ACROSS the refused rows
+    assert len(report) == 1 and "-3.0%" in report[0]
+
+
+def test_split_metric_strips_backend_and_fallback_label():
+    lane, backend, fb = ledger.split_metric(
+        "sustained vote ingest (2048 nodes x 2048 txs, k=8, 5 rounds, "
+        "cpu) [CPU FALLBACK — accelerator unavailable]")
+    assert backend == "cpu" and fb is True
+    assert "cpu" not in lane and "FALLBACK" not in lane
+    lane2, backend2, fb2 = ledger.split_metric(
+        "sustained vote ingest (16384 nodes x 16384 txs, k=8, 20 "
+        "rounds, tpu, latency2, coalesced-inflight)")
+    assert backend2 == "tpu" and fb2 is False
+    assert "latency2, coalesced-inflight" in lane2
+
+
+def test_row_from_result_prefers_explicit_fields():
+    parsed = {"metric": "m (64 nodes x 64 txs, k=8, 2 rounds, cpu)",
+              "value": 5.0, "unit": "votes/sec", "backend": "tpu",
+              "tag": ", latency2", "devices": {"device_count": 8}}
+    row = ledger.row_from_result(parsed)
+    assert row["backend"] == "tpu"          # explicit beats metric parse
+    assert row["tag"] == ", latency2"
+    assert row["devices"] == {"device_count": 8}
+    old = ledger.row_from_result({"metric": "bare metric", "value": 1.0})
+    assert old["backend"] == "unknown"
+
+
+def test_bench_replay_gate_refuses_cpu_rounds(tmp_path):
+    """The satellite self-test: replay the archived BENCH_r01–r05
+    driver rounds through `--gate`.  The CPU-fallback rounds (r04/r05)
+    must be REFUSED from comparison, the failed round (r01) excluded,
+    and the r02->r03 TPU pair gated within the band."""
+    led = tmp_path / "ledger.jsonl"
+    paths = [str(REPO / f"BENCH_r{n:02d}.json") for n in range(1, 6)]
+    out = subprocess.run(
+        [sys.executable, str(REPO / "benchmarks" / "ledger.py"),
+         "--ledger", str(led), "--import", *paths, "--gate", "--table"],
+        capture_output=True, text=True, timeout=60, cwd=str(REPO))
+    assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]
+    assert "refused: r04" in out.stdout
+    assert "refused: r05" in out.stdout
+    assert "refused: r01" in out.stdout
+    assert "r02 59.82B -> r03 56.82B (-5.0%)" in out.stdout
+    # the trajectory table reproduces the PERF_NOTES r01–r03 chain
+    assert "-5.0%" in out.stdout
+    assert "CPU fallback" in out.stdout
+
+
+def test_committed_ledger_gates_clean():
+    """The seeded benchmarks/ledger.jsonl (BENCH r01–r05 imported) must
+    pass the gate: TPU pair within band, CPU rounds refused."""
+    rows = ledger.load(ledger.DEFAULT_LEDGER)
+    assert len(rows) >= 5
+    failures, refused, report = ledger.gate(rows)
+    assert failures == []
+    assert any("r04" in r for r in refused)
+
+
+def test_bench_appends_ledger_row_via_env_redirect(tmp_path, monkeypatch):
+    monkeypatch.setenv("GO_AVALANCHE_TPU_LEDGER",
+                       str(tmp_path / "led.jsonl"))
+    import bench
+
+    bench._ledger_append({"metric": "m (8 nodes x 8 txs, k=8, 1 "
+                                    "rounds, cpu)",
+                          "value": 1.0, "unit": "votes/sec",
+                          "backend": "cpu", "tag": ""})
+    rows = ledger.load(tmp_path / "led.jsonl")
+    assert len(rows) == 1 and rows[0]["backend"] == "cpu"
+    assert rows[0]["source"] == "bench"
+
+
+# ----------------------------------------------------------- vmem knee
+
+def test_knee_table_monotone_and_fits_budget():
+    table = vmem_knee.knee_table("cpu-ci")
+    nts = [r["largest_nt"] for r in table["rows"]]
+    assert all(nt is not None for nt in nts)
+    assert nts == sorted(nts, reverse=True)  # more trials, smaller sims
+    budget = (vmem_knee.DEVICE_PROFILES["cpu-ci"]["hbm_bytes"]
+              * vmem_knee.HEADROOM)
+    ratio = table["temp_ratio"]["ratio"]
+    for r in table["rows"]:
+        assert r["modeled_live_peak_bytes"] <= budget
+        # the NEXT swept square must genuinely not fit — largest_nt is
+        # the knee, not a conservative guess (exact recomputation, not
+        # a scaling approximation)
+        next_peak = (r["trials_per_device"]
+                     * vmem_knee.per_trial_footprint(2 * r["largest_nt"])
+                     * (1.0 + ratio))
+        assert next_peak > budget
+
+
+def test_knee_archive_matches_recomputation():
+    """benchmarks/vmem_knee.json is the citable artifact (ROADMAP
+    fleet-of-sharded-sims item quotes it); it must equal what the
+    model currently derives."""
+    archived = json.loads(
+        (REPO / "benchmarks" / "vmem_knee.json").read_text())
+    for name in ("v5e-8", "cpu-ci"):
+        assert archived["tables"][name] == vmem_knee.knee_table(name)
+
+
+def test_knee_v5e8_supports_roadmap_fleet_claim():
+    """The number the ROADMAP item cites: >= 1024 trials per config
+    point at 2048^2 fit a v5e-8 under the modeled live peak."""
+    table = vmem_knee.knee_table("v5e-8")
+    row = next(r for r in table["rows"] if r["fleet"] == 1024)
+    assert row["largest_nt"] >= 2048
+    assert row["vmem_resident"] is True
+
+
+# ------------------------------------------------ device-time profile
+
+def test_device_phase_times_joins_canonical_spans():
+    import jax.numpy as jnp
+
+    from go_avalanche_tpu.utils import tracing
+
+    @jax.jit
+    def f(x):
+        with tracing.annotate("poll_mask"):
+            y = x @ x
+        with tracing.annotate("ingest_votes"):
+            return jnp.sin(y).sum()
+
+    x = jnp.ones((256, 256))
+    text = f.lower(x).compile().as_text()
+    assert tracing.hlo_module_name(text) == "jit_f"
+    phase_map = tracing.hlo_phase_map(text)
+    assert set(phase_map.values()) <= {"poll_mask", "ingest_votes"}
+    _, ms = tracing.device_phase_times(f, x, compiled_text=text)
+    assert "device_total_ms" in ms and ms["device_total_ms"] > 0
+    assert "poll_mask" in ms  # the dot is the dominant op
+    from go_avalanche_tpu.obs.tags import PHASE_SPANS
+    assert set(ms) <= set(PHASE_SPANS) | {"other_device_ms",
+                                          "device_total_ms"}
+
+
+def test_annotate_rejects_ad_hoc_span_names():
+    from go_avalanche_tpu.utils import tracing
+
+    with pytest.raises(ValueError, match="PHASE_SPANS"):
+        tracing.annotate("my_custom_phase")

@@ -281,3 +281,24 @@ def test_cli_partition_flag_parse_error():
     with pytest.raises(SystemExit):
         main(["--model", "snowball", "--partition", "not-a-spec",
               "--json"])
+
+
+def test_cli_report_memory_dense(capsys):
+    """--report-memory prints the compiled memory ledger + the analytic
+    per-plane footprint to stderr (the resource plane, PR 14); stdout
+    keeps the one-result contract."""
+    result = main(["--model", "avalanche", "--nodes", "32", "--txs", "16",
+                   "--finalization-score", "16", "--report-memory",
+                   "--json"])
+    err = capsys.readouterr().err
+    assert result["finalized_fraction"] == 1.0
+    assert "memory report [avalanche, single device]" in err
+    assert "live_peak_bytes" in err
+    assert "analytic state footprint" in err
+
+
+def test_cli_report_memory_rejects_phase_grid():
+    with pytest.raises(SystemExit) as exc:
+        main(["--model", "snowball", "--fleet", "4", "--phase-grid",
+              '{"k": [8]}', "--report-memory", "--json"])
+    assert exc.value.code == 2

@@ -33,11 +33,13 @@ def test_profiler_trace_writes_artifacts(tmp_path):
 
 
 def test_annotate_works_inside_jit():
+    # Canonical span names only (obs.tags.PHASE_SPANS): ad-hoc strings
+    # are rejected so profile rows always join against the registry.
     @jax.jit
     def fn(x):
-        with tracing.annotate("phase_a"):
+        with tracing.annotate("poll_mask"):
             y = x * 2
-        with tracing.annotate("phase_b"):
+        with tracing.annotate("ingest_votes"):
             return y + 1
 
     assert int(fn(jnp.int32(3))) == 7
