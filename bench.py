@@ -86,7 +86,7 @@ def flagship_program(cfg, n_rounds: int):
     return run
 
 
-def fleet_program(cfg, n_rounds: int, fleet: int):
+def fleet_program(cfg, n_rounds: int, fleet: int, mesh=None):
     """The `--fleet` variant of `flagship_program`: `fleet` whole
     flagship scans batched on a leading trial axis inside ONE jit
     (state donated) — a fleet of small sims is one compiled program and
@@ -94,13 +94,24 @@ def fleet_program(cfg, n_rounds: int, fleet: int):
     workload (`go_avalanche_tpu/fleet.py`).  ``fleet=1`` returns
     `flagship_program` itself — the f=1 spelling IS the pinned flagship
     program (`benchmarks/hlo_pin.py --verify-off-path` machine-checks
-    the collapse).  Module-level so `hlo_pin.py` hashes the timed
-    program (`fleet_small`), not a reconstruction of it.
+    the collapse).  `mesh` (the `--mesh A,B` lane, a
+    `parallel.sharded_fleet.make_fleet_mesh` mesh) lays the trial axis
+    over its devices — each scans F/D trials in place, zero
+    collectives (`sharded_fleet.fleet_scan_program`, pinned as
+    `fleet_sharded`); a 1-device (or absent) mesh collapses to the
+    dense spelling, which `--verify-off-path` proves byte-identical to
+    the archived `fleet_small` chain.  Module-level so `hlo_pin.py`
+    hashes the timed program, not a reconstruction of it.
     """
     import jax
 
     from go_avalanche_tpu.models import avalanche as av
 
+    if mesh is not None and mesh.devices.size > 1:
+        from go_avalanche_tpu.parallel import sharded_fleet
+
+        sharded_fleet.check_fleet_divisible(fleet, mesh)
+        return sharded_fleet.fleet_scan_program(mesh, cfg, n_rounds)
     if fleet == 1:
         return flagship_program(cfg, n_rounds)
 
@@ -143,6 +154,7 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
           ingest: str = "u8", latency: int = 0,
           latency_mode: str = "fixed", timeout_rounds: int | None = None,
           inflight: str = "walk", fleet: int | None = None,
+          mesh: str | None = None,
           arrival: float | None = None, arrival_window: int = 1024,
           stake: str = "off", stake_clusters: int = 1,
           adversary: str = "off", byzantine: float = 0.0,
@@ -184,6 +196,14 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
         metrics_every = 0
         trace_every = tap_stride
     trace_rounds = n_rounds * (repeats + 1)
+    fleet_mesh = None
+    if mesh is not None and fleet is None:
+        # Mirror the CLI parser: mesh is the fleet lane's trial-sharding
+        # axis — a silently-ignored mesh would time the dense flagship
+        # and record a row labeled as something it isn't.
+        raise ValueError("mesh is the fleet lane's trial-sharding axis "
+                         "(bench times single-chip programs otherwise) "
+                         "— pair it with fleet=F")
     if arrival is not None:
         # The live-traffic lane: the streaming backlog scheduler under
         # poisson arrival with closed-loop admission
@@ -221,6 +241,17 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
             inflight_engine=inflight, stake=stake,
             clusters=stake_clusters, adversary=adversary,
             byzantine=byzantine)
+        if mesh is not None:
+            # The `--mesh A,B` lane (the fleet x mesh composition): lay
+            # the stacked trial axis over the fleet mesh so the timed
+            # donated scan runs F/(A*B) whole sims per device
+            # (parallel/sharded_fleet.py; pinned as fleet_sharded).
+            from go_avalanche_tpu.parallel import sharded_fleet
+
+            a, b = (int(x) for x in mesh.split(","))
+            fleet_mesh = sharded_fleet.make_fleet_mesh(a, b)
+            sharded_fleet.check_fleet_divisible(fleet, fleet_mesh)
+            state = sharded_fleet.shard_fleet_state(state, fleet_mesh)
     else:
         # `stake`/`stake_clusters` ride the flagship lane: the same
         # timed scan under the stake-weighted committee draw
@@ -253,8 +284,15 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
     if fleet is not None:
         # Not a config knob (the batching lives in the program, not the
         # round), so the fleet width tags the metric here — same-metric
-        # deltas never cross fleet widths.
+        # deltas never cross fleet widths.  The mesh tags too
+        # (', fleetF, meshAxB'): a trial-sharded run measures a
+        # different machine, so its ledger lane never chains against a
+        # different mesh's rows (benchmarks/ledger.py also hard-errors
+        # on a device-count change inside one lane).
         engine_tag += f", fleet{fleet}"
+        if fleet_mesh is not None and fleet_mesh.devices.size > 1:
+            a, b = fleet_mesh.devices.shape
+            engine_tag += f", mesh{a}x{b}"
     sink_ctx = (obs.metrics_sink(metrics, tag=engine_tag)
                 if metrics else contextlib.nullcontext())
 
@@ -267,7 +305,7 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
     if arrival is not None:
         run = traffic_program(cfg, n_rounds)
     elif fleet is not None:
-        run = fleet_program(cfg, n_rounds, fleet)
+        run = fleet_program(cfg, n_rounds, fleet, mesh=fleet_mesh)
     else:
         run = flagship_program(cfg, n_rounds)
 
@@ -410,6 +448,18 @@ def _phase_profile(av, state, cfg) -> dict:
 
 def _worker_main(args: argparse.Namespace) -> None:
     if args.force_cpu:
+        if args.mesh is not None:
+            # The fleet mesh needs A*B devices; the CPU fallback has
+            # one.  XLA_FLAGS is read at backend INIT (after this), so
+            # forcing the virtual host-device count here — before any
+            # jax device query — gives the fallback its mesh, exactly
+            # like tests/conftest.py.
+            a, b = (int(x) for x in args.mesh.split(","))
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + f" --xla_force_host_platform_device_count="
+                    f"{a * b}").strip()
         import jax
         jax.config.update("jax_platforms", "cpu")
     result = bench(args.nodes, args.txs, args.rounds, args.k,
@@ -417,6 +467,7 @@ def _worker_main(args: argparse.Namespace) -> None:
                    latency=args.latency, latency_mode=args.latency_mode,
                    timeout_rounds=args.timeout_rounds,
                    inflight=args.inflight_engine, fleet=args.fleet,
+                   mesh=args.mesh,
                    arrival=args.arrival,
                    arrival_window=args.arrival_window,
                    stake=args.stake, stake_clusters=args.stake_clusters,
@@ -616,6 +667,22 @@ def main() -> None:
                              "collapse).  A/B at small shape: fleet=1 "
                              "vs fleet=64 isolates per-dispatch "
                              "overhead (PERF_NOTES PR 7)")
+    parser.add_argument("--mesh", type=str, default=None, metavar="A,B",
+                        help="with --fleet: lay the trial axis over an "
+                             "(A, B) fleet mesh — A*B devices each "
+                             "scan F/(A*B) whole flagship sims inside "
+                             "the one donated timed jit (parallel/"
+                             "sharded_fleet.fleet_scan_program, zero "
+                             "collectives; pinned as fleet_sharded).  "
+                             "F must divide by A*B.  The metric gains "
+                             "', fleetF, meshAxB', so same-metric "
+                             "deltas never cross meshes — and the "
+                             "ledger gate hard-errors on a device-"
+                             "count change inside one lane (the "
+                             "r04/r05 class).  A 1-device mesh times "
+                             "THE fleet_small program "
+                             "(hlo_pin --verify-off-path checks the "
+                             "collapse)")
     parser.add_argument("--arrival", type=float, default=None,
                         metavar="RATE",
                         help="live-traffic lane (go_avalanche_tpu/"
@@ -744,6 +811,25 @@ def main() -> None:
             parser.error("--profile replays one eager round on the "
                          "timed state; a fleet-stacked state has no "
                          "single-round spelling")
+    if args.mesh is not None:
+        # Parser-level (the PR 5 rule): a worker ValueError reads as an
+        # accelerator failure and spins the retry/fallback loop.
+        if args.fleet is None:
+            parser.error("--mesh is the fleet lane's trial-sharding "
+                         "axis (bench times single-chip programs "
+                         "otherwise) — pair it with --fleet F")
+        try:
+            a, b = (int(x) for x in args.mesh.split(","))
+        except ValueError:
+            parser.error(f"--mesh must be A,B trial shards (e.g. 2,2), "
+                         f"got {args.mesh!r}")
+        if a < 1 or b < 1:
+            parser.error(f"--mesh axes must be >= 1, got {args.mesh}")
+        if args.fleet % (a * b):
+            parser.error(f"--fleet ({args.fleet}) must divide by the "
+                         f"mesh's device count ({a}x{b} = {a * b}): "
+                         f"the trial axis shards evenly — each device "
+                         f"runs F/D whole sims")
     if args.arrival is not None:
         # Parser-level rejection (the PR 5 rule): the arrival lane times
         # a DIFFERENT program (the backlog scheduler), so the flagship
@@ -869,6 +955,7 @@ def main() -> None:
             f"--byzantine={args.byzantine}"]
            if args.adversary != "off" else []) \
         + ([f"--fleet={args.fleet}"] if args.fleet is not None else []) \
+        + ([f"--mesh={args.mesh}"] if args.mesh is not None else []) \
         + ([f"--arrival={args.arrival}",
             f"--arrival-window={args.arrival_window}"]
            if args.arrival is not None else []) \

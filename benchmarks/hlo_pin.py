@@ -71,6 +71,22 @@ entry):
                      0, forced explicitly == the archived
                      flagship_async_coalesced pin) is covered by
                      `--verify-off-path`;
+  fleet_sharded    — the `bench.py --fleet 8 --mesh 2,2` program at
+                     flagship-mini shape: the fleet's TRIAL axis laid
+                     over a (2, 2) fleet mesh, each device scanning
+                     F/4 whole sims in place inside the one donated
+                     jit (`parallel/sharded_fleet.fleet_scan_program`
+                     — zero collectives; trials never communicate).
+                     `--verify-off-path` proves the mesh=1 spelling
+                     lowers byte-identical to the archived
+                     `fleet_small` pin AND the mesh=1 + fleet=1 +
+                     empty-stochastic spelling collapses all the way
+                     to the archived `flagship` pin (the whole
+                     off-path chain).  Lowering needs >= 4 devices —
+                     the CLI forces the 8-virtual-device CPU harness
+                     like benchmarks/mem_pin.py
+                     (GO_AVALANCHE_TPU_ANALYSIS_HW skips the forcing
+                     on hardware);
   flagship_traffic — the `bench.py --arrival` program: the streaming
                      backlog scheduler (`models/backlog.step`) under
                      live-traffic poisson arrival with closed-loop
@@ -142,6 +158,11 @@ FLEET_SMALL = dict(fleet=8, nodes=256, txs=256, rounds=20, k=8)
 # closed-loop admission (go_avalanche_tpu/traffic.py).
 TRAFFIC = dict(nodes=4096, txs=65536, window=1024, rounds=32, k=8,
                rate=24.0)
+# The fleet-of-sharded-sims shape (`bench.py --fleet 8 --mesh 2,2`):
+# the FLEET_SMALL workload with its trial axis laid over the (2, 2)
+# audit-sized fleet mesh — 2 trials per device
+# (go_avalanche_tpu/parallel/sharded_fleet.py).
+FLEET_SHARDED = dict(FLEET_SMALL, mesh=[2, 2])
 
 
 def flagship_stablehlo(nodes: int, txs: int, rounds: int, k: int,
@@ -229,6 +250,41 @@ def fleet_stablehlo(fleet: int, nodes: int, txs: int, rounds: int,
         state_abs).as_text()
 
 
+def fleet_sharded_stablehlo(fleet: int, nodes: int, txs: int,
+                            rounds: int, k: int, mesh,
+                            faults=None) -> str:
+    """StableHLO text of the `bench.py --fleet F --mesh A,B` program:
+    the fleet-stacked flagship state's TRIAL axis laid over an
+    ``(A, B)`` fleet mesh, each device scanning its F/D trials inside
+    the one donated jit (`bench.fleet_program(mesh=...)` — the timed
+    program itself, via `parallel/sharded_fleet.fleet_scan_program`).
+    A 1-device mesh COLLAPSES to `bench.fleet_program`'s dense
+    spelling, which is how `--verify-off-path` proves the off-path
+    chain (mesh=1 == the `fleet_small` pin; mesh=1 + fleet=1 == the
+    `flagship` pin).  `faults` follows `flagship_stablehlo`'s
+    convention.  Needs ``A*B`` devices (the CLI forces the virtual
+    8-device CPU harness, `_ensure_devices`)."""
+    import jax
+
+    import bench
+    from benchmarks.workload import flagship_config, fleet_flagship_state
+    from go_avalanche_tpu.parallel import sharded_fleet
+
+    cfg = flagship_config(txs, k)
+    if faults is not None:
+        from go_avalanche_tpu.config import fault_script_from_json
+
+        cfg = dataclasses.replace(cfg,
+                                  fault_script=fault_script_from_json(faults))
+    a, b = (int(x) for x in mesh)
+    fleet_mesh = sharded_fleet.make_fleet_mesh(a, b)
+    state_abs = jax.eval_shape(
+        lambda: fleet_flagship_state(fleet, nodes, txs, k)[0])
+    return bench.fleet_program(cfg, rounds, fleet,
+                               mesh=fleet_mesh).lower(
+        state_abs).as_text()
+
+
 def streaming_step_stablehlo(nodes: int, backlog_sets: int, set_cap: int,
                              window_sets: int, arrival=None,
                              stake=None) -> str:
@@ -308,6 +364,8 @@ PROGRAMS = {
                         lambda w: flagship_stablehlo(**w)),
     "fleet_small": (dict(FLEET_SMALL),
                     lambda w: fleet_stablehlo(**w)),
+    "fleet_sharded": (dict(FLEET_SHARDED),
+                      lambda w: fleet_sharded_stablehlo(**w)),
     "flagship_stake": (dict(FLAGSHIP, stake="zipf", clusters=4),
                        lambda w: flagship_stablehlo(**w)),
     "flagship_trace": (dict(FLAGSHIP, latency=2, inflight="coalesced",
@@ -338,6 +396,7 @@ PROGRAM_BUILDERS = {
     "flagship_trace": ("flagship_config", "flagship_state"),
     "flagship_adversary": ("flagship_config", "flagship_state"),
     "fleet_small": ("flagship_config", "fleet_flagship_state"),
+    "fleet_sharded": ("flagship_config", "fleet_flagship_state"),
     "flagship_traffic": ("traffic_config", "traffic_backlog_state"),
     "streaming_step": ("northstar_config", "northstar_state"),
 }
@@ -538,6 +597,47 @@ def verify_off_path(platform: str, archive: dict | None = None) -> list:
                 f"fleet=1 empty-stochastic program {current} != the "
                 f"flagship pin {pinned} — the fleet lane's f=1 spelling "
                 f"no longer times the pinned flagship program")
+    # The fleet-of-sharded-sims off-path chain: `fleet_sharded` with
+    # its mesh forced to 1 device must lower byte-identical to the
+    # archived `fleet_small` pin (the shard_map layer is the ONLY
+    # delta), and with fleet=1 + an explicitly-empty stochastic block
+    # forced too it must collapse all the way to the archived
+    # `flagship` pin — mesh sharding, fleet batching and the
+    # stochastic fault engine all statically absent down the chain.
+    entry = archive.get("programs", {}).get("fleet_sharded")
+    if entry:
+        # Each collapse compares AT THE BASE PIN'S OWN WORKLOAD (the
+        # fleet_small shape for the mesh=1 hop, the flagship shape for
+        # the mesh=1 + fleet=1 hop) — a hash can only ever match a pin
+        # lowered at the same dims.
+        small = archive.get("programs", {}).get("fleet_small")
+        if small and small.get("hashes", {}).get(platform):
+            workload = dict(entry.get("workload") or FLEET_SHARDED)
+            workload.update(dict(small.get("workload") or FLEET_SMALL),
+                            mesh=[1, 1])
+            current = program_hash("fleet_sharded", workload)
+            pinned = small["hashes"][platform]
+            if current != pinned:
+                failures.append(
+                    f"fleet_sharded with mesh forced to 1 device "
+                    f"hashes to {current} != the fleet_small pin "
+                    f"{pinned} — the trial-sharded program differs "
+                    f"from the dense fleet program by more than the "
+                    f"mesh layout")
+        flag = archive.get("programs", {}).get("flagship")
+        if flag and flag.get("hashes", {}).get(platform):
+            workload = dict(flag.get("workload") or FLAGSHIP)
+            workload.update(fleet=1, mesh=[1, 1], faults=[])
+            current = program_hash("fleet_sharded", workload)
+            pinned = flag["hashes"][platform]
+            if current != pinned:
+                failures.append(
+                    f"fleet_sharded with mesh=1 + fleet=1 + an "
+                    f"explicitly-empty stochastic block hashes to "
+                    f"{current} != the flagship pin {pinned} — the "
+                    f"off-path chain (mesh sharding, fleet batching, "
+                    f"stochastic faults all statically absent) is "
+                    f"broken")
     # The live-traffic lane's off path (PR 8): the streaming step with
     # the arrival plane forced off EXPLICITLY must lower to the
     # archived `streaming_step` pin byte-identical — the traffic layer
@@ -557,6 +657,25 @@ def verify_off_path(platform: str, archive: dict | None = None) -> list:
                 f"live-traffic plane or the stake subsystem leaks "
                 f"into the disabled streaming program")
     return failures
+
+
+def _ensure_devices() -> None:
+    """The `fleet_sharded` pin lowers over a 2x2 fleet mesh; mirror
+    benchmarks/mem_pin.py's virtual 8-device CPU setup so the CLI runs
+    on any box (forced after the jax import — see tests/conftest.py's
+    NOTE about the axon plugin).  `GO_AVALANCHE_TPU_ANALYSIS_HW` skips
+    the forcing to pin on real hardware."""
+    import os
+
+    if os.environ.get("GO_AVALANCHE_TPU_ANALYSIS_HW"):
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 
 def _load_archive() -> dict:
@@ -649,6 +768,7 @@ def main() -> None:
               f"pins have live builders")
         return
 
+    _ensure_devices()
     import jax
 
     platform = jax.default_backend()

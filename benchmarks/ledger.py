@@ -186,9 +186,12 @@ def gate(rows: List[Dict], band: float = DEFAULT_BAND
     REFUSED from comparison (listed, never compared); adjacent
     comparable rows with DIFFERENT backends are a hard failure (the
     r04/r05 class: a trajectory must not change backend mid-chain —
-    open a new lane or re-measure); same-backend adjacent rows gate on
-    the noise band (a drop beyond it is a regression failure, growth
-    is reported)."""
+    open a new lane or re-measure), and so are rows whose recorded
+    DEVICE COUNTS differ (the fleet-sharded miniature of the same
+    class: a 1-device fleet row vs an 8-device mesh row; the mesh tag
+    normally separates the lanes, this catches tag-free collisions);
+    same-backend adjacent rows gate on the noise band (a drop beyond
+    it is a regression failure, growth is reported)."""
     failures: List[str] = []
     refused: List[str] = []
     report: List[str] = []
@@ -228,6 +231,20 @@ def gate(rows: List[Dict], band: float = DEFAULT_BAND
                     f"{prev['backend']}, {_rowid(cur)} on "
                     f"{cur['backend']}; a trajectory must not change "
                     f"backend mid-chain (the BENCH r04/r05 footgun)")
+                continue
+            pd, cd = _device_count(prev), _device_count(cur)
+            if pd is not None and cd is not None and pd != cd:
+                # The fleet-sharded class of the r04/r05 footgun in
+                # miniature: a 1-device fleet row and an 8-device row
+                # measure different machines even on one backend.  The
+                # mesh tag normally keeps them in separate lanes; rows
+                # that still collide here (a tag-free artifact, a
+                # hand-edited metric) are a hard error, never compared.
+                failures.append(
+                    f"lane {lane!r}: device-topology change mid-chain "
+                    f"— {_rowid(prev)} ran on {pd} device(s), "
+                    f"{_rowid(cur)} on {cd}; open a new lane (the "
+                    f"mesh tag) or re-measure")
                 continue
             delta = (cur["value"] - prev["value"]) / prev["value"]
             line = (f"lane {lane!r} [{cur['backend']}]: "
@@ -274,6 +291,17 @@ def table(rows: List[Dict]) -> str:
         lines.append(f"{rid:>5} {_human(row['value']):>10} "
                      f"{backend:>8} {delta:>8}  {note}".rstrip())
     return "\n".join(lines)
+
+
+def _device_count(row: Dict) -> Optional[int]:
+    """The row's recorded device count (None for pre-PR-14 artifacts
+    without a `devices` field — those still compare; only an OBSERVED
+    topology change hard-fails)."""
+    devices = row.get("devices")
+    if isinstance(devices, dict):
+        n = devices.get("device_count")
+        return int(n) if isinstance(n, int) else None
+    return None
 
 
 def _rowid(row: Dict) -> str:

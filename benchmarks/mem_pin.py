@@ -92,9 +92,22 @@ def harvest(name: str, workload=None) -> dict:
 
     workload = dict(workload or hlo_pin.PROGRAMS[name][0])
     lowered, state_abs = hlo_audit.lower_pinned(name, workload)
+    if name == "fleet_sharded":
+        # The trial axis shards over the pin's fleet mesh, so the
+        # analytic side accounts PER-DEVICE shard shapes (every leaf
+        # under FLEET_SPEC) — same arithmetic as the sharded_* driver
+        # entries, against the same compiled per-device record.
+        from go_avalanche_tpu.parallel import sharded_fleet
+
+        a, b = (int(x) for x in workload["mesh"])
+        mesh = sharded_fleet.make_fleet_mesh(a, b)
+        fp = resources.footprint(
+            state_abs, sharded_fleet.fleet_state_specs(state_abs), mesh)
+    else:
+        fp = resources.footprint(state_abs)
     return {
         "record": resources.memory_record(lowered.compile()),
-        "footprint": resources.footprint(state_abs),
+        "footprint": fp,
         "hlo": hlo_pin.hlo_hash(lowered.as_text()),
     }
 

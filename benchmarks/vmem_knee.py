@@ -81,31 +81,64 @@ def per_trial_footprint(nt: int, k: int = 8) -> int:
     return resources.footprint(state_abs)["total_bytes"]
 
 
-def temp_ratio_for(profile: dict) -> dict:
+# The archived memory records a platform's temp ratio is harvested
+# from, most-preferred first: the fleet program IS the workload this
+# table sizes (`fleet_small` is its committed CPU spelling), so its
+# scratch-per-state ratio is the measured source; the ORDER is the
+# only policy — the first TPU `mem_pin.py --update` appends a record
+# and this table re-derives without a code change (tests feed a
+# synthetic record through `record=` to pin that property).
+RATIO_SOURCES = ("fleet_small",)
+
+
+def temp_ratio_for(profile: dict, record: dict | None = None) -> dict:
     """``{"ratio": float, "source": str}`` — the XLA scratch-per-state
-    ratio: harvested from the archived `fleet_small` memory record for
-    this platform when one exists (temp / argument bytes), else the
-    profile's provisional default."""
-    try:
-        archive = json.loads(MEM_PIN.read_text())
-        rec = archive["programs"]["fleet_small"]["records"][
-            profile["platform"]]
-        return {"ratio": rec["temp_bytes"] / rec["argument_bytes"],
-                "source": f"mem_pin.json fleet_small "
-                          f"[{profile['platform']}]"}
-    except (OSError, KeyError, ValueError, ZeroDivisionError):
-        return {"ratio": profile["default_temp_ratio"],
-                "source": "profile default (PROVISIONAL — no "
-                          "mem_pin record for this platform yet; the "
-                          "hardware window's mem_pin.py --update "
-                          "re-derives this table)"}
+    ratio (temp / argument bytes).
+
+    Source precedence: an explicit MEASURED `record` (a
+    `obs.resources.memory_record` dict — how a fresh harvest or a unit
+    test re-derives the table without touching the archive), else the
+    archived `mem_pin.json` record for this platform (`RATIO_SOURCES`
+    order), else the profile's provisional default.  A malformed or
+    zero-argument ARCHIVED record falls through to the next source
+    rather than crashing the sweep; an explicit `record` with the same
+    defect is a caller error and raises (the wording
+    tests/test_sharded_fleet.py pins).
+    """
+    if record is not None:
+        try:
+            return {"ratio": record["temp_bytes"]
+                    / record["argument_bytes"],
+                    "source": "explicit measured record"}
+        except (KeyError, TypeError, ZeroDivisionError):
+            raise ValueError(
+                "temp_ratio_for: an explicit record needs numeric "
+                "temp_bytes and non-zero argument_bytes "
+                "(obs.resources.memory_record)")
+    for name in RATIO_SOURCES:
+        try:
+            archive = json.loads(MEM_PIN.read_text())
+            rec = archive["programs"][name]["records"][
+                profile["platform"]]
+            return {"ratio": rec["temp_bytes"] / rec["argument_bytes"],
+                    "source": f"mem_pin.json {name} "
+                              f"[{profile['platform']}]"}
+        except (OSError, KeyError, ValueError, ZeroDivisionError):
+            continue
+    return {"ratio": profile["default_temp_ratio"],
+            "source": "profile default (PROVISIONAL — no "
+                      "mem_pin record for this platform yet; the "
+                      "hardware window's mem_pin.py --update "
+                      "re-derives this table)"}
 
 
 def knee_table(profile_name: str, fleets=FLEETS, squares=SQUARES,
-               k: int = 8) -> dict:
-    """The largest-safe-shape table for one device profile."""
+               k: int = 8, mem_record: dict | None = None) -> dict:
+    """The largest-safe-shape table for one device profile.
+    `mem_record` re-derives it from an explicit measured memory record
+    instead of the archived/default ratio (`temp_ratio_for`)."""
     profile = DEVICE_PROFILES[profile_name]
-    tr = temp_ratio_for(profile)
+    tr = temp_ratio_for(profile, record=mem_record)
     budget = profile["hbm_bytes"] * HEADROOM
     per_trial = {nt: per_trial_footprint(nt, k) for nt in squares}
 
@@ -140,6 +173,98 @@ def knee_table(profile_name: str, fleets=FLEETS, squares=SQUARES,
         rows.append(row)
     return {"profile": profile_name, **profile, "headroom": HEADROOM,
             "temp_ratio": tr, "k": k, "rows": rows}
+
+
+# jax platform -> the knee-table profile that models it (the active
+# device profile `run_sim --fleet-shape auto` resolves against).
+PLATFORM_PROFILES = {"tpu": "v5e-8", "cpu": "cpu-ci"}
+
+
+def _cite(profile: str) -> str:
+    return f"benchmarks/{OUT.name} [{profile}]"
+
+
+def select_fleet_shape(platform: str, devices: int, nodes: int,
+                       txs: int, fleet: int | None = None,
+                       tables: dict | None = None) -> dict:
+    """Knee-table-driven fleet sizing (`run_sim --fleet-shape auto`).
+
+    Resolves the active device profile from the jax `platform`, then —
+    against the ARCHIVED table (`vmem_knee.json`; pass `tables` to
+    test) at the requested ``N = nodes, T = txs`` square:
+
+      * ``fleet=None`` — PICK the shape: the deepest trials-per-device
+        row whose ``largest_nt`` still fits the shape, scaled by the
+        actual `devices` count (the fleet mesh's, not the profile's).
+        Returns ``{"fleet", "trials_per_device", "profile", "row"}``.
+      * ``fleet`` given — VALIDATE it: the binding row is the
+        shallowest ``trials_per_device >= ceil(fleet / devices)``; a
+        shape above that row's knee raises `ValueError` CITING the
+        table row (the acceptance wording — the error names the file,
+        profile, row and the knee it crossed).
+
+    Raises `ValueError` (funnelled into `parser.error`) when the
+    platform has no profile, the archive has no table, or nothing
+    fits.
+    """
+    profile = PLATFORM_PROFILES.get(platform)
+    if profile is None:
+        raise ValueError(
+            f"--fleet-shape auto: no knee-table device profile models "
+            f"platform {platform!r} (profiles: "
+            f"{', '.join(sorted(PLATFORM_PROFILES.values()))})")
+    if tables is None:
+        try:
+            tables = json.loads(OUT.read_text()).get("tables", {})
+        except (OSError, ValueError) as e:
+            raise ValueError(f"--fleet-shape auto: cannot read "
+                             f"benchmarks/{OUT.name}: {e}")
+    table = tables.get(profile)
+    if table is None:
+        raise ValueError(
+            f"--fleet-shape auto: no archived knee table for profile "
+            f"{profile!r} in benchmarks/{OUT.name} — run "
+            f"`python benchmarks/vmem_knee.py --update`")
+    if devices < 1:
+        raise ValueError(f"--fleet-shape auto needs >= 1 device, got "
+                         f"{devices}")
+    nt = max(int(nodes), int(txs))
+    rows = [r for r in table.get("rows", [])
+            if r.get("largest_nt") is not None]
+    if fleet is None:
+        fitting = [r for r in rows if r["largest_nt"] >= nt]
+        if not fitting:
+            best = max((r["largest_nt"] for r in rows), default=0)
+            raise ValueError(
+                f"--fleet-shape auto: {nodes}x{txs} exceeds every "
+                f"knee in {_cite(profile)} (largest safe square even "
+                f"at 1 trial/device: {best}²) — shrink the shape or "
+                f"re-derive the table")
+        row = max(fitting, key=lambda r: r["trials_per_device"])
+        return {"fleet": row["trials_per_device"] * devices,
+                "trials_per_device": row["trials_per_device"],
+                "profile": profile, "row": row}
+    per_chip = math.ceil(fleet / devices)
+    binding = [r for r in rows if r["trials_per_device"] >= per_chip]
+    if not binding:
+        deepest = max((r["trials_per_device"] for r in rows), default=0)
+        raise ValueError(
+            f"--fleet-shape auto: fleet {fleet} over {devices} "
+            f"device(s) is {per_chip} trials/chip — beyond every row "
+            f"of {_cite(profile)} (deepest swept: {deepest} "
+            f"trials/chip)")
+    row = min(binding, key=lambda r: r["trials_per_device"])
+    if nt > row["largest_nt"]:
+        raise ValueError(
+            f"--fleet-shape auto: {nodes}x{txs} at {per_chip} "
+            f"trials/chip is ABOVE the VMEM/HBM knee — {_cite(profile)}"
+            f" caps the {row['trials_per_device']} trials/chip row at "
+            f"{row['largest_nt']}² (modeled live peak "
+            f"{row['modeled_live_peak_bytes'] / GIB:.1f} GiB, temp "
+            f"ratio source: {table['temp_ratio']['source']}) — shrink "
+            f"the shape, the fleet, or grow the mesh")
+    return {"fleet": fleet, "trials_per_device": per_chip,
+            "profile": profile, "row": row}
 
 
 def render(table: dict) -> str:

@@ -64,8 +64,12 @@ def run_audit(compile_donation: bool = True) -> list:
     failures += hlo_audit.audit_off_path(platform, archive)
     try:
         # One pass over the five drivers; compile_donation rides along
-        # so nothing is lowered (or reported) twice.
+        # so nothing is lowered (or reported) twice.  The sharded-fleet
+        # pair (driver + bench scan, parallel/sharded_fleet.py) audits
+        # on its own 2x2 trials-mesh alongside.
         failures += hlo_audit.audit_all_sharded(
+            compile_donation=compile_donation)
+        failures += hlo_audit.audit_sharded_fleet(
             compile_donation=compile_donation)
     except hlo_audit.AuditUnavailable as e:
         failures.append(f"sharded audit unavailable: {e}")

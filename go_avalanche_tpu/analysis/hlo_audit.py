@@ -442,8 +442,8 @@ def small_workload(name: str) -> Dict:
     from benchmarks import hlo_pin
 
     workload = dict(hlo_pin.PROGRAMS[name][0])
-    if name == "fleet_small":
-        workload.update(_SMALL_FLEET)
+    if name in ("fleet_small", "fleet_sharded"):
+        workload.update(_SMALL_FLEET)   # fleet_sharded keeps its mesh
     elif name == "flagship_traffic":
         workload.update(_SMALL_TRAFFIC)
     elif name == "streaming_step":
@@ -461,7 +461,7 @@ def pinned_donated_leaves(name: str, workload: Dict) -> int:
 
     from benchmarks import workload as wl
 
-    if name == "fleet_small":
+    if name in ("fleet_small", "fleet_sharded"):
         state = jax.eval_shape(lambda: wl.fleet_flagship_state(
             workload["fleet"], workload["nodes"], workload["txs"],
             workload["k"])[0])
@@ -608,13 +608,20 @@ def lower_pinned(name: str, workload: Dict):
             track_finality=False)[0])
         return (jax.jit(lambda s: sdg.step(s, cfg)[0]).lower(state_abs),
                 state_abs)
-    if name == "fleet_small":
+    if name in ("fleet_small", "fleet_sharded"):
         cfg = flagship_config(workload["txs"], workload["k"])
         state_abs = jax.eval_shape(lambda: fleet_flagship_state(
             workload["fleet"], workload["nodes"], workload["txs"],
             workload["k"])[0])
+        mesh = None
+        if name == "fleet_sharded":
+            from go_avalanche_tpu.parallel import sharded_fleet
+
+            a, b = (int(x) for x in workload["mesh"])
+            mesh = sharded_fleet.make_fleet_mesh(a, b)
         lowered = bench.fleet_program(cfg, workload["rounds"],
-                                      workload["fleet"]).lower(state_abs)
+                                      workload["fleet"],
+                                      mesh=mesh).lower(state_abs)
         return lowered, state_abs
     elif name == "flagship_traffic":
         cfg = traffic_config(workload["window"], workload["k"],
@@ -886,6 +893,102 @@ def audit_all_sharded(compile_donation: bool = False) -> List[str]:
     return failures
 
 
+def _fleet_audit_mesh():
+    """A 2x2 ``(trials, nodes)`` fleet mesh over the first 4 devices —
+    the sharded-fleet twin of `_audit_mesh` (distinct replica grouping
+    per axis subset, so collective attribution is unambiguous)."""
+    import jax
+
+    from go_avalanche_tpu.parallel import sharded_fleet
+
+    devices = jax.devices()
+    if len(devices) < 4:
+        raise AuditUnavailable(
+            f"the sharded-fleet audit needs >= 4 devices for its 2x2 "
+            f"fleet mesh, found {len(devices)} — run under the tier-1 "
+            f"harness (8 virtual CPU devices) or on hardware")
+    return sharded_fleet.make_fleet_mesh(2, 2, devices=devices[:4])
+
+
+def audit_sharded_fleet(compile_donation: bool = False) -> List[str]:
+    """Contract audit of BOTH fleet-of-sharded-sims programs on the
+    2x2 fleet mesh (`parallel/sharded_fleet.py`):
+
+      * the DRIVER (`fleet_driver_program`, the `run_fleet(mesh=...)`
+        seam, lowered through `fleet._compiled_sharded_fleet` — the
+        exact lru-cached jit the runner executes): per-trial gathers
+        and count psums over the declared trial axes and NOTHING else
+        (a collective touching an [N, T] plane means one trial leaked
+        into another's stream), zero callbacks, clean dtypes, and —
+        union equality — every `DECLARED_COLLECTIVES` entry actually
+        lowered (stale-manifest check, like `audit_sharded`);
+      * the BENCH scan (`fleet_scan_program`, the `fleet_sharded`
+        pin): ZERO collectives (trials never communicate — the
+        embarrassing parallelism IS the contract) and full donation
+        coverage, `compile_donation=True` additionally proving the
+        executable's ``input_output_alias`` covers every fleet-stacked
+        leaf (the donation-under-vmap contract's static half at mesh
+        scale; the RUNTIME soak lives in tests/test_sharded_fleet.py).
+    """
+    import jax
+
+    from benchmarks.hlo_pin import strip_locations
+    from benchmarks.workload import flagship_config, fleet_flagship_state
+    from go_avalanche_tpu import fleet as fl
+    from go_avalanche_tpu.parallel import sharded_fleet
+    from go_avalanche_tpu.parallel.mesh import NODES_AXIS
+
+    mesh = _fleet_audit_mesh()
+    mesh_axes = [(sharded_fleet.TRIALS_AXIS, 2), (NODES_AXIS, 2)]
+    failures: List[str] = []
+
+    # --- the driver program (keys -> gathered outcomes + counts).
+    from go_avalanche_tpu.config import AvalancheConfig
+
+    cfg = AvalancheConfig(finalization_score=16)
+    driver = fl.compiled_fleet_program("avalanche", cfg, 16, 8, 2, 2,
+                                       0.5, True, 64, mesh=mesh)
+    keys_abs = jax.eval_shape(
+        lambda: jax.random.split(jax.random.key(0), 8))
+    text = strip_locations(driver.lower(keys_abs).as_text())
+    failures.extend(audit_text(
+        text, "sharded_fleet[driver]", callbacks=0, donated_leaves=None,
+        collectives=sharded_fleet.DECLARED_COLLECTIVES,
+        mesh_axes=mesh_axes, plane_elems=16 * 8))
+    observed, _ = observed_collectives(text, mesh_axes)
+    for kind, axes in sorted(sharded_fleet.DECLARED_COLLECTIVES
+                             - observed):
+        failures.append(
+            f"sharded_fleet: declared collective {kind} over axes "
+            f"{'/'.join(axes)} never lowered in the driver program — "
+            f"stale manifest entry")
+
+    # --- the bench scan program (the fleet_sharded pin's family).
+    import bench
+
+    bcfg = flagship_config(32, 8)
+    state_abs = jax.eval_shape(
+        lambda: fleet_flagship_state(8, 32, 32, 8)[0])
+    scan = bench.fleet_program(bcfg, 2, 8, mesh=mesh)
+    lowered = scan.lower(state_abs)
+    stext = strip_locations(lowered.as_text())
+    leaves = len(jax.tree.leaves(state_abs))
+    failures.extend(audit_text(
+        stext, "sharded_fleet[bench-scan]", callbacks=0,
+        donated_leaves=leaves, collectives=frozenset(),
+        mesh_axes=mesh_axes, plane_elems=32 * 32))
+    if compile_donation:
+        c_aliased = compiled_alias_count(lowered.compile().as_text())
+        if c_aliased != leaves:
+            failures.append(
+                f"sharded_fleet[bench-scan]: compiled "
+                f"input_output_alias covers {c_aliased} of {leaves} "
+                f"donated fleet-stacked leaves — the trial planes "
+                f"double-buffer (the donation-under-vmap contract at "
+                f"mesh scale)")
+    return failures
+
+
 # --------------------------------------------------------- run_sim audit
 
 
@@ -909,15 +1012,36 @@ def audit_run_sim(args, cfg) -> List[str]:
     if args.fleet is not None:
         from go_avalanche_tpu import fleet as fl
 
+        fleet_mesh = getattr(args, "fleet_mesh", None)
         keys_abs = jax.eval_shape(
             lambda: jax.random.split(jax.random.key(args.seed),
                                      args.fleet))
-        jitted = fl._compiled_fleet(
-            args.model, cfg, int(args.nodes), int(args.txs),
-            int(args.max_rounds), int(args.conflict_size),
-            float(args.yes_fraction), bool(args.contested),
-            int(args.slots))
+        jitted = fl.compiled_fleet_program(
+            args.model, cfg, args.nodes, args.txs, args.max_rounds,
+            args.conflict_size, args.yes_fraction, args.contested,
+            args.slots, mesh=fleet_mesh)
         text = strip_locations(jitted.lower(keys_abs).as_text())
+        if fleet_mesh is not None and fleet_mesh.devices.size > 1:
+            # The trial-sharded driver: collectives on the declared
+            # trial axes only (partition-based, so degenerate meshes
+            # like 4,1 attribute correctly), plane guard included.
+            from go_avalanche_tpu.parallel import sharded_fleet
+            from go_avalanche_tpu.parallel.mesh import NODES_AXIS
+
+            mesh_axes = [
+                (sharded_fleet.TRIALS_AXIS,
+                 fleet_mesh.shape[sharded_fleet.TRIALS_AXIS]),
+                (NODES_AXIS, fleet_mesh.shape[NODES_AXIS])]
+            failures = collective_coverage_failures(
+                text, sharded_fleet.DECLARED_COLLECTIVES, mesh_axes,
+                f"{what}@fleet{args.fleet}-mesh")
+            failures.extend(audit_text(
+                text, f"{what}@fleet{args.fleet}-mesh", callbacks=0,
+                donated_leaves=None,
+                collectives=sharded_fleet.DECLARED_COLLECTIVES,
+                mesh_axes=mesh_axes,
+                plane_elems=args.nodes * args.txs))
+            return failures
         return audit_text(text, f"{what}@fleet{args.fleet}",
                           callbacks=0, donated_leaves=None)
 

@@ -360,18 +360,17 @@ def _outcome_backlog(state, cfg: AvalancheConfig) -> TrialOutcome:
 # The fleet program: vmap(init -> scan(round_step) -> reduce) over keys.
 
 
-@functools.lru_cache(maxsize=16)  # bounded, like models/avalanche's jits
-def _compiled_fleet(model: str, cfg: AvalancheConfig, n_nodes: int,
-                    n_txs: int, n_rounds: int, conflict_size: int,
-                    yes_fraction: float, contested: bool, window: int):
-    """One jitted ``keys [F] -> (TrialOutcome [F], telemetry [F, R],
-    trace [F, S, M] | None)`` program — the whole sim (init included)
-    lives inside the vmap, so a fleet is one compile and one dispatch
-    per config point.  With `cfg.trace_every > 0` each trial carries
-    its own on-device trace plane (obs/trace.py) — the vmap lifts the
-    ``[S, M]`` buffer to PER-TRIAL ``[F, S, M]`` traces, which is what
-    the in-graph metrics tap could never do (an io_callback has no
-    per-trial identity under vmap)."""
+def _trial_fn(model: str, cfg: AvalancheConfig, n_nodes: int,
+              n_txs: int, n_rounds: int, conflict_size: int,
+              yes_fraction: float, contested: bool, window: int):
+    """The per-key whole-sim trial program: ``key -> (TrialOutcome,
+    telemetry [R], trace [S, M] | None)`` — init, the full `round_step`
+    scan and the in-graph outcome reduction, nothing else.  ONE
+    closure, shared by the dense fleet (`_compiled_fleet` vmaps it) and
+    the trial-sharded fleet (`parallel/sharded_fleet.fleet_driver_
+    program` vmaps each device's key slice): the dense-vs-sharded
+    bit-parity is a refactoring invariant, not two copies kept in
+    sync."""
 
     def trial(key):
         if model == "snowball":
@@ -435,7 +434,74 @@ def _compiled_fleet(model: str, cfg: AvalancheConfig, n_nodes: int,
         final, tel = lax.scan(body, state, None, length=n_rounds)
         return outcome(final, cfg), tel, trace_of(final)
 
-    return jax.jit(jax.vmap(trial))
+    return trial
+
+
+@functools.lru_cache(maxsize=16)  # bounded, like models/avalanche's jits
+def _compiled_fleet(model: str, cfg: AvalancheConfig, n_nodes: int,
+                    n_txs: int, n_rounds: int, conflict_size: int,
+                    yes_fraction: float, contested: bool, window: int):
+    """One jitted ``keys [F] -> (TrialOutcome [F], telemetry [F, R],
+    trace [F, S, M] | None)`` program — the whole sim (init included)
+    lives inside the vmap, so a fleet is one compile and one dispatch
+    per config point.  With `cfg.trace_every > 0` each trial carries
+    its own on-device trace plane (obs/trace.py) — the vmap lifts the
+    ``[S, M]`` buffer to PER-TRIAL ``[F, S, M]`` traces, which is what
+    the in-graph metrics tap could never do (an io_callback has no
+    per-trial identity under vmap)."""
+    return jax.jit(jax.vmap(_trial_fn(
+        model, cfg, n_nodes, n_txs, n_rounds, conflict_size,
+        yes_fraction, contested, window)))
+
+
+@functools.lru_cache(maxsize=16)
+def _compiled_sharded_fleet(model: str, cfg: AvalancheConfig,
+                            n_nodes: int, n_txs: int, n_rounds: int,
+                            conflict_size: int, yes_fraction: float,
+                            contested: bool, window: int, mesh):
+    """The trial-SHARDED twin of `_compiled_fleet`: the same per-trial
+    program laid over a fleet mesh (`parallel/sharded_fleet`) — keys
+    sharded ``P(('trials', 'nodes'))``, each device vmapping its F/D
+    slice, per-trial vectors all-gathered and summary counts psum'd
+    in-graph.  Keyed on the mesh too (`jax.sharding.Mesh` hashes by
+    device grid + axis names), so a phase grid re-jits per config point
+    exactly like the dense cache — the retrace guard
+    (`analysis/retrace.guard_fleet_point`) reads whichever cache the
+    point's mesh selects."""
+    from go_avalanche_tpu.parallel import sharded_fleet
+
+    return sharded_fleet.fleet_driver_program(mesh, _trial_fn(
+        model, cfg, n_nodes, n_txs, n_rounds, conflict_size,
+        yes_fraction, contested, window))
+
+
+def _fleet_cache(mesh):
+    """The compiled-program cache a (mesh | None) selection uses — the
+    one dispatch spelling shared by `run_fleet`, `run_phase_grid`'s
+    retrace guard and `run_sim --audit`.  A 1-device mesh COLLAPSES to
+    the dense program (the off-path identity `hlo_pin.py
+    --verify-off-path` pins for the bench twin)."""
+    from go_avalanche_tpu.parallel import sharded_fleet
+
+    return (_compiled_sharded_fleet
+            if sharded_fleet.mesh_devices(mesh) > 1 else _compiled_fleet)
+
+
+def compiled_fleet_program(model: str, cfg: AvalancheConfig,
+                           n_nodes: int, n_txs: int, n_rounds: int,
+                           conflict_size: int, yes_fraction: float,
+                           contested: bool, window: int, mesh=None):
+    """The jitted fleet program a (config point, mesh | None) selection
+    executes — dense vmap or the trial-sharded driver.  `run_sim
+    --audit` / `--report-memory` lower through THIS (the lru-cached
+    jits the run executes), so the audited program compiles exactly
+    once at execution."""
+    cache = _fleet_cache(mesh)
+    args = (model, cfg, int(n_nodes), int(n_txs), int(n_rounds),
+            int(conflict_size), float(yes_fraction), bool(contested),
+            int(window))
+    return cache(*args) if cache is _compiled_fleet else cache(*args,
+                                                               mesh)
 
 
 @dataclasses.dataclass
@@ -577,6 +643,7 @@ def run_fleet(
     yes_fraction: float = 0.5,
     contested: bool = True,
     window: int = 64,
+    mesh=None,
 ) -> FleetResult:
     """Run `fleet` independent trials of one config point as ONE
     vmapped program; reduce to Wilson-CI estimates.
@@ -590,6 +657,14 @@ def run_fleet(
     realizes its own arrival stream and reports finality-latency
     percentiles, which is what lets a phase grid sweep OFFERED LOAD
     (`arrival_rate`) into a capacity diagram.
+
+    `mesh` (a `parallel.sharded_fleet.make_fleet_mesh` mesh) lays the
+    TRIAL axis across its devices — D devices each run F/D whole sims
+    in one compiled program, bit-identical to the dense fleet on the
+    same seeds (per-trial keys, vectors, realizations and traces are
+    the dense ones, reassembled in key order; the psum'd in-graph
+    summary counts are cross-checked against them).  F must divide by
+    the device count; a 1-device mesh collapses to the dense program.
     """
     if model not in FLEET_MODELS:
         raise ValueError(f"fleet models are {', '.join(FLEET_MODELS)}, "
@@ -634,11 +709,20 @@ def run_fleet(
     if model == "dag" and n_txs % conflict_size:
         raise ValueError(f"n_txs ({n_txs}) must divide by conflict_size "
                          f"({conflict_size})")
+    from go_avalanche_tpu.parallel import sharded_fleet
+
+    sharded = sharded_fleet.mesh_devices(mesh) > 1
+    if sharded:
+        sharded_fleet.check_fleet_divisible(fleet, mesh)
     keys = jax.random.split(jax.random.key(seed), fleet)
-    outcome, telemetry, trace_buf = _compiled_fleet(
-        model, cfg, int(n_nodes), int(n_txs), int(n_rounds),
-        int(conflict_size), float(yes_fraction), bool(contested),
-        int(window))(keys)
+    program = compiled_fleet_program(
+        model, cfg, n_nodes, n_txs, n_rounds, conflict_size,
+        yes_fraction, contested, window, mesh=mesh)
+    counts = None
+    if sharded:
+        outcome, counts, telemetry, trace_buf = program(keys)
+    else:
+        outcome, telemetry, trace_buf = program(keys)
     violations = np.asarray(jax.device_get(outcome.violation))
     settled = np.asarray(jax.device_get(outcome.settled))
     stalled = np.asarray(jax.device_get(outcome.stalled))
@@ -659,6 +743,25 @@ def run_fleet(
              np.asarray(jax.device_get(outcome.region_end)),
              np.asarray(jax.device_get(outcome.region_cluster))],
             axis=-1)
+    if counts is not None:
+        # The sharded fleet's psum'd in-graph summary counts vs the
+        # all-gathered per-trial vectors (the PR-8 sharded
+        # self-consistency pattern): a mismatch means the trial gather
+        # reordered or dropped a trial — fail loudly rather than emit a
+        # phase row whose counts and vectors disagree.
+        got = {"trials": int(jax.device_get(counts.trials)),
+               "violations": int(jax.device_get(counts.violations)),
+               "settled": int(jax.device_get(counts.settled)),
+               "stalled": int(jax.device_get(counts.stalled))}
+        want = {"trials": fleet, "violations": int(violations.sum()),
+                "settled": int(settled.sum()),
+                "stalled": int(stalled.sum())}
+        if got != want:
+            raise RuntimeError(
+                f"sharded-fleet summary counts diverged from the "
+                f"gathered per-trial vectors: psum'd {got} vs gathered "
+                f"{want} — the trial axis lost its identity "
+                f"(parallel/sharded_fleet.py)")
     lat_percentiles = arrived = None
     if outcome.lat_p50 is not None:
         lat_percentiles = np.stack(
@@ -884,6 +987,7 @@ def run_phase_grid(
     contested: bool = True,
     window: int = 64,
     sink=None,
+    mesh=None,
 ) -> List[Dict]:
     """Sweep a phase grid: one `run_fleet` per cartesian point (re-jit
     per point — the config is jit-static), returning one summary row
@@ -891,7 +995,9 @@ def run_phase_grid(
     lands — the phase-diagram JSONL, each row carrying its `point`,
     the fleet estimates, the per-trial REALIZED stochastic fault
     schedules (`FleetResult.realizations`; absent without stochastic
-    events), and the point config's `tag_from_config` tag.
+    events), and the point config's `tag_from_config` tag.  `mesh`
+    lays every point's trial axis across a fleet mesh (`run_fleet`);
+    rows are bit-identical to the dense sweep's.
     """
     from go_avalanche_tpu.obs import tag_from_config
 
@@ -943,22 +1049,24 @@ def run_phase_grid(
     from go_avalanche_tpu.analysis import retrace
 
     rows = []
+    cache = _fleet_cache(mesh)
     for point in points:
         cfg = point_config(base_cfg, point)
         # One compile per config point is the fleet's whole
-        # dispatch-amortization premise (PR 7): `_compiled_fleet` may
-        # TRACE at most once per point (zero for a repeated point —
-        # lru hit).  More means the config stopped being a stable
-        # jit-static cache key; fail the sweep rather than silently
-        # recompile per trial batch (analysis/retrace.py).
-        misses_before = _compiled_fleet.cache_info().misses
+        # dispatch-amortization premise (PR 7): the active fleet cache
+        # (dense or mesh-keyed sharded — `_fleet_cache`) may TRACE at
+        # most once per point (zero for a repeated point — lru hit).
+        # More means the config stopped being a stable jit-static
+        # cache key; fail the sweep rather than silently recompile per
+        # trial batch (analysis/retrace.py).
+        misses_before = cache.cache_info().misses
         res = run_fleet(model, cfg, fleet, n_nodes, n_txs=n_txs,
                         n_rounds=n_rounds, seed=seed,
                         conflict_size=conflict_size,
                         yes_fraction=yes_fraction, contested=contested,
-                        window=window)
+                        window=window, mesh=mesh)
         retrace.guard_fleet_point(
-            misses_before, _compiled_fleet.cache_info().misses, point)
+            misses_before, cache.cache_info().misses, point)
         row = {"point": point, **res.summary(),
                "tag": tag_from_config(cfg)}
         realized = res.realizations()

@@ -1,0 +1,236 @@
+"""Fleet-of-sharded-sims: the Monte-Carlo TRIAL axis laid across the mesh.
+
+The fleet (`go_avalanche_tpu/fleet.py`) vmaps WHOLE sims over a batched
+seed axis — one compiled program per config point, but one device.  The
+sharded drivers (`parallel/sharded*.py`) shard ONE sim's node/tx planes
+— many devices, one trajectory.  This module composes them along the
+axis the statistics actually need: the trial axis ``F`` is laid out
+over the mesh (``P(('trials', 'nodes'))`` — the 2-D spelling; a 1-D
+``P('trials')`` mesh is the ``n_node_shards=1`` special case), so ``D``
+devices each run ``F/D`` whole DENSE sims — init-from-key, the full
+`round_step` scan, and the in-graph outcome reduction — inside ONE
+compiled program per config point.
+
+Because each trial's computation is the dense per-trial program
+unchanged (the vmap merely partitions the batch), the sharded fleet is
+BIT-IDENTICAL to the dense fleet on the same seeds — the established
+dense-vs-sharded acceptance pattern, pinned by
+tests/test_sharded_fleet.py (outcome vectors, realizations and trace
+planes leaf-exact; summary rows identical).  Wilson CIs stay host-side
+and unchanged.
+
+Two program families share the mesh:
+
+  * `fleet_driver_program` — the `fleet.run_fleet(mesh=...)` seam:
+    ``keys [F] -> (TrialOutcome [F], FleetCounts, telemetry [F, R],
+    trace [F, S, M] | None)``.  Per-trial vectors are **all-gathered**
+    over the trial axes (every device — and the host — reads the same
+    ``[F]`` vectors the dense fleet produces) and the summary counts
+    are **psum'd** in-graph (`FleetCounts`), cross-checked against the
+    gathered vectors by `run_fleet` (the PR-8 sharded self-consistency
+    pattern).
+  * `fleet_scan_program` — the `bench.py --fleet F --mesh A,B` timed
+    program (pinned as `fleet_sharded`): a fleet-stacked flagship
+    state, DONATED, each device scanning its ``F/D`` trials in place.
+    Trials never communicate, so the program carries ZERO collectives —
+    the embarrassing parallelism is the whole perf story (the VMEM-knee
+    table, `benchmarks/vmem_knee.py`, prices exactly this layout).  On
+    a 1-device mesh it collapses to `bench.fleet_program` — byte-
+    identical to the archived `fleet_small` pin
+    (`hlo_pin.py --verify-off-path`).
+
+Randomness: nothing here folds a shard index — each trial consumes its
+own per-trial key exactly as the dense fleet splits them, which is what
+makes the bit-parity hold (contrast `parallel/sharded.py`, where the
+per-shard PRNG streams differ from dense by design).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from go_avalanche_tpu.parallel.mesh import NODES_AXIS, shard_map
+
+TRIALS_AXIS = "trials"
+
+# The trial axis is laid over BOTH mesh axes (row-major: trials-major,
+# nodes-minor — the same order `jax.random.split` lays the keys out),
+# so a (A, B) mesh shards F trials over A*B devices.  One spelling,
+# shared by every in/out spec in this module and the footprint model
+# (`benchmarks/mem_pin.py` accounts the per-device shard shapes with
+# exactly this spec).
+FLEET_SPEC = P((TRIALS_AXIS, NODES_AXIS))
+
+# The collective allowlist (go_avalanche_tpu/analysis/hlo_audit.py —
+# the manifest convention every sharded driver declares): the driver
+# program gathers the per-trial outcome/telemetry/trace vectors and
+# psums the summary counts over the trial axes; NOTHING else may
+# communicate (a collective touching an [N, T] plane would mean a trial
+# leaked into another trial's stream).  The bench scan program
+# (`fleet_scan_program`) lowers ZERO collectives — the audit asserts
+# that too (tests/test_sharded_fleet.py, analysis/hlo_audit.py).
+DECLARED_COLLECTIVES = frozenset({
+    ("all_gather", (TRIALS_AXIS, NODES_AXIS)),  # per-trial vectors [F, ...]
+    ("all_reduce", (TRIALS_AXIS, NODES_AXIS)),  # FleetCounts psums
+})
+
+
+class FleetCounts(NamedTuple):
+    """The in-graph summary reduction, psum'd over the trial axes and
+    replicated on every device — the counts `FleetResult.summary` rows
+    are built from, cross-checked by `fleet.run_fleet` against the
+    gathered per-trial vectors (a mismatch means the gather reordered
+    or dropped a trial — fail loudly, never mislabel a phase row)."""
+
+    trials: jax.Array      # int32 — global trial count (Σ local F/D)
+    violations: jax.Array  # int32 — Σ TrialOutcome.violation
+    settled: jax.Array     # int32 — Σ TrialOutcome.settled
+    stalled: jax.Array     # int32 — Σ TrialOutcome.stalled
+
+
+def make_fleet_mesh(n_trial_shards: int, n_node_shards: int = 1,
+                    devices: Optional[Sequence[jax.Device]] = None
+                    ) -> Mesh:
+    """A ``(trials, nodes)`` mesh over the FIRST ``A * B`` devices.
+
+    Unlike `parallel.mesh.make_mesh` (which claims every device), the
+    fleet mesh takes a prefix — the audit/CI harness exposes 8 virtual
+    devices and the 2x2 parity mesh must build under it, exactly like
+    `analysis/hlo_audit._audit_mesh`.  The trial axis spans BOTH axes
+    (`FLEET_SPEC`), so today the 2-D spelling is a device-count
+    factorization; the `nodes` axis keeps the canonical name so the
+    full trials-x-node-plane composition (ROADMAP follow-up) can claim
+    it without re-speccing the trial layout.
+    """
+    if n_trial_shards < 1 or n_node_shards < 1:
+        raise ValueError(f"fleet mesh axes must be >= 1, got "
+                         f"{n_trial_shards}x{n_node_shards}")
+    need = n_trial_shards * n_node_shards
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < need:
+        raise ValueError(
+            f"fleet mesh {n_trial_shards}x{n_node_shards} needs {need} "
+            f"devices, found {len(devices)} — run under the tier-1 "
+            f"harness (8 virtual CPU devices) or on hardware")
+    dev_array = np.asarray(devices[:need]).reshape(n_trial_shards,
+                                                   n_node_shards)
+    return Mesh(dev_array, (TRIALS_AXIS, NODES_AXIS))
+
+
+def mesh_devices(mesh: Optional[Mesh]) -> int:
+    """Device count of a fleet mesh (0 for None) — the one spelling of
+    'does this mesh actually shard' shared by the dispatch sites."""
+    return 0 if mesh is None else int(mesh.devices.size)
+
+
+def check_fleet_divisible(fleet: int, mesh: Mesh) -> None:
+    """`shard_map` splits the trial axis evenly: F must divide by the
+    mesh's device count (each device runs exactly F/D whole sims).
+    THE one wording — the run_sim/bench parsers mirror it."""
+    d = mesh_devices(mesh)
+    if fleet % d:
+        raise ValueError(
+            f"fleet ({fleet}) must divide by the fleet mesh's device "
+            f"count ({d} = {'x'.join(str(s) for s in mesh.devices.shape)}"
+            f" devices): the trial axis shards evenly — each device "
+            f"runs F/D whole sims")
+
+
+def fleet_state_specs(state):
+    """`FLEET_SPEC` mirrored over every leaf of a fleet-stacked state
+    (every leaf carries the leading ``[F]`` trial axis — the fleet vmap
+    stacks them all), None slots preserved — the spec tree
+    `benchmarks/mem_pin.py` feeds the per-device footprint model."""
+    return jax.tree.map(lambda _: FLEET_SPEC, state)
+
+
+def shard_fleet_state(state, mesh: Mesh):
+    """Place a fleet-stacked state (`workload.fleet_flagship_state`)
+    onto the fleet mesh, every leaf sharded on its trial axis.  Like
+    `sharded.shard_state`, `device_put` may alias already-placed
+    leaves — treat the original as consumed when the result feeds the
+    donated scan program."""
+    return jax.tree.map(
+        lambda x: jax.device_put(x, NamedSharding(mesh, FLEET_SPEC)),
+        state)
+
+
+def fleet_driver_program(mesh: Mesh, trial):
+    """The jitted sharded-fleet driver `fleet.run_fleet(mesh=...)`
+    executes — exposed unexecuted so `analysis/hlo_audit.py` lowers THE
+    program (the `scan_program` seam convention, applied to the fleet).
+
+    ``trial`` is the per-key whole-sim function (`fleet._trial_fn` —
+    the SAME closure the dense fleet vmaps, which is what makes the
+    bit-parity a property instead of a test-only coincidence).  Inside
+    `shard_map` each device vmaps its local ``F/D`` key slice, then:
+
+      * per-trial vectors (TrialOutcome / telemetry / trace) are
+        all-gathered over ``(trials, nodes)`` — tiled concat in
+        row-major device order, which is exactly the order
+        `FLEET_SPEC` laid the keys out, so the reassembled ``[F]``
+        vectors match the dense fleet's element-for-element;
+      * `FleetCounts` is psum'd — the in-graph summary reduction.
+
+    Outputs are replicated (``out_specs=P()``), so the host-side
+    Wilson-CI path in `run_fleet` is the dense one, unchanged.  The key
+    plane is tiny and the outputs share no buffer with it, so the
+    driver is UNDONATED like the dense `_compiled_fleet` (the donated
+    program of this module is `fleet_scan_program`).
+    """
+    axes = (TRIALS_AXIS, NODES_AXIS)
+
+    def local(keys):
+        outcome, tel, trace = jax.vmap(trial)(keys)
+        counts = FleetCounts(
+            trials=lax.psum(jnp.int32(keys.shape[0]), axes),
+            violations=lax.psum(
+                outcome.violation.sum().astype(jnp.int32), axes),
+            settled=lax.psum(
+                outcome.settled.sum().astype(jnp.int32), axes),
+            stalled=lax.psum(
+                outcome.stalled.sum().astype(jnp.int32), axes),
+        )
+        gathered = jax.tree.map(
+            lambda x: lax.all_gather(x, axes, axis=0, tiled=True),
+            (outcome, tel, trace))
+        return gathered[0], counts, gathered[1], gathered[2]
+
+    return jax.jit(shard_map(local, mesh=mesh, in_specs=(FLEET_SPEC,),
+                             out_specs=P()))
+
+
+def fleet_scan_program(mesh: Mesh, cfg, n_rounds: int):
+    """The jitted DONATED `bench.py --fleet F --mesh A,B` program
+    (pinned as `fleet_sharded`): each device scans its ``F/D`` flagship
+    trials in place — `bench.fleet_program`'s vmapped scan partitioned
+    over the fleet mesh, zero collectives (trials never communicate).
+
+    Built here (not inline in bench.py) so `benchmarks/hlo_pin.py`,
+    `benchmarks/mem_pin.py` and the contract auditor all lower THE
+    timed program through one seam; `bench.fleet_program(mesh=...)`
+    dispatches to it and collapses to the dense spelling on a 1-device
+    mesh (`hlo_pin --verify-off-path` proves the collapse is
+    byte-identical to the archived `fleet_small` chain).
+    """
+    from go_avalanche_tpu.models import avalanche as av
+
+    def run_one(s):
+        def body(st, _):
+            new_s, _ = av.round_step(st, cfg)
+            return new_s, None
+        out, _ = lax.scan(body, s, None, length=n_rounds)
+        return out
+
+    return jax.jit(
+        shard_map(lambda s: jax.vmap(run_one)(s), mesh=mesh,
+                  in_specs=(FLEET_SPEC,), out_specs=FLEET_SPEC),
+        donate_argnums=0)

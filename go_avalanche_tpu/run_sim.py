@@ -533,20 +533,25 @@ def run_fleet_mode(args, cfg: AvalancheConfig) -> Dict:
     from go_avalanche_tpu.obs.sink import active_sink
 
     sink = active_sink()
+    mesh = getattr(args, "fleet_mesh", None)
+    mesh_extra = ({"fleet_mesh": args.mesh,
+                   "fleet_devices": int(mesh.devices.size)}
+                  if mesh is not None else {})
     common = dict(fleet=args.fleet, n_nodes=args.nodes, n_txs=args.txs,
                   n_rounds=args.max_rounds, seed=args.seed,
                   conflict_size=args.conflict_size,
                   yes_fraction=args.yes_fraction,
                   contested=args.contested,
-                  window=args.slots)
+                  window=args.slots, mesh=mesh)
     if args.phase_grid_parsed is not None:
         rows = fl.run_phase_grid(args.model, cfg,
                                  args.phase_grid_parsed, sink=sink,
                                  **common)
         return {"fleet": args.fleet, "phase_points": len(rows),
-                "grid_rows": rows}
+                "grid_rows": rows, **mesh_extra}
     res = fl.run_fleet(args.model, cfg, **common)
     row = res.summary()
+    row.update(mesh_extra)
     realized = res.realizations()
     if realized:
         row["realizations"] = realized
@@ -575,17 +580,20 @@ def _report_memory(args, cfg) -> None:
     if args.fleet is not None:
         from go_avalanche_tpu import fleet as fl
 
+        fleet_mesh = getattr(args, "fleet_mesh", None)
         keys_abs = jax.eval_shape(
             lambda: jax.random.split(jax.random.key(args.seed),
                                      args.fleet))
-        jitted = fl._compiled_fleet(
-            args.model, cfg, int(args.nodes), int(args.txs),
-            int(args.max_rounds), int(args.conflict_size),
-            float(args.yes_fraction), bool(args.contested),
-            int(args.slots))
+        jitted = fl.compiled_fleet_program(
+            args.model, cfg, args.nodes, args.txs, args.max_rounds,
+            args.conflict_size, args.yes_fraction, args.contested,
+            args.slots, mesh=fleet_mesh)
         compiled = jitted.lower(keys_abs).compile()
         scope = (f"fleet{args.fleet} (argument = the per-trial key "
                  f"plane; states build in-graph)")
+        if fleet_mesh is not None and fleet_mesh.devices.size > 1:
+            scope += (f", trial axis over {fleet_mesh.devices.size} "
+                      f"devices (per-device ledger)")
     elif args.mesh:
         from go_avalanche_tpu import parallel
 
@@ -913,7 +921,26 @@ def main(argv=None) -> Dict:
     parser.add_argument("--mesh", type=str, default=None, metavar="N,T",
                         help="run the sharded backend over an "
                              "(n node shards, t tx shards) device mesh "
-                             "(models: avalanche, dag, backlog)")
+                             "(models: avalanche, dag, backlog).  With "
+                             "--fleet the axes read (A, B) TRIAL "
+                             "shards instead (parallel/"
+                             "sharded_fleet.py): the Monte-Carlo trial "
+                             "axis is laid over A*B devices — each "
+                             "runs F/(A*B) whole sims in one compiled "
+                             "program per config point, bit-identical "
+                             "to the dense fleet on the same seeds — "
+                             "so F must divide by A*B")
+    parser.add_argument("--fleet-shape", choices=("auto",), default=None,
+                        help="knee-table-driven fleet sizing "
+                             "(benchmarks/vmem_knee.py, the archived "
+                             "[F, N, T] VMEM/HBM-knee table for the "
+                             "active device profile): without --fleet, "
+                             "PICKS F — the deepest trials-per-device "
+                             "row whose largest safe N=T square still "
+                             "fits --nodes/--txs, times the --mesh "
+                             "device count; with --fleet, VALIDATES it "
+                             "— a shape above the knee is rejected "
+                             "here with the table row cited")
     parser.add_argument("--donate", action="store_true",
                         help="with --mesh: donate the sharded state into "
                              "the while-loop drivers so the [N, T] planes "
@@ -1106,6 +1133,32 @@ def main(argv=None) -> Dict:
 
     # Fleet-mode validation: everything parser-level (the PR 5 rule).
     args.phase_grid_parsed = None
+    args.fleet_mesh = None
+    if args.fleet_shape is not None:
+        # Knee-table-driven fleet sizing (benchmarks/vmem_knee.py):
+        # resolve the active device profile from the backend, then
+        # PICK F (no --fleet: the deepest trials-per-device row whose
+        # knee fits --nodes/--txs, scaled by the mesh's device count)
+        # or VALIDATE the explicit --fleet (a shape above the knee is
+        # rejected HERE with the table row cited).
+        from benchmarks.vmem_knee import select_fleet_shape
+
+        mesh_devices = 1
+        if args.mesh:
+            try:
+                a_s, b_s = args.mesh.split(",")
+                mesh_devices = int(a_s) * int(b_s)
+            except ValueError:
+                parser.error(f"--mesh must be A,B shards, got "
+                             f"{args.mesh!r}")
+        try:
+            sel = select_fleet_shape(jax.devices()[0].platform,
+                                     mesh_devices, args.nodes, args.txs,
+                                     fleet=args.fleet)
+        except ValueError as e:
+            parser.error(str(e))
+        if args.fleet is None:
+            args.fleet = sel["fleet"]
     if args.fleet is not None:
         if args.fleet < 1:
             parser.error(f"--fleet must be >= 1 trials, got {args.fleet}")
@@ -1113,13 +1166,28 @@ def main(argv=None) -> Dict:
             parser.error(f"--fleet supports models snowball/avalanche/"
                          f"dag/backlog, not {args.model}")
         if args.mesh:
-            parser.error(
-                "--fleet x --mesh is not implemented: the fleet vmaps "
-                "WHOLE sims in-graph, and composing that batching with "
-                "the shard_map drivers (a fleet of sharded sims) is the "
-                "open 'fleet-of-sharded-sims' ROADMAP item (Monte-Carlo "
-                "fleet, next steps).  Run the fleet dense, or drop "
-                "--fleet to shard a single sim")
+            # The fleet x mesh COMPOSITION (the landed
+            # fleet-of-sharded-sims item): --mesh A,B lays the trial
+            # axis over an (A, B) fleet mesh — A*B devices each run
+            # F/(A*B) whole sims in one compiled program, bit-identical
+            # to the dense fleet on the same seeds
+            # (parallel/sharded_fleet.py).
+            from go_avalanche_tpu.parallel import sharded_fleet
+
+            try:
+                a_s, b_s = args.mesh.split(",")
+                args.fleet_mesh = sharded_fleet.make_fleet_mesh(
+                    int(a_s), int(b_s))
+                sharded_fleet.check_fleet_divisible(args.fleet,
+                                                    args.fleet_mesh)
+            except ValueError as e:
+                parser.error(f"--fleet x --mesh: {e}")
+            if args.donate:
+                parser.error(
+                    "--donate tunes the sharded single-sim drivers; "
+                    "the sharded fleet driver's input is the per-trial "
+                    "key plane (nothing worth donating — the bench "
+                    "lane's state-scan program donates instead)")
         if args.check_invariants:
             parser.error("--check-invariants steps ONE sim on the host; "
                          "it has no per-trial identity under --fleet")
@@ -1227,8 +1295,13 @@ def main(argv=None) -> Dict:
                          f"occupancy fractions (e.g. 0.7,0.9), got "
                          f"{args.arrival_backpressure!r}")
 
-    if args.mesh and args.model not in ("avalanche", "dag", "backlog",
-                                        "streaming_dag", "node_stream"):
+    if (args.mesh and args.fleet is None
+            and args.model not in ("avalanche", "dag", "backlog",
+                                   "streaming_dag", "node_stream")):
+        # Under --fleet the mesh shards the TRIAL axis and every trial
+        # runs the dense per-trial program, so the fleet models
+        # (snowball included) all compose — the single-sim driver
+        # restriction applies only without --fleet.
         parser.error(f"--mesh supports models avalanche/dag/backlog/"
                      f"streaming_dag/node_stream, not {args.model}")
     if args.donate and not args.mesh:
@@ -1286,7 +1359,12 @@ def main(argv=None) -> Dict:
                          f"in-graph tap; the family models "
                          f"(slush/snowflake) predate it — got "
                          f"{args.model}")
-        if args.mesh and (args.metrics_every or not args.trace_every):
+        if (args.mesh and args.fleet is None
+                and (args.metrics_every or not args.trace_every)):
+            # Fleet runs stream PHASE ROWS host-side regardless of the
+            # mesh (the in-graph tap is forced off below), so the
+            # sharded-driver tap restriction applies only without
+            # --fleet.
             parser.error("--metrics is the dense in-graph tap; sharded "
                          "drivers stream stacked telemetry host-side "
                          "(obs.MetricsSink.write_stacked) — or use "

@@ -624,7 +624,9 @@ def test_run_sim_fleet_parser_rejections(tmp_path):
     for argv in (
         ["--model", "snowball", "--fleet", "0"],
         ["--model", "slush", "--fleet", "4"],
-        ["--model", "avalanche", "--fleet", "4", "--mesh", "2,2"],
+        # --fleet x --mesh now DISPATCHES (fleet-of-sharded-sims); an
+        # indivisible trial count still dies at the parser.
+        ["--model", "avalanche", "--fleet", "3", "--mesh", "2,2"],
         ["--model", "snowball", "--fleet", "4", "--check-invariants"],
         ["--model", "snowball", "--phase-grid", "{\"k\": [8]}"],  # no --fleet
         ["--model", "snowball", "--fleet", "4", "--phase-grid", "not json"],

@@ -1,0 +1,381 @@
+"""Fleet-of-sharded-sims (the composed --fleet x --mesh axis).
+
+Load-bearing pins:
+
+  * BIT-PARITY — `run_fleet(mesh=...)` on a 2x2 fleet mesh is
+    bit-identical to the dense fleet on the same seeds/config (the
+    acceptance bar): outcome vectors, realized stochastic schedules,
+    per-trial telemetry and the [F, S, M] trace plane leaf-exact;
+    `summary()` rows identical.  Holds because the sharded driver vmaps
+    the SAME `fleet._trial_fn` closure over each device's key slice —
+    the established vmap==stacked property partitions.
+  * IN-GRAPH COUNTS — the psum'd `FleetCounts` summary is
+    cross-checked against the gathered vectors inside `run_fleet`
+    (a divergence raises, never mislabels a phase row).
+  * DONATION SOAK (runtime) — the `fleet_sharded` bench program runs N
+    back-to-back DONATED steps on the fleet mesh, its compiled memory
+    record passes `obs.resources.check_memory` (per-device analytic
+    footprint fully aliased — no per-trial buffer clone), and a
+    planted undonated variant of the same program FAILS the check
+    (the negative the static auditor cannot plant).
+  * KNEE-DRIVEN SHAPES — `vmem_knee.select_fleet_shape` picks /
+    validates F against the archived table, rejects above-knee shapes
+    citing the table, and `knee_table(mem_record=...)` re-derives from
+    a synthetic measured record (the first TPU `mem_pin --update`
+    appends data, never changes code).
+  * LEDGER LANES — a mesh-tagged fleet row never chains against a
+    different mesh's rows (distinct lanes), and a device-count change
+    INSIDE one lane is a hard gate error (the r04/r05 class in
+    miniature).
+
+Wall-budget note: each compiled fleet config costs ~2-8 s CPU and the
+870 s tier-1 gate was ~95% full before this PR — tier-1 carries the
+2x2 parity pair (the acceptance bar), the 1-device-collapse identity
+(lru reuse: zero extra compiles) and the jax-free knee/ledger/parser
+pins; the donation soak, the planted negative, the audit contracts,
+the bench lane and the phase-grid parity ride the slow lane (verified
+passing).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+import bench
+from benchmarks import ledger, vmem_knee
+from go_avalanche_tpu import fleet
+from go_avalanche_tpu.config import AvalancheConfig
+from go_avalanche_tpu.obs import resources
+from go_avalanche_tpu.parallel import sharded_fleet
+from go_avalanche_tpu.parallel.mesh import shard_map
+
+
+@pytest.fixture(scope="module")
+def fleet_mesh():
+    return sharded_fleet.make_fleet_mesh(2, 2)
+
+
+def _rich_cfg() -> AvalancheConfig:
+    """Stochastic faults + async coalesced + trace plane: every
+    per-trial surface the parity claim covers (realizations, ring,
+    [F, S, M] traces)."""
+    return AvalancheConfig(
+        finalization_score=16, time_step_s=1.0, request_timeout_s=3.0,
+        latency_mode="fixed", latency_rounds=1,
+        inflight_engine="coalesced",
+        fault_script=(("stochastic_partition", (2, 4), (3, 6),
+                       (0.4, 0.6)),),
+        trace_every=2)
+
+
+KW = dict(fleet=4, n_nodes=16, n_txs=12, n_rounds=6)
+
+
+def test_sharded_fleet_bit_parity_with_dense(fleet_mesh):
+    cfg = _rich_cfg()
+    dense = fleet.run_fleet("avalanche", cfg, **KW)
+    shard = fleet.run_fleet("avalanche", cfg, mesh=fleet_mesh, **KW)
+    for field in ("violations", "settled", "finality_round",
+                  "finalized_fraction", "stalled"):
+        np.testing.assert_array_equal(
+            getattr(dense, field), getattr(shard, field),
+            err_msg=f"sharded fleet {field} vector diverged from dense")
+    # Realized stochastic schedules: per-trial windows + splits exact.
+    assert dense.realizations() == shard.realizations()
+    np.testing.assert_array_equal(dense.cut_windows, shard.cut_windows)
+    # Per-trial telemetry [F, R]: every counter leaf exact.
+    for a, b in zip(jax.tree.leaves(dense.telemetry),
+                    jax.tree.leaves(shard.telemetry)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # The trace plane [F, S, M] leaf-exact, and its decode too.
+    np.testing.assert_array_equal(np.asarray(dense.trace.data),
+                                  np.asarray(shard.trace.data))
+    np.testing.assert_array_equal(np.asarray(dense.trace.cursor),
+                                  np.asarray(shard.trace.cursor))
+    assert dense.trace_records() == shard.trace_records()
+    # The phase-row body — THE acceptance spelling.
+    assert dense.summary() == shard.summary()
+
+
+def test_sharded_fleet_one_device_mesh_collapses_to_dense():
+    # Same config/shape as the parity test above, so BOTH runs here are
+    # `_compiled_fleet` lru hits — the collapse costs zero compiles.
+    cfg = _rich_cfg()
+    mesh1 = sharded_fleet.make_fleet_mesh(1, 1)
+    dense = fleet.run_fleet("avalanche", cfg, **KW)
+    col = fleet.run_fleet("avalanche", cfg, mesh=mesh1, **KW)
+    np.testing.assert_array_equal(dense.violations, col.violations)
+    assert dense.summary() == col.summary()
+    # The collapse is the SAME compiled program, not a parallel twin.
+    assert fleet._fleet_cache(mesh1) is fleet._compiled_fleet
+    assert fleet._fleet_cache(None) is fleet._compiled_fleet
+
+
+def test_sharded_fleet_rejects_indivisible_fleet(fleet_mesh):
+    with pytest.raises(ValueError, match="divide by the fleet mesh"):
+        fleet.run_fleet("snowball", AvalancheConfig(), fleet=3,
+                        n_nodes=8, n_rounds=4, mesh=fleet_mesh)
+    with pytest.raises(ValueError, match="devices"):
+        sharded_fleet.make_fleet_mesh(64, 64)
+
+
+@pytest.mark.slow
+def test_sharded_fleet_phase_grid_rows_match_dense(fleet_mesh):
+    cfg = AvalancheConfig(finalization_score=16)
+    kw = dict(fleet=4, n_nodes=16, n_txs=12, n_rounds=6)
+    grid = {"k": [4, 8]}
+    dense_rows = fleet.run_phase_grid("avalanche", cfg, grid, **kw)
+    shard_rows = fleet.run_phase_grid("avalanche", cfg, grid,
+                                      mesh=fleet_mesh, **kw)
+    assert dense_rows == shard_rows
+
+
+# ---------------------------------------------------------------------------
+# Donation-under-vmap RUNTIME soak (the half the static auditor cannot
+# prove): N back-to-back donated steps of the sharded fleet program,
+# memory record clean, planted undonated clone trips the check.
+
+
+def _soak_state_and_cfg(mesh):
+    from benchmarks.workload import fleet_flagship_state
+
+    state, cfg = fleet_flagship_state(4, 32, 32, k=8)
+    return sharded_fleet.shard_fleet_state(state, mesh), cfg
+
+
+def _soak_state_abs():
+    from benchmarks.workload import fleet_flagship_state
+
+    # Sharding never changes shapes, so the abstract twin skips the
+    # device_put.
+    return jax.eval_shape(lambda: fleet_flagship_state(4, 32, 32,
+                                                       k=8)[0])
+
+
+@pytest.mark.slow
+def test_sharded_fleet_donation_soak_runtime(fleet_mesh):
+    state, cfg = _soak_state_and_cfg(fleet_mesh)
+    state_abs = _soak_state_abs()
+    run = bench.fleet_program(cfg, 2, 4, mesh=fleet_mesh)
+    compiled = run.lower(state_abs).compile()
+    record = resources.memory_record(compiled)
+    analytic = resources.footprint(
+        state_abs, sharded_fleet.fleet_state_specs(state_abs),
+        fleet_mesh)["total_bytes"]
+    # Per-device: argument == analytic shard bytes, alias covers the
+    # whole state — NO per-trial buffer clone rides the program.
+    assert resources.check_memory(record, analytic, donated=True,
+                                  extra_output_ok=False,
+                                  what="fleet_sharded@soak") == []
+    # The runtime half: chain N donated calls — donation actually
+    # consumed each input (a double-buffered plane would still run;
+    # the record above is what rules it out — but a BROKEN alias
+    # table would crash or corrupt here), and the trial axis keeps
+    # advancing every sim in place.
+    for _ in range(4):
+        state = run(state)
+    rounds = np.asarray(jax.device_get(state.round))
+    np.testing.assert_array_equal(rounds, np.full(4, 8, np.int32))
+
+
+@pytest.mark.slow
+def test_sharded_fleet_planted_undonated_clone_trips_check(fleet_mesh):
+    # The negative: the SAME local scan WITHOUT donation — every
+    # fleet-stacked plane double-buffers, alias bytes collapse to 0,
+    # and check_memory names the undonated copy.
+    from benchmarks.workload import flagship_config
+    from go_avalanche_tpu.models import avalanche as av
+
+    state_abs = _soak_state_abs()
+    cfg = flagship_config(32, 8)
+
+    def run_one(s):
+        def body(st, _):
+            new_s, _ = av.round_step(st, cfg)
+            return new_s, None
+        out, _ = jax.lax.scan(body, s, None, length=2)
+        return out
+
+    undonated = jax.jit(shard_map(
+        lambda s: jax.vmap(run_one)(s), mesh=fleet_mesh,
+        in_specs=(sharded_fleet.FLEET_SPEC,),
+        out_specs=sharded_fleet.FLEET_SPEC))          # no donate_argnums
+    record = resources.memory_record(
+        undonated.lower(state_abs).compile())
+    analytic = resources.footprint(
+        state_abs, sharded_fleet.fleet_state_specs(state_abs),
+        fleet_mesh)["total_bytes"]
+    failures = resources.check_memory(record, analytic, donated=True,
+                                      extra_output_ok=False,
+                                      what="planted")
+    assert any("undonated copy" in f for f in failures), failures
+
+
+@pytest.mark.slow
+def test_sharded_fleet_audit_contracts_clean():
+    from go_avalanche_tpu.analysis import hlo_audit
+
+    assert hlo_audit.audit_sharded_fleet(compile_donation=False) == []
+
+
+# ---------------------------------------------------------------------------
+# bench --fleet --mesh lane: the tagged one-line contract.
+
+
+@pytest.mark.slow
+def test_bench_fleet_mesh_lane_tags_and_devices(tmp_path, monkeypatch):
+    monkeypatch.setenv("GO_AVALANCHE_TPU_LEDGER",
+                       str(tmp_path / "ledger.jsonl"))
+    res = bench.bench(48, 48, 3, 8, repeats=1, fleet=8, mesh="2,2")
+    assert res["tag"].endswith(", fleet8, mesh2x2")
+    assert ", fleet8, mesh2x2)" in res["metric"]
+    assert res["devices"]["device_count"] == 8  # harness topology
+    assert res["value"] > 0
+    # The ledger row carries the lane + device topology the gate keys on.
+    row = ledger.row_from_result(res, source="test")
+    assert ", fleet8, mesh2x2" in row["lane"]
+    assert row["devices"]["device_count"] == 8
+
+
+@pytest.mark.slow
+def test_bench_fleet_mesh_rejects_indivisible():
+    with pytest.raises(ValueError, match="divide by the fleet mesh"):
+        bench.bench(32, 32, 2, 8, repeats=1, fleet=6, mesh="2,2")
+
+
+# ---------------------------------------------------------------------------
+# Knee-table-driven shapes (benchmarks/vmem_knee.py).
+
+
+def test_select_fleet_shape_picks_deepest_fitting_row():
+    sel = vmem_knee.select_fleet_shape("cpu", 4, 512, 512, fleet=None)
+    # cpu-ci: the 512² knee sits at 256 trials/device.
+    assert sel["trials_per_device"] == 256
+    assert sel["fleet"] == 256 * 4
+    assert sel["profile"] == "cpu-ci"
+
+
+def test_select_fleet_shape_validates_and_rejects_above_knee():
+    ok = vmem_knee.select_fleet_shape("cpu", 4, 256, 256, fleet=1024)
+    assert ok["trials_per_device"] == 256
+    with pytest.raises(ValueError) as e:
+        vmem_knee.select_fleet_shape("cpu", 4, 8192, 8192, fleet=1024)
+    msg = str(e.value)
+    # The acceptance wording: the rejection CITES the table.
+    assert "vmem_knee.json" in msg and "ABOVE the VMEM/HBM knee" in msg
+    with pytest.raises(ValueError, match="no knee-table device profile"):
+        vmem_knee.select_fleet_shape("gpu", 4, 64, 64)
+
+
+def test_knee_table_rederives_from_synthetic_measured_record():
+    # The ROADMAP contract: a measured mem_pin record re-derives the
+    # table WITHOUT a code change — feed a synthetic record and watch
+    # the ratio (and the knees) move.
+    base = vmem_knee.knee_table("v5e-8")
+    assert base["temp_ratio"]["ratio"] == 1.0  # provisional default
+    meas = vmem_knee.knee_table(
+        "v5e-8", mem_record={"temp_bytes": 3_000, "argument_bytes": 1_000})
+    assert meas["temp_ratio"] == {"ratio": 3.0,
+                                  "source": "explicit measured record"}
+    base_nt = {r["fleet"]: r.get("largest_nt") for r in base["rows"]}
+    meas_nt = {r["fleet"]: r.get("largest_nt") for r in meas["rows"]}
+    assert any(meas_nt[f] < base_nt[f] for f in base_nt
+               if base_nt[f] and meas_nt[f]), (
+        "a 3x scratch ratio must shrink some knee")
+    with pytest.raises(ValueError, match="explicit record"):
+        vmem_knee.temp_ratio_for(vmem_knee.DEVICE_PROFILES["v5e-8"],
+                                 record={"temp_bytes": 1})
+
+
+# ---------------------------------------------------------------------------
+# Ledger: mesh-tagged fleet lanes never cross meshes; device-count
+# changes inside one lane are the r04/r05 class in miniature.
+
+
+def _lrow(value, lane, backend="tpu", ts=1.0, devcount=None):
+    return {"schema": 1, "ts": ts, "lane": lane, "metric": lane,
+            "value": value, "unit": "votes/sec", "tag": "",
+            "backend": backend, "fallback": False, "round": None,
+            "devices": ({"device_count": devcount}
+                        if devcount is not None else None)}
+
+
+def test_gate_mesh_tagged_fleet_rows_are_distinct_lanes():
+    # A 1-device fleet row and an 8-device mesh row carry different
+    # lane strings (the ', meshAxB' tag) — never compared, no failure
+    # even with a 100x value gap.
+    rows = [_lrow(100.0, "ingest (fleet8)", ts=1, devcount=1),
+            _lrow(10_000.0, "ingest (fleet8, mesh2x4)", ts=2,
+                  devcount=8)]
+    failures, refused, report = ledger.gate(rows)
+    assert failures == [] and refused == [] and report == []
+
+
+def test_gate_device_count_change_mid_chain_is_hard_error():
+    rows = [_lrow(100.0, "ingest (fleet8)", ts=1, devcount=1),
+            _lrow(101.0, "ingest (fleet8)", ts=2, devcount=8)]
+    failures, _, _ = ledger.gate(rows)
+    assert len(failures) == 1
+    assert "device-topology change mid-chain" in failures[0]
+    # Same count (or absent — pre-PR-14 artifacts) still compares.
+    ok, _, report = ledger.gate(
+        [_lrow(100.0, "l", ts=1, devcount=8),
+         _lrow(101.0, "l", ts=2, devcount=8)])
+    assert ok == [] and len(report) == 1
+    ok2, _, report2 = ledger.gate(
+        [_lrow(100.0, "l", ts=1), _lrow(101.0, "l", ts=2, devcount=8)])
+    assert ok2 == [] and len(report2) == 1
+
+
+# ---------------------------------------------------------------------------
+# run_sim CLI: the composed dispatch's parser hygiene (the PR 5 rule).
+
+
+def test_run_sim_fleet_mesh_parser_rejections():
+    from go_avalanche_tpu.run_sim import main
+
+    for argv in (
+        # F must divide by the mesh's device count
+        ["--model", "avalanche", "--fleet", "3", "--mesh", "2,2"],
+        # malformed fleet mesh
+        ["--model", "avalanche", "--fleet", "4", "--mesh", "nope"],
+        # nothing to donate in the keys->outcomes driver
+        ["--model", "avalanche", "--fleet", "4", "--mesh", "2,2",
+         "--donate"],
+        # knee rejection: 16384² is above every cpu-ci knee row
+        ["--model", "avalanche", "--fleet", "64", "--mesh", "2,2",
+         "--nodes", "16384", "--txs", "16384", "--fleet-shape", "auto"],
+    ):
+        with pytest.raises(SystemExit):
+            main(argv)
+
+
+@pytest.mark.slow
+def test_run_sim_audit_fleet_mesh_single_compile(capsys):
+    # --audit --fleet --mesh lowers through the SAME mesh-keyed
+    # lru-cached jit the runner executes (fleet._compiled_sharded_
+    # fleet), so the audited program still compiles exactly once.
+    from go_avalanche_tpu.run_sim import main
+
+    misses_before = fleet._compiled_sharded_fleet.cache_info().misses
+    result = main(["--model", "avalanche", "--fleet", "4", "--mesh",
+                   "2,2", "--nodes", "12", "--txs", "8", "--max-rounds",
+                   "3", "--finalization-score", "8", "--audit",
+                   "--json"])
+    assert result["fleet"] == 4
+    assert "audit ok" in capsys.readouterr().err
+    assert (fleet._compiled_sharded_fleet.cache_info().misses
+            - misses_before) <= 1
+
+
+def test_run_sim_fleet_shape_auto_rejection_cites_table(capsys):
+    from go_avalanche_tpu.run_sim import main
+
+    with pytest.raises(SystemExit):
+        main(["--model", "avalanche", "--fleet", "64", "--mesh", "2,2",
+              "--nodes", "16384", "--txs", "16384",
+              "--fleet-shape", "auto"])
+    err = capsys.readouterr().err
+    assert "vmem_knee.json" in err and "knee" in err

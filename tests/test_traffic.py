@@ -472,17 +472,24 @@ def test_fleet_rows_without_stochastic_events_omit_realizations():
 
 
 # ---------------------------------------------------------------------------
-# CLI surfaces: parser rejections + the fleet x mesh wording pin.
+# CLI surfaces: parser rejections + the fleet x mesh dispatch.
 
 
-def test_run_sim_fleet_mesh_rejection_names_roadmap_item(capsys):
+def test_run_sim_fleet_mesh_dispatches_to_sharded_fleet():
+    # The former wording-pin REJECTION test, flipped to the acceptance
+    # it named: --fleet x --mesh now dispatches to the trial-sharded
+    # fleet (parallel/sharded_fleet.py — the landed
+    # fleet-of-sharded-sims ROADMAP item) and reports the same summary
+    # schema as the dense fleet, plus the mesh provenance keys.
     from go_avalanche_tpu.run_sim import main
 
-    with pytest.raises(SystemExit):
-        main(["--model", "avalanche", "--fleet", "4", "--mesh", "2,2"])
-    err = capsys.readouterr().err
-    assert "fleet-of-sharded-sims" in err      # the ROADMAP item, by name
-    assert "ROADMAP" in err
+    out = main(["--model", "avalanche", "--fleet", "4", "--mesh", "2,2",
+                "--nodes", "12", "--txs", "8", "--max-rounds", "4",
+                "--finalization-score", "8", "--json"])
+    assert out["fleet"] == 4
+    assert out["fleet_mesh"] == "2,2" and out["fleet_devices"] == 4
+    assert 0.0 <= out["p_violation"] <= 1.0
+    assert out["violation_ci"][0] <= out["violation_ci"][1]
 
 
 def test_run_sim_arrival_parser_rejections():
