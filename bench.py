@@ -151,7 +151,8 @@ def traffic_program(cfg, n_rounds: int):
 
 def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
           repeats: int = 3, exchange: str = "fused",
-          ingest: str = "u8", latency: int = 0,
+          ingest: str = "u8", round_engine: str = "phased",
+          latency: int = 0,
           latency_mode: str = "fixed", timeout_rounds: int | None = None,
           inflight: str = "walk", fleet: int | None = None,
           mesh: str | None = None,
@@ -196,6 +197,24 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
         metrics_every = 0
         trace_every = tap_stride
     trace_rounds = n_rounds * (repeats + 1)
+    if round_engine != "phased":
+        # The megakernel covers the dense synchronous flagship round
+        # only (ops/megakernel.py); every other lane would silently run
+        # phased under a megakernel-tagged row — the same
+        # mislabeled-row hazard as a silently-ignored mesh below.  The
+        # CLI enforces this at the parser; the function API here.
+        if arrival is not None:
+            raise ValueError("round_engine 'megakernel' fuses the dense "
+                             "flagship round; the arrival lane times "
+                             "the streaming scheduler — pick one lane")
+        if fleet is not None:
+            raise ValueError("round_engine 'megakernel' fuses the dense "
+                             "flagship round; the fleet lanes keep the "
+                             "phased path — run them separately")
+        if latency > 0:
+            raise ValueError("round_engine 'megakernel' covers the "
+                             "synchronous round only; the async latency "
+                             "lanes ride the phased in-flight ring")
     fleet_mesh = None
     if mesh is not None and fleet is None:
         # Mirror the CLI parser: mesh is the fleet lane's trial-sharding
@@ -271,7 +290,8 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
                                     stake=stake,
                                     clusters=stake_clusters,
                                     adversary=adversary,
-                                    byzantine=byzantine)
+                                    byzantine=byzantine,
+                                    round_engine=round_engine)
     if exchange != "fused":
         cfg = dataclasses.replace(cfg, fused_exchange=False)
     if ingest != "u8":
@@ -414,6 +434,11 @@ def bench(n_nodes: int, n_txs: int, n_rounds: int, k: int,
                                            None),
                     "device_count": len(devices)},
         "tag": engine_tag,
+        # The round-execution engine as a FIELD (the PR-16 ledger
+        # contract): `ledger.py --gate` hard-fails a lane that chains a
+        # megakernel row against a phased one, so the axis can never
+        # hide inside the tag string.
+        "round_engine": cfg.round_engine,
     }
     if profile_payload is not None:
         result["profile_ms"] = profile_payload["eager_ms"]
@@ -464,6 +489,7 @@ def _worker_main(args: argparse.Namespace) -> None:
         jax.config.update("jax_platforms", "cpu")
     result = bench(args.nodes, args.txs, args.rounds, args.k,
                    exchange=args.exchange, ingest=args.ingest,
+                   round_engine=args.round_engine,
                    latency=args.latency, latency_mode=args.latency_mode,
                    timeout_rounds=args.timeout_rounds,
                    inflight=args.inflight_engine, fleet=args.fleet,
@@ -616,6 +642,20 @@ def main() -> None:
                              "lane-packed engine (ops/swar.py; tags the "
                              "metric so same-metric deltas never cross "
                              "engines)")
+    parser.add_argument("--round-engine",
+                        choices=("phased", "megakernel"),
+                        default="phased",
+                        help="whole-round execution engine "
+                             "(cfg.round_engine): 'phased' = the pinned "
+                             "per-phase chain (default), 'megakernel' = "
+                             "ONE Pallas program fusing gather + SWAR "
+                             "ingest + confidence fold "
+                             "(ops/megakernel.py; bit-exact, tags the "
+                             "metric AND rides the ledger row as a "
+                             "field so same-metric deltas never cross "
+                             "round engines).  Dense synchronous "
+                             "flagship lane only — the async / fleet / "
+                             "arrival lanes reject it as inert")
     parser.add_argument("--latency", type=int, default=0,
                         help="A/B lane for the async query engine "
                              "(ops/inflight.py): fixed per-draw response "
@@ -918,6 +958,34 @@ def main() -> None:
         parser.error("--arrival times the streaming scheduler; the "
                      "--adversary lane rides the flagship scan — pick "
                      "one lane")
+    # Round-engine rejections (the PR 5 rule again): the megakernel
+    # fuses the dense SYNCHRONOUS flagship round only — every other
+    # lane would run phased under a megakernel-labeled row.
+    if args.round_engine != "phased":
+        if args.latency:
+            parser.error("--round-engine megakernel covers the "
+                         "synchronous round only; the --latency lanes "
+                         "deliver votes across rounds through the "
+                         "in-flight ring, outside the one fused "
+                         "program — run them on the phased engine")
+        if args.arrival is not None:
+            parser.error("--arrival times the streaming scheduler; "
+                         "--round-engine megakernel fuses the dense "
+                         "flagship round — pick one lane")
+        if args.fleet is not None or args.mesh is not None:
+            parser.error("--round-engine megakernel is the "
+                         "single-device dense flagship lane; the "
+                         "fleet/mesh drivers keep the phased path "
+                         "(parallel/sharded_fleet.py rejects the knob)")
+        if args.adversary != "off":
+            parser.error("--adversary policies read per-round context "
+                         "planes the fused program does not thread; "
+                         "run the adaptive-adversary lane on the "
+                         "phased engine")
+        if args.txs % 32:
+            parser.error(f"--round-engine megakernel needs --txs "
+                         f"divisible by 32 (whole bit-packed "
+                         f"preference words), got {args.txs}")
     if args.metrics_every < 0:
         # Reject here: the worker subprocess's ValueError would read as
         # an accelerator failure and spin the retry/fallback loop.
@@ -945,6 +1013,7 @@ def main() -> None:
         return
 
     flags = [f"--exchange={args.exchange}", f"--ingest={args.ingest}",
+             f"--round-engine={args.round_engine}",
              f"--latency={args.latency}",
              f"--latency-mode={args.latency_mode}",
              f"--inflight-engine={args.inflight_engine}"] \

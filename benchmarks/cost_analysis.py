@@ -112,6 +112,59 @@ def ingest_engine_rows(shape: str) -> list:
     return rows
 
 
+def round_engine_rows(shape: str) -> list:
+    """The PR 16 acceptance measurement: ONE dense avalanche round
+    (`models/avalanche.round_step`) lowered abstractly at `shape` under
+    each `cfg.round_engine`, reporting the cost model's bytes accessed
+    and the optimized module's element-ops.  The engines are
+    bit-identical in results (tests/test_megakernel.py); the comparison
+    is pure cost — the megakernel's fusion removes the [N, k]
+    vote-pack and intermediate [N, T] planes the phased chain
+    round-trips between its fused-op islands.
+
+    Honesty note on the CPU cost model: the interpreter-mode Pallas
+    lowering walks the kernel grid with an XLA loop, and
+    `cost_analysis()` counts a loop BODY once, not per trip — so the
+    megakernel's bytes are the one-tile traffic plus the unfused
+    prologue/epilogue, an UNDERcount of total touched bytes but a
+    faithful count of the per-element HBM traffic the fusion claim is
+    about (each byte the body touches is VMEM-resident across all k
+    draws).  The phased program has no grid loop, so its count is
+    whole-plane.  Treat the delta as the removed inter-phase traffic,
+    not as a wall-clock prediction; the TPU verdict rides the
+    hardware window (ROADMAP)."""
+    import jax
+
+    from benchmarks.workload import flagship_config, flagship_state
+    from go_avalanche_tpu.models import avalanche as av
+
+    n, t = (int(x) for x in shape.split(","))
+    rows = []
+    for engine in ("phased", "megakernel"):
+        cfg = flagship_config(t, 8, round_engine=engine)
+        state_abs = jax.eval_shape(lambda: flagship_state(n, t, 8)[0])
+
+        def step(s, cfg=cfg):
+            return av.round_step(s, cfg)[0]
+
+        compiled = jax.jit(step).lower(state_abs).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        instructions, element_ops = count_hlo_ops(compiled.as_text())
+        rows.append({
+            "program": f"round_{engine}",
+            "nodes": n,
+            "txs": t,
+            "hlo_instructions": instructions,
+            "hlo_element_gops": round(element_ops / 1e9, 2),
+            "bytes_accessed_mb": round(ca.get("bytes accessed", 0) / 1e6,
+                                       1),
+            "gflops": round(ca.get("flops", 0) / 1e9, 2),
+        })
+    return rows
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--nodes", type=int, default=4096)
@@ -144,6 +197,27 @@ def main() -> None:
                         metavar="N,T",
                         help="shape for the --ingest comparison (default: "
                              "the flagship bench shape)")
+    parser.add_argument("--round", action="store_true",
+                        help="ALSO emit the round-engine comparison: one "
+                             "row per cfg.round_engine ('phased' vs "
+                             "'megakernel') for ONE dense avalanche round "
+                             "at --round-shape, with the optimized-HLO "
+                             "element-ops next to the cost model's "
+                             "bytes/flops (the PR 16 acceptance metric), "
+                             "and SELF-CHECK the megakernel's bytes "
+                             "accessed against --round-min-reduction.  "
+                             "Not part of the --check/--out baseline "
+                             "contract")
+    parser.add_argument("--round-shape", type=str, default="2048,2048",
+                        metavar="N,T",
+                        help="shape for the --round comparison (default "
+                             "2048,2048 — the acceptance shape; the CPU "
+                             "box lowers it in seconds)")
+    parser.add_argument("--round-min-reduction", type=float, default=0.30,
+                        help="with --round: minimum fractional reduction "
+                             "in lowered bytes accessed the megakernel "
+                             "round must show vs the phased round (exit "
+                             "1 below it; default 30%%)")
     args = parser.parse_args()
 
     import jax
@@ -154,6 +228,26 @@ def main() -> None:
     if args.ingest:
         for row in ingest_engine_rows(args.ingest_shape):
             print(json.dumps(row), flush=True)
+
+    if args.round:
+        round_rows = round_engine_rows(args.round_shape)
+        for row in round_rows:
+            print(json.dumps(row), flush=True)
+        by_name = {r["program"]: r for r in round_rows}
+        phased = by_name["round_phased"]["bytes_accessed_mb"]
+        mega = by_name["round_megakernel"]["bytes_accessed_mb"]
+        reduction = 1.0 - mega / phased if phased else 0.0
+        if reduction < args.round_min_reduction:
+            print(f"ROUND-ENGINE TRAFFIC CHECK FAILED: megakernel round "
+                  f"accesses {mega}MB vs phased {phased}MB — "
+                  f"{reduction:.1%} reduction, contract requires >= "
+                  f"{args.round_min_reduction:.0%} (the fusion stopped "
+                  f"removing the inter-phase HBM traffic)",
+                  file=sys.stderr)
+            sys.exit(1)
+        print(f"round-engine traffic: megakernel {mega}MB vs phased "
+              f"{phased}MB ({reduction:.1%} reduction, contract >= "
+              f"{args.round_min_reduction:.0%})", file=sys.stderr)
 
     from benchmarks.workload import northstar_state
     from go_avalanche_tpu.models import dag as dag_model

@@ -176,7 +176,8 @@ def flagship_stablehlo(nodes: int, txs: int, rounds: int, k: int,
                        stake: str = "off",
                        clusters: int = 1,
                        adversary: str = "off",
-                       byzantine: float = 0.0) -> str:
+                       byzantine: float = 0.0,
+                       round_engine: str = "phased") -> str:
     """StableHLO text of the flagship bench program at the given shape.
 
     Abstract lowering: `jax.eval_shape` turns the state builder into
@@ -200,7 +201,8 @@ def flagship_stablehlo(nodes: int, txs: int, rounds: int, k: int,
                           metrics_every=metrics_every,
                           trace_every=trace_every, stake=stake,
                           clusters=clusters, adversary=adversary,
-                          byzantine=byzantine)
+                          byzantine=byzantine,
+                          round_engine=round_engine)
     if exchange != "fused":
         cfg = dataclasses.replace(cfg, fused_exchange=False)
     if ingest != "u8":
@@ -351,6 +353,8 @@ PROGRAMS = {
                  lambda w: flagship_stablehlo(**w)),
     "flagship_swar32": (dict(FLAGSHIP, ingest="swar32"),
                         lambda w: flagship_stablehlo(**w)),
+    "flagship_megakernel": (dict(FLAGSHIP, round_engine="megakernel"),
+                            lambda w: flagship_stablehlo(**w)),
     "flagship_async": (dict(FLAGSHIP, latency=2),
                        lambda w: flagship_stablehlo(**w)),
     "flagship_async_coalesced": (dict(FLAGSHIP, latency=2,
@@ -388,6 +392,7 @@ PROGRAMS = {
 PROGRAM_BUILDERS = {
     "flagship": ("flagship_config", "flagship_state"),
     "flagship_swar32": ("flagship_config", "flagship_state"),
+    "flagship_megakernel": ("flagship_config", "flagship_state"),
     "flagship_async": ("flagship_config", "flagship_state"),
     "flagship_async_coalesced": ("flagship_config", "flagship_state"),
     "flagship_metrics": ("flagship_config", "flagship_state"),
@@ -542,14 +547,16 @@ def verify_off_path(platform: str, archive: dict | None = None) -> list:
         workload["stake"] = "off"
         workload["adversary"] = "off"
         workload["byzantine"] = 0.0
+        workload["round_engine"] = "phased"
         current = program_hash(name, workload)
         if current != pinned:
             failures.append(
                 f"{name}: metrics-off trace-off empty-script stake-off "
-                f"adversary-off program {current} != pinned {pinned} — "
-                f"the obs tap, the trace plane, the fault-script "
-                f"engine, the stake subsystem or the adversary-policy "
-                f"engine leaks into the off path")
+                f"adversary-off phased-round program {current} != "
+                f"pinned {pinned} — the obs tap, the trace plane, the "
+                f"fault-script engine, the stake subsystem, the "
+                f"adversary-policy engine or the megakernel dispatch "
+                f"leaks into the off path")
     for tapped, base, overrides, what in (
             ("flagship_metrics", "flagship", {"metrics_every": 0},
              "the tapped program differs from the untapped one by more "
@@ -569,7 +576,11 @@ def verify_off_path(platform: str, archive: dict | None = None) -> list:
              {"adversary": "off", "byzantine": 0.0},
              "the adaptive-adversary program differs from the "
              "coalesced async flagship by more than the policy "
-             "engine")):
+             "engine"),
+            ("flagship_megakernel", "flagship",
+             {"round_engine": "phased"},
+             "the megakernel program differs from the phased flagship "
+             "by more than the round-engine dispatch")):
         on = archive.get("programs", {}).get(tapped)
         off = archive.get("programs", {}).get(base)
         if not (on and off and off.get("hashes", {}).get(platform)):

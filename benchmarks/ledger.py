@@ -99,6 +99,11 @@ def row_from_result(parsed: Dict, source: str = "bench",
         "backend": backend,
         "fallback": fallback,
         "devices": parsed.get("devices"),
+        # Post-PR-16 contract: bench results carry the round engine
+        # explicitly.  Older artifacts predate the megakernel and were
+        # all phased — the default makes their rows say so rather than
+        # leaving the gate to guess.
+        "round_engine": parsed.get("round_engine", "phased"),
         "git_sha": _git_sha(),
         "source": source,
     }
@@ -245,6 +250,21 @@ def gate(rows: List[Dict], band: float = DEFAULT_BAND
                     f"— {_rowid(prev)} ran on {pd} device(s), "
                     f"{_rowid(cur)} on {cd}; open a new lane (the "
                     f"mesh tag) or re-measure")
+                continue
+            pe = prev.get("round_engine", "phased")
+            ce = cur.get("round_engine", "phased")
+            if pe != ce:
+                # Same trap, round-engine flavoured: a phased row and a
+                # megakernel row time different programs.  The
+                # ", megakernel" tag fragment normally keeps them in
+                # separate lanes; rows that still collide here are a
+                # hard error, never compared.
+                failures.append(
+                    f"lane {lane!r}: round-engine change mid-chain — "
+                    f"{_rowid(prev)} ran {pe!r}, {_rowid(cur)} "
+                    f"{ce!r}; the engines time different programs "
+                    f"(phased vs whole-round megakernel), so a delta "
+                    f"would be meaningless — re-measure one engine")
                 continue
             delta = (cur["value"] - prev["value"]) / prev["value"]
             line = (f"lane {lane!r} [{cur['backend']}]: "

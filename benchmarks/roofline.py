@@ -257,6 +257,25 @@ def main() -> None:
     measure("round_step_full", one_round,
             scan_factory(one_round, indexed=False), state)
 
+    # --- phase: the SAME full round on the whole-round megakernel
+    # (ops/megakernel.py: gather -> SWAR ingest -> confidence fold fused
+    # into one Pallas program, no [N,k] vote-packs or [N,T] ingest
+    # temporaries in HBM).  Bit-identical to round_step_full
+    # (tests/test_megakernel.py); comparing the two rows is the
+    # on-hardware A/B of the PR 16 engine.  On CPU (--quick) the kernel
+    # runs in interpreter mode, so the row pins dispatch plumbing, not
+    # fused-kernel bandwidth.
+    import dataclasses as _dc
+
+    mega_cfg = _dc.replace(cfg, round_engine="megakernel")
+
+    def one_round_mega(s):
+        return av.round_step(s, mega_cfg)[0]
+
+    measure("round_step_megakernel", one_round_mega,
+            scan_factory(one_round_mega, indexed=False), state,
+            tag=tag_from_config(mega_cfg))
+
     # --- phase: vote-ingest kernel alone (k fused window updates on the
     # record planes — RegisterVotes, `processor.go:92-117`).  Carry the
     # records AND the vote planes: closing over [N, T] planes bakes

@@ -35,7 +35,8 @@ def flagship_config(txs: int, k: int = 8, latency: int = 0,
                     stake: str = "off",
                     clusters: int = 1,
                     adversary: str = "off",
-                    byzantine: float = 0.0):
+                    byzantine: float = 0.0,
+                    round_engine: str = "phased"):
     """The flagship bench config alone — buildable without materializing
     state (how `benchmarks/hlo_pin.py` lowers the full-shape program
     abstractly): finalization unreachable within the timed window
@@ -68,7 +69,12 @@ def flagship_config(txs: int, k: int = 8, latency: int = 0,
     runs split_vote on the coalesced async flagship; policy off +
     byzantine 0 leaves every archived pin byte-identical (no context
     plane is built).  Adversary knobs change config VALUES only, never
-    state shapes, so `flagship_state` needs no adversary arguments."""
+    state shapes, so `flagship_state` needs no adversary arguments.
+    `round_engine` = "megakernel" swaps the phased round for the
+    whole-round fused Pallas program (`ops/megakernel.py`, pinned as
+    `flagship_megakernel`); "phased" (the default) leaves every
+    archived flagship pin byte-identical — the `hlo_pin.py
+    --verify-off-path` contract."""
     from go_avalanche_tpu.config import AvalancheConfig
 
     async_kw = {}
@@ -95,6 +101,7 @@ def flagship_config(txs: int, k: int = 8, latency: int = 0,
                            metrics_every=metrics_every,
                            trace_every=trace_every,
                            stake_mode=stake, n_clusters=clusters,
+                           round_engine=round_engine,
                            **async_kw, **adv_kw)
 
 

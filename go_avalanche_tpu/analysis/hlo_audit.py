@@ -333,7 +333,8 @@ def audit_text(text: str, what: str, *, callbacks: int = 0,
                donated_leaves: Optional[int] = None,
                collectives: FrozenSet = frozenset(),
                mesh_axes: Optional[Sequence[Tuple[str, int]]] = None,
-               plane_elems: Optional[int] = None) -> List[str]:
+               plane_elems: Optional[int] = None,
+               kernel_calls: Optional[Dict[str, int]] = None) -> List[str]:
     """Run every text-level contract over one lowered program.
 
     `callbacks` — exact python-callback budget; `donated_leaves` — flat
@@ -342,8 +343,16 @@ def audit_text(text: str, what: str, *, callbacks: int = 0,
     `collectives`/`mesh_axes` — the declared (kind, axes) allowlist and
     the audit mesh (None mesh: single-chip, zero collectives);
     `plane_elems` — the unpacked [N, T] element count for the
-    all-gather plane guard."""
+    all-gather plane guard; `kernel_calls` — the program's declared
+    accelerator-kernel custom-call budget (target -> MAX count, e.g.
+    the megakernel's Mosaic `tpu_custom_call`): listed targets are
+    allowed up to their cap instead of reported as undeclared, and an
+    over-budget count fails — a second kernel appearing in a
+    one-kernel program is a program change, not plumbing.  Interpreter
+    -mode lowerings (CPU) legitimately contain ZERO of them, so the
+    budget is a ceiling, not an exact count."""
     failures = []
+    kernel_calls = kernel_calls or {}
 
     got_cb = callback_calls(text)
     if got_cb != callbacks:
@@ -353,7 +362,15 @@ def audit_text(text: str, what: str, *, callbacks: int = 0,
             + ("an io_callback/debug print leaked into an off-path "
                "program" if got_cb > callbacks else
                "the declared tap vanished (stale contract?)"))
-    unknown = unknown_custom_calls(text)
+    targets = custom_call_targets(text)
+    for target, cap in sorted(kernel_calls.items()):
+        if targets.get(target, 0) > cap:
+            failures.append(
+                f"{what}: {targets[target]} {target} custom call(s), "
+                f"kernel budget allows at most {cap} — an extra "
+                f"accelerator kernel entered the program")
+    unknown = [t for t in unknown_custom_calls(text)
+               if t not in kernel_calls]
     if unknown:
         failures.append(
             f"{what}: undeclared custom-call target(s) "
@@ -420,6 +437,17 @@ def audit_text(text: str, what: str, *, callbacks: int = 0,
 # Exact python-callback budget per pinned program (absent: 0).  The
 # metrics tap is ONE unordered io_callback under a round-mod cond.
 PINNED_CALLBACK_BUDGET: Dict[str, int] = {"flagship_metrics": 1}
+
+# Accelerator-kernel custom-call budget per pinned program (absent:
+# none allowed).  `flagship_megakernel` embeds exactly ONE Pallas
+# program per round (ops/megakernel.py) — `tpu_custom_call` is
+# Mosaic's lowering target on TPU, and the scan body spells it once;
+# the CPU interpreter lowering contains zero (pure HLO emulation), so
+# the budget is a ceiling (audit_text docstring).  A second kernel in
+# a one-kernel program fails the audit.
+PINNED_KERNEL_BUDGET: Dict[str, Dict[str, int]] = {
+    "flagship_megakernel": {"tpu_custom_call": 1},
+}
 
 # Programs whose timed jit donates its state (everything except the
 # bare streaming step, which is lowered un-donated by design).
@@ -495,7 +523,8 @@ def audit_pinned(name: str, workload: Optional[Dict] = None) -> List[str]:
     return audit_text(
         text, f"{name}",
         callbacks=PINNED_CALLBACK_BUDGET.get(name, 0),
-        donated_leaves=donated)
+        donated_leaves=donated,
+        kernel_calls=PINNED_KERNEL_BUDGET.get(name))
 
 
 def audit_all_pinned(archive: Optional[Dict] = None) -> List[str]:
@@ -528,7 +557,8 @@ def audit_donation_compiled(name: str) -> List[str]:
     failures = audit_text(hlo_pin.strip_locations(text),
                           f"{name}@audit-shape",
                           callbacks=PINNED_CALLBACK_BUDGET.get(name, 0),
-                          donated_leaves=leaves)
+                          donated_leaves=leaves,
+                          kernel_calls=PINNED_KERNEL_BUDGET.get(name))
     compiled = _compile_pinned(name, workload)
     aliased = compiled_alias_count(compiled)
     if aliased != leaves:
@@ -643,7 +673,9 @@ def lower_pinned(name: str, workload: Dict):
                               stake=workload.get("stake", "off"),
                               clusters=workload.get("clusters", 1),
                               adversary=workload.get("adversary", "off"),
-                              byzantine=workload.get("byzantine", 0.0))
+                              byzantine=workload.get("byzantine", 0.0),
+                              round_engine=workload.get("round_engine",
+                                                        "phased"))
         if workload.get("exchange", "fused") != "fused":
             cfg = _dc.replace(cfg, fused_exchange=False)
         if workload.get("ingest", "u8") != "u8":

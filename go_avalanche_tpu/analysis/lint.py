@@ -29,6 +29,15 @@ lint subcommand runs anywhere.
                        in library modules: telemetry flows through the
                        obs planes (metrics tap / trace plane), never
                        ad-hoc prints in compiled code.
+  round-engine-seam    a library module (outside ops/) that pairs a
+                       phased exchange call (`gather_vote_packs` /
+                       `fused_vote_packs` / `legacy_vote_packs`) with a
+                       `register_packed_votes*` ingest call must also
+                       reference `round_engine` — dispatching to the
+                       whole-round megakernel (`ops/megakernel.py`) or
+                       rejecting the knob as inert.  A hand-wired
+                       exchange→ingest pair with no seam silently
+                       ignores `cfg.round_engine`.
 
 Adding a rule: give it an id + pinned message here, a fixture test in
 tests/test_analysis.py (one planted violation, one clean positive),
@@ -83,6 +92,13 @@ TRACED_SCOPE_FILES = {
 # Library scope for debug-print: the whole package.
 LIBRARY_SCOPE_PREFIX = "go_avalanche_tpu/"
 
+# round-engine-seam: the phased pipeline's two halves.  ops/ itself is
+# out of scope — the engines and the megakernel live there.
+ROUND_SEAM_OPS_PREFIX = "go_avalanche_tpu/ops/"
+ROUND_SEAM_EXCHANGE_CALLS = {"gather_vote_packs", "fused_vote_packs",
+                             "legacy_vote_packs"}
+ROUND_SEAM_INGEST_PREFIX = "register_packed_votes"
+
 # Per-rule allowlist: rule -> set of repo-relative files exempted.
 # Keep empty unless a reviewed exception exists; every entry needs a
 # docs/static_analysis.md row saying why.
@@ -91,6 +107,7 @@ ALLOWLIST: Dict[str, Set[str]] = {
     "config-jax-free": set(),
     "host-rng-in-traced": set(),
     "debug-print": set(),
+    "round-engine-seam": set(),
 }
 
 _MSG_CANONICAL = ("{name} has ONE spelling — bind/import it from "
@@ -106,6 +123,12 @@ _MSG_HOST_RNG = ("host RNG in traced code: models/ops/parallel draw "
 _MSG_DEBUG_PRINT = ("jax.debug.{attr} in a library module: telemetry "
                     "flows through the obs planes (metrics tap / trace "
                     "plane), never ad-hoc prints in compiled code")
+_MSG_ROUND_SEAM = ("phased exchange+ingest pair without a round-engine "
+                   "seam: a module pairing gather_vote_packs with "
+                   "register_packed_votes* must dispatch on "
+                   "cfg.round_engine or reject it as inert — otherwise "
+                   "the whole-round megakernel knob "
+                   "(ops/megakernel.py) is silently ignored")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -247,11 +270,44 @@ def _debug_print(tree: ast.AST, rel: str) -> List[Violation]:
     return out
 
 
+def _round_engine_seam(tree: ast.AST, rel: str) -> List[Violation]:
+    if not rel.startswith(LIBRARY_SCOPE_PREFIX):
+        return []
+    if rel.startswith(ROUND_SEAM_OPS_PREFIX):
+        return []
+    exchange_line = ingest_line = None
+    has_seam = False
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = (fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else None)
+            if name in ROUND_SEAM_EXCHANGE_CALLS:
+                if exchange_line is None:
+                    exchange_line = node.lineno
+            elif (name and name.startswith(ROUND_SEAM_INGEST_PREFIX)
+                    and ingest_line is None):
+                ingest_line = node.lineno
+        # The seam is any `round_engine` touch: the cfg attribute, a
+        # dispatch variable, or a `_reject_round_engine`-style guard.
+        if ((isinstance(node, ast.Attribute)
+                and "round_engine" in node.attr)
+                or (isinstance(node, ast.Name)
+                    and "round_engine" in node.id)):
+            has_seam = True
+    if exchange_line is not None and ingest_line is not None \
+            and not has_seam:
+        return [Violation(rel, max(exchange_line, ingest_line),
+                          "round-engine-seam", _MSG_ROUND_SEAM)]
+    return []
+
+
 _RULES = (
     ("canonical-spelling", _canonical_spelling),
     ("config-jax-free", _config_jax_free),
     ("host-rng-in-traced", _host_rng_in_traced),
     ("debug-print", _debug_print),
+    ("round-engine-seam", _round_engine_seam),
 )
 
 RULE_IDS = tuple(rule for rule, _ in _RULES)

@@ -265,6 +265,31 @@ class AvalancheConfig:
                                       #   Bit-exact either way — pinned
                                       #   by tests/test_swar.py across
                                       #   every config axis.
+    round_engine: str = "phased"      # whole-round execution engine for
+                                      #   the dense avalanche SYNC round
+                                      #   (models/avalanche.round_step).
+                                      #   "phased": the pinned per-phase
+                                      #   path — exchange gather, vote
+                                      #   ingest and confidence fold as
+                                      #   separate fused-op islands (the
+                                      #   archived flagship program).
+                                      #   "megakernel": ONE Pallas
+                                      #   program (ops/megakernel.py)
+                                      #   runs gather -> SWAR ingest ->
+                                      #   closed-form confidence with a
+                                      #   block's record planes resident
+                                      #   in VMEM across all k draws —
+                                      #   no [N, k] vote pack and no
+                                      #   intermediate [N, T] planes
+                                      #   round-trip HBM.  Sync
+                                      #   SEQUENTIAL rounds only (see
+                                      #   _validate_round_engine);
+                                      #   dag/snowball and the sharded
+                                      #   drivers keep phased and reject
+                                      #   the knob as inert.  Bit-exact
+                                      #   either way — pinned by
+                                      #   tests/test_megakernel.py in
+                                      #   interpreter mode.
     fused_sharded_gossip: bool = False
                                       # sharded gossip-admission scatter
                                       #   (parallel/sharded.py
@@ -927,6 +952,7 @@ class AvalancheConfig:
         self._validate_arrival()
         self._validate_stake()
         self._validate_adversary()
+        self._validate_round_engine()
         if self.latency_mode == "rtt":
             if self.rtt_matrix is None:
                 raise ValueError(
@@ -1445,6 +1471,62 @@ class AvalancheConfig:
                 "node is weightless and the eclipse set is arbitrary — "
                 "select a stake_mode ('zipf' puts the adversary on top "
                 "stake, the worst case)")
+
+    def _validate_round_engine(self) -> None:
+        """The whole-round megakernel covers the SYNC SEQUENTIAL round
+        only (one Pallas program: gather -> SWAR ingest -> closed-form
+        confidence, ops/megakernel.py).  Every knob whose machinery
+        lives between the phases the kernel fuses away is rejected as
+        inert at CONSTRUCTION (the `_validate_adversary` inert-knob
+        precedent — a silently ignored engine knob would mislabel the
+        A/B lane); run_sim and bench mirror these at their parsers.
+        """
+        if self.round_engine not in ("phased", "megakernel"):
+            raise ValueError(
+                f"round_engine must be 'phased' or 'megakernel', "
+                f"got {self.round_engine!r}")
+        if self.round_engine == "phased":
+            return
+        if self.vote_mode is not VoteMode.SEQUENTIAL:
+            raise ValueError(
+                "round_engine 'megakernel' fuses the SEQUENTIAL "
+                "window-ingest round (the SWAR kernel body); the "
+                "MAJORITY reduction has no windowed ingest to fuse")
+        if self.async_queries():
+            raise ValueError(
+                "round_engine 'megakernel' covers the synchronous "
+                "round only: the in-flight ring (latency_mode / "
+                "partition_spec / fault_script events) delivers votes "
+                "ACROSS rounds, outside the one fused program — run "
+                "the async lanes on round_engine 'phased'")
+        if self.inflight_engine != "walk":
+            raise ValueError(
+                f"inflight_engine {self.inflight_engine!r} set with "
+                f"round_engine 'megakernel': the kernel covers the "
+                f"sync round, so the delivery-engine knob is inert "
+                f"and would mislabel the A/B lane — leave it at "
+                f"'walk' (the default)")
+        if self.skip_absent_votes:
+            raise ValueError(
+                "round_engine 'megakernel' does not implement the "
+                "skip_absent_votes lane gating (same scoping as the "
+                "SWAR Pallas ingest it embeds) — use round_engine "
+                "'phased'")
+        if (self.byzantine_fraction > 0.0 and self.adversary_strategy
+                is AdversaryStrategy.EQUIVOCATE):
+            raise ValueError(
+                "round_engine 'megakernel' cannot reproduce the "
+                "EQUIVOCATE strategy's per-draw host-keyed coin "
+                "stream inside the kernel without materialising the "
+                "[N, k, T] lie planes it exists to remove — run "
+                "equivocation studies on round_engine 'phased'")
+        if self.adversary_policy != "off":
+            raise ValueError(
+                f"adversary_policy {self.adversary_policy!r} set with "
+                f"round_engine 'megakernel': the adaptive-adversary "
+                f"context transforms run between the phases the "
+                f"kernel fuses — run policy studies on round_engine "
+                f"'phased'")
 
     def _validate_rtt_matrix(self) -> None:
         """The cluster-pair RTT matrix must be square, match the

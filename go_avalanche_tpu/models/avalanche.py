@@ -416,7 +416,7 @@ def round_step(
         prefs = vr.is_accepted(state.records.confidence)   # [N, T]
         packed_prefs = pack_bool_plane(prefs)              # [N, ceil(T/8)]
         minority_t = adversary.minority_plane(prefs)       # [T]
-        if not inflight.enabled(cfg):
+        if not inflight.enabled(cfg) and cfg.round_engine != "megakernel":
             yes_pack, consider_pack = exchange.gather_vote_packs(
                 packed_prefs, peers, responded, lie, k_byz, cfg,
                 minority_t, t, pol)
@@ -426,37 +426,62 @@ def round_step(
     # `cfg.ingest_engine` selects the u8 reference or the SWAR
     # lane-packed engine (ops/swar.py) — identical bits either way.
     ring = state.inflight
-    with annotate("ingest_votes"):
-        if inflight.enabled(cfg):
-            # Async query lifecycle (ops/inflight.py): stamp this round's
-            # polls with per-draw latencies (+ the fault script's spikes
-            # and cuts), enqueue them, then run the delivery/expiry pass
-            # over the whole ring.  SEQUENTIAL-only (config-validated).
-            lat = inflight.draw_latency(k_sample, cfg, peers,
-                                        state.latency_weight, n)
-            lat = adversary.apply_policy_latency(cfg, lat, lie, withheld)
-            lat = inflight.apply_faults(lat, cfg, state.round, 0,
-                                        peers, n, state.fault_params)
-            ring = inflight.enqueue(state.inflight, state.round, peers,
-                                    lat, responded, lie, polled)
-            records, changed, votes_applied = inflight.deliver_multi_engine(
-                ring, state.records, cfg, packed_prefs, minority_t,
-                k_byz, state.round, t, live_rows=state.alive, ctx=pol)
-        elif cfg.vote_mode is VoteMode.SEQUENTIAL:
-            records, changed = vr.register_packed_votes_engine(
-                state.records, yes_pack, consider_pack, cfg.k, cfg,
-                update_mask=polled)
-            votes_applied = (popcnt_plane(consider_pack) * polled).sum()
-        else:
-            thresh = math.ceil(cfg.alpha * cfg.k)
-            yes_cnt = popcnt_plane(yes_pack & consider_pack)
-            no_cnt = popcnt_plane(~yes_pack & consider_pack)
-            err = jnp.where(yes_cnt >= thresh, jnp.int32(0),
-                            jnp.where(no_cnt >= thresh, jnp.int32(1),
-                                      jnp.int32(-1)))
-            records, changed = vr.register_vote(state.records, err, cfg,
-                                                update_mask=polled)
-            votes_applied = ((err >= 0) & polled).sum()
+    if cfg.round_engine == "megakernel":
+        # --- whole-round megakernel (ops/megakernel.py): the gather,
+        # the SWAR window ingest, and the closed-form confidence fold
+        # run as ONE Pallas program on VMEM-resident record tiles — the
+        # [N, k] vote packs and intermediate [N, T] planes above never
+        # reach HBM.  Bit-identical to the phased chain (pinned by
+        # tests/test_megakernel.py); sync round only (config-validated:
+        # no in-flight ring, SEQUENTIAL votes).  Imported lazily so the
+        # phased path never touches pallas at import time.
+        from go_avalanche_tpu.ops import megakernel
+        with annotate("fused_round"):
+            records, changed = megakernel.fused_round(
+                state.records, packed_prefs, peers, responded, lie,
+                minority_t, polled, cfg)
+            # consider_pack is the per-row responded popcount broadcast
+            # over txs, so the phased count folds to this closed form.
+            votes_applied = (responded.sum(axis=1).astype(jnp.int32)[:, None]
+                             * polled).sum()
+    else:
+        with annotate("ingest_votes"):
+            if inflight.enabled(cfg):
+                # Async query lifecycle (ops/inflight.py): stamp this
+                # round's polls with per-draw latencies (+ the fault
+                # script's spikes and cuts), enqueue them, then run the
+                # delivery/expiry pass over the whole ring.
+                # SEQUENTIAL-only (config-validated).
+                lat = inflight.draw_latency(k_sample, cfg, peers,
+                                            state.latency_weight, n)
+                lat = adversary.apply_policy_latency(cfg, lat, lie,
+                                                     withheld)
+                lat = inflight.apply_faults(lat, cfg, state.round, 0,
+                                            peers, n, state.fault_params)
+                ring = inflight.enqueue(state.inflight, state.round, peers,
+                                        lat, responded, lie, polled)
+                records, changed, votes_applied = (
+                    inflight.deliver_multi_engine(
+                        ring, state.records, cfg, packed_prefs, minority_t,
+                        k_byz, state.round, t, live_rows=state.alive,
+                        ctx=pol))
+            elif cfg.vote_mode is VoteMode.SEQUENTIAL:
+                records, changed = vr.register_packed_votes_engine(
+                    state.records, yes_pack, consider_pack, cfg.k, cfg,
+                    update_mask=polled)
+                votes_applied = (popcnt_plane(consider_pack)
+                                 * polled).sum()
+            else:
+                thresh = math.ceil(cfg.alpha * cfg.k)
+                yes_cnt = popcnt_plane(yes_pack & consider_pack)
+                no_cnt = popcnt_plane(~yes_pack & consider_pack)
+                err = jnp.where(yes_cnt >= thresh, jnp.int32(0),
+                                jnp.where(no_cnt >= thresh, jnp.int32(1),
+                                          jnp.int32(-1)))
+                records, changed = vr.register_vote(state.records, err,
+                                                    cfg,
+                                                    update_mask=polled)
+                votes_applied = ((err >= 0) & polled).sum()
 
     # --- lifecycle + telemetry.
     fin_after = vr.has_finalized(records.confidence, cfg)

@@ -331,6 +331,12 @@ def step(
     the scheduler writes the full record into `sim.trace`, the inner
     round's write is suppressed.
     """
+    if cfg.round_engine != "phased":
+        raise ValueError(
+            "round_engine 'megakernel' is wired for the dense avalanche "
+            "round only; the backlog window scheduler keeps the phased "
+            "inner round (the window width need not satisfy the "
+            "kernel's tiling contract) — the knob would be inert here")
     round_val = state.sim.round
     arrivals = jnp.int32(0)
     if state.traffic is not None:

@@ -236,6 +236,12 @@ def round_step(
     Like `avalanche.round_step` but responses vote conflict-set preference,
     and finalizing a set freezes its losers.
     """
+    if cfg.round_engine != "phased":
+        raise ValueError(
+            "round_engine 'megakernel' is wired for the dense avalanche "
+            "round only; the dag model keeps the phased path (fusing the "
+            "conflict-set preference vote is a ROADMAP follow-up) — the "
+            "knob would be inert here")
     base = state.base
     n, t = base.records.votes.shape
     k_sample, k_byz, k_drop, k_churn, k_next = jax.random.split(base.key, 5)

@@ -116,6 +116,11 @@ def round_step(
     cfg: AvalancheConfig = DEFAULT_CONFIG,
 ) -> Tuple[SnowballState, RoundTelemetry]:
     """One simulated network round.  Pure; jit/scan-able."""
+    if cfg.round_engine != "phased":
+        raise ValueError(
+            "round_engine 'megakernel' is wired for the dense avalanche "
+            "round only; the snowball/snowflake/slush family keeps the "
+            "phased path — the knob would be inert here")
     n = state.records.votes.shape[0]
     k_sample, k_byz, k_drop, k_churn, k_next = jax.random.split(state.key, 5)
 

@@ -15,6 +15,11 @@ fixed order:
 
     ", legacy-exchange"        cfg.fused_exchange False
     ", {engine}-ingest"        cfg.ingest_engine != "u8"
+    ", megakernel"             cfg.round_engine != "phased" (the
+                               whole-round fused Pallas program,
+                               ops/megakernel.py — an entirely
+                               different timed program from the
+                               phased chain)
     ", latency{N}"             async on with a latency distribution
     ", {mode}-latency"         cfg.latency_mode not fixed
     ", timeout{T}"             timeout differs from the bench-derived
@@ -80,6 +85,7 @@ PHASE_SPANS = (
     "gossip_admission",   # gossip scatter-max admission (gossip on)
     "gather_prefs",       # peer-preference gathers (exchange engines)
     "ingest_votes",       # RegisterVotes window ingest (u8/swar32)
+    "fused_round",        # whole-round megakernel (gather+ingest+conf)
 )
 
 
@@ -106,6 +112,8 @@ def tag_from_config(cfg: AvalancheConfig) -> str:
     tag = "" if cfg.fused_exchange else ", legacy-exchange"
     if cfg.ingest_engine != "u8":
         tag += f", {cfg.ingest_engine}-ingest"
+    if cfg.round_engine != "phased":
+        tag += ", megakernel"
     if cfg.async_queries():
         if cfg.latency_mode != "none":
             tag += f", latency{cfg.latency_rounds}"

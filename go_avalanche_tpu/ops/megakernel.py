@@ -1,0 +1,257 @@
+"""The whole-round megakernel: exchange -> ingest -> confidence in ONE
+Pallas program.
+
+The r05 roofline (PERF_NOTES.md) attributes the remaining flagship gap
+to memory, not compute: the phased round is ~6 fused-op islands that
+each round-trip the [N, k] vote packs and [N, T] record planes through
+HBM between phases.  This module fuses the hot sync round into one
+kernel so those intermediates never exist:
+
+  * the fused-exchange gather (`ops/exchange.fused_vote_packs`) becomes
+    an IN-KERNEL row gather of the bit-packed preference plane — the
+    whole [N, T/32] plane is VMEM-resident per column block, so all k
+    draws read it without HBM traffic and the [N, k] vote-pack planes
+    are never materialised;
+  * the SWAR packed-u32 window ingest and the branch-free closed-form
+    confidence fold run on the SAME VMEM-resident record tiles, via the
+    seams shared with `ops/pallas_vote` (`swar_window_fold`,
+    `swar_confidence_fold`) — the two engines cannot drift;
+  * gossip admission stays OUTSIDE the kernel, unchanged: it runs
+    before the gather in `models/avalanche.round_step` (and the
+    flagship lane runs gossip off), so there is nothing between it and
+    the fused program to round-trip.
+
+Layout.  Preferences arrive BIT-packed: `pack_u8_lanes(pack_bool_plane
+(prefs))` puts tx column c at bit ``c % 32`` of u32 word ``c // 32``
+(the layout algebra of `ops/swar.py` x `ops/bitops.py`), so one
+[N, T/32] u32 plane carries every peer's whole preference row at 1
+bit/column.  The record planes ride the SWAR u32 layout (4 tx columns
+per word); expanding a gathered bit word to SWAR lane-LSB words is a
+static nibble spread (`_nibble_expand`), pure element-wise i32.
+
+Adversary coverage matches `config._validate_round_engine`: FLIP is an
+in-kernel xor of the lie bit, OPPOSE_MAJORITY an in-kernel select of
+the (VMEM-resident) minority row.  EQUIVOCATE and the adaptive
+policies draw per-draw host-keyed coin streams that cannot be
+reproduced in-kernel without materialising the [N, k, T] planes this
+kernel exists to remove — both are rejected at config construction.
+
+Interpreter-mode parity against the phased round is pinned bit-for-bit
+by tests/test_megakernel.py (the same protocol as the SWAR ingest
+kernel: the body is Mosaic-shaped — element-wise i32 on
+identically-shaped tiles plus one row gather — but the hardware
+verdict, including Mosaic legalization of the traced-index gather, is
+a ROADMAP hardware-window item; this container has no TPU).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from go_avalanche_tpu.config import (AdversaryStrategy, AvalancheConfig,
+                                     DEFAULT_CONFIG)
+from go_avalanche_tpu.ops import pallas_vote, swar
+from go_avalanche_tpu.ops import voterecord as vr
+from go_avalanche_tpu.ops.bitops import pack_bool_plane
+
+# Word-shaped like DEFAULT_BLOCK_SWAR: a (64, 128)-word record tile is a
+# (64, 512)-column tile; its preference slice is 128 // 8 = 16 bit words.
+DEFAULT_BLOCK_MEGA = (64, 128)
+
+_LSB = 0x01010101
+
+
+def _divisor(dim: int, cap: int, multiple: int = 1) -> Optional[int]:
+    """Largest block edge <= cap that divides `dim` and is a multiple of
+    `multiple` (static Python — grid shapes are compile-time)."""
+    for d in range(min(cap, dim), 0, -1):
+        if dim % d == 0 and d % multiple == 0:
+            return d
+    return None
+
+
+def _nibble_expand(g: jax.Array) -> jax.Array:
+    """Bit-packed pref words ``[rows, w32]`` i32 -> SWAR lane-LSB words
+    ``[rows, w32 * 8]``: SWAR word w4 covers tx columns ``4*w4 ..
+    4*w4+3`` = bits ``4*(w4 % 8) ..`` of bit word ``w4 // 8``, so each
+    bit word spreads into 8 nibbles, one bit per byte lane.  Pure
+    element-wise i32 after a static-repeat broadcast; `& 0xF` discards
+    the arithmetic shift's sign extension."""
+    rep = jnp.repeat(g, 8, axis=1)
+    col = lax.broadcasted_iota(jnp.int32, rep.shape, 1)
+    nib = (rep >> ((col & 7) * 4)) & 0xF
+    return ((nib & 1)
+            | (((nib >> 1) & 1) << 8)
+            | (((nib >> 2) & 1) << 16)
+            | (((nib >> 3) & 1) << 24))
+
+
+def _mega_kernel(votes_ref, consider_ref, conf_refs, prefs_ref, peers_ref,
+                 resp_ref, lie_ref, minority_ref, mask_ref, votes_o,
+                 consider_o, conf_os, changed_o, *, k: int,
+                 cfg: AvalancheConfig) -> None:
+    """One [bn, bt4] record tile's whole round: gather each draw's
+    preference bits from the VMEM-resident [N, bw32] plane slice, apply
+    the static adversary transform, and feed the shared SWAR window +
+    confidence seams.  The record tile stays resident across all k
+    draws — the grid/block contract of the module docstring."""
+    orig_votes = votes_ref[:].astype(jnp.int32)
+    orig_consider = consider_ref[:].astype(jnp.int32)
+    votes, consider = orig_votes, orig_consider
+    prefs_bits = prefs_ref[:].astype(jnp.int32)    # [N, bw32], all rows
+    peers = peers_ref[:]                           # [bn, k] i32
+    resp = resp_ref[:]                             # [bn, k] i32 {0, 1}
+    lie = lie_ref[:]                               # [bn, k] i32 {0, 1}
+
+    attack = cfg.byzantine_fraction > 0.0
+    oppose = (attack and cfg.adversary_strategy
+              is AdversaryStrategy.OPPOSE_MAJORITY)
+    flip = attack and cfg.adversary_strategy is AdversaryStrategy.FLIP
+    minority = (_nibble_expand(minority_ref[:].astype(jnp.int32))
+                if oppose else None)               # [1, bt4] lane-LSB
+
+    def draw_bits(j):
+        gathered = prefs_bits[peers[:, j]]         # [bn, bw32] row gather
+        raw = _nibble_expand(gathered)             # [bn, bt4] lane-LSB
+        lie_j = lie[:, j:j + 1]
+        if oppose:
+            sel = lie_j * jnp.int32(-1)            # all-ones where lying
+            raw = (raw & ~sel) | (minority & sel)
+        elif flip:
+            raw = raw ^ (lie_j * _LSB)
+        return raw, resp[:, j:j + 1] * _LSB
+
+    votes, consider, out_yes, out_concl = pallas_vote.swar_window_fold(
+        votes, consider, draw_bits, k=k, cfg=cfg)
+
+    # Masked select IN-kernel (unlike the SWAR ingest wrapper's outside
+    # `where`): the update mask is already a kernel input for the
+    # confidence fold, so restoring unpolled records here saves the
+    # wrapper two whole-plane HBM round-trips.  keep = 0xFF per polled
+    # byte lane (the mask words carry 0/1 per lane).
+    keep = mask_ref[:].astype(jnp.int32) * 0xFF
+    votes_o[:] = ((votes & keep) | (orig_votes & ~keep)).astype(jnp.uint32)
+    consider_o[:] = ((consider & keep)
+                     | (orig_consider & ~keep)).astype(jnp.uint32)
+    pallas_vote.swar_confidence_fold(out_yes, out_concl, conf_refs,
+                                     mask_ref, conf_os, changed_o, cfg=cfg)
+
+
+def fused_round(
+    records: vr.VoteRecordState,
+    packed_prefs: jax.Array,
+    peers: jax.Array,
+    responded: jax.Array,
+    lie: jax.Array,
+    minority_t: jax.Array,
+    polled: jax.Array,
+    cfg: AvalancheConfig = DEFAULT_CONFIG,
+    block: Tuple[int, int] = DEFAULT_BLOCK_MEGA,
+    interpret: Optional[bool] = None,
+) -> Tuple[vr.VoteRecordState, jax.Array]:
+    """The `cfg.round_engine = "megakernel"` dispatch seam: one Pallas
+    program for gather -> SWAR ingest -> closed-form confidence.
+
+    Inputs are the phased round's own intermediates — `packed_prefs`
+    the bit-packed ``[N, ceil(T/8)]`` preference plane, `peers` int32
+    ``[N, k]``, `responded`/`lie` bool ``[N, k]``, `minority_t` bool
+    ``[T]``, `polled` the bool update mask — so
+    `models/avalanche.round_step` swaps engines without re-deriving
+    anything.  Returns ``(new_records, changed)`` bit-identical to
+    `exchange.gather_vote_packs` + `voterecord.
+    register_packed_votes_engine` on every supported config (pinned by
+    tests/test_megakernel.py).
+
+    Shape contract: ``t % 32 == 0`` (whole bit words — the SWAR lane
+    split needs t % 4 anyway) and `n` divisible by some block height;
+    the column block is the largest divisor of ``t/4`` within `block`
+    that keeps whole bit words (a multiple of 8), so odd tilings like
+    t = 1184 run with a narrow boundary block rather than failing.
+    `interpret` defaults to True off-TPU (the SWAR-kernel protocol).
+    """
+    n, t = records.votes.shape
+    if not (0 < cfg.k <= 8):
+        raise ValueError("megakernel packs per-draw outcomes into byte "
+                         "lanes: k must be in (0, 8]")
+    if t % 32:
+        raise ValueError(f"txs axis ({t}) must divide by 32 (whole "
+                         f"bit-packed preference words)")
+    t4 = t // 4
+    bn = _divisor(n, min(block[0], n))
+    bt4 = _divisor(t4, min(block[1], t4), multiple=8)
+    if bn is None or bt4 is None:
+        raise ValueError(f"word shape {(n, t4)} does not tile under "
+                         f"{block} (column blocks must keep whole bit "
+                         f"words)")
+    bw32 = bt4 // 8
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    votes_w = swar.pack_u8_lanes(records.votes)
+    cons_w = swar.pack_u8_lanes(records.consider)
+    confs = [records.confidence[:, lane::4] for lane in range(4)]
+    prefs_bits = swar.pack_u8_lanes(packed_prefs)          # [N, T/32] u32
+    minority_bits = swar.pack_u8_lanes(
+        pack_bool_plane(minority_t[None, :]))              # [1, T/32] u32
+    mask_u8 = polled.astype(jnp.uint8)
+    mask_w = swar.pack_u8_lanes(mask_u8)
+
+    k = cfg.k
+    rec_spec = pl.BlockSpec((bn, bt4), lambda i, j: (i, j),
+                            memory_space=pltpu.VMEM)
+    # ALL N preference rows resident per column block: peer ids are
+    # arbitrary rows, so the gather must see the whole node axis.  At
+    # the 16384^2 flagship that is 16384 * 16 words * 4 B = 1 MB of
+    # VMEM — the 8x bit packing is what makes residency affordable.
+    prefs_spec = pl.BlockSpec((n, bw32), lambda i, j: (0, j),
+                              memory_space=pltpu.VMEM)
+    row_spec = pl.BlockSpec((bn, k), lambda i, j: (i, 0),
+                            memory_space=pltpu.VMEM)
+    minority_spec = pl.BlockSpec((1, bw32), lambda i, j: (0, j),
+                                 memory_space=pltpu.VMEM)
+    grid = (n // bn, t4 // bt4)
+
+    def kernel(votes_ref, consider_ref, c0, c1, c2, c3, prefs_ref,
+               peers_ref, resp_ref, lie_ref, minority_ref, mask_ref,
+               votes_o, consider_o, o0, o1, o2, o3, changed_o):
+        _mega_kernel(votes_ref, consider_ref, (c0, c1, c2, c3), prefs_ref,
+                     peers_ref, resp_ref, lie_ref, minority_ref, mask_ref,
+                     votes_o, consider_o, (o0, o1, o2, o3), changed_o,
+                     k=k, cfg=cfg)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[rec_spec] * 6 + [prefs_spec, row_spec, row_spec,
+                                   row_spec, minority_spec, rec_spec],
+        out_specs=[rec_spec] * 7,
+        out_shape=[
+            jax.ShapeDtypeStruct((n, t4), jnp.uint32),
+            jax.ShapeDtypeStruct((n, t4), jnp.uint32),
+            jax.ShapeDtypeStruct((n, t4), jnp.uint16),
+            jax.ShapeDtypeStruct((n, t4), jnp.uint16),
+            jax.ShapeDtypeStruct((n, t4), jnp.uint16),
+            jax.ShapeDtypeStruct((n, t4), jnp.uint16),
+            jax.ShapeDtypeStruct((n, t4), jnp.uint32),
+        ],
+        interpret=interpret,
+    )(votes_w, cons_w, *confs, prefs_bits,
+      peers.astype(jnp.int32), responded.astype(jnp.int32),
+      lie.astype(jnp.int32), minority_bits, mask_w)
+    new_votes_w, new_cons_w, o0, o1, o2, o3, changed_w = out
+
+    new_votes = swar.unpack_u8_lanes(new_votes_w, t)
+    new_consider = swar.unpack_u8_lanes(new_cons_w, t)
+    confidence = jnp.stack([o0, o1, o2, o3], axis=-1).reshape(n, t)
+    # All three planes come back fully masked: the kernel restores
+    # unpolled votes/consider lanes itself, so no host-side `where`
+    # (and no extra whole-plane HBM round-trip) is needed.
+    changed = swar.expand_lane_mask(changed_w, t)
+    return (vr.VoteRecordState(new_votes, new_consider, confidence),
+            changed)

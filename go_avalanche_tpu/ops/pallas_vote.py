@@ -140,29 +140,26 @@ def _popcount8_i32(x: jax.Array) -> jax.Array:
     return (x + (x >> 4)) & 0x0F0F0F0F
 
 
-def _vote_kernel_swar(votes_ref, consider_ref, yes_ref, cons_ref, conf_refs,
-                      mask_ref, votes_o, consider_o, conf_os, changed_o,
-                      *, k: int, cfg: AvalancheConfig) -> None:
-    """The SWAR-input kernel body: every plane arrives PRE-PACKED as u32
-    words (4 tx columns per word, `ops/swar.py` layout), so the i32
-    arithmetic below IS the storage width — none of the u8->i32 widening
-    that cost the r03 kernel 4x register/VMEM traffic on the window
-    planes.  Confidence rides as 4 per-lane u16 planes (one per
-    ``t % 4`` residue, split outside the kernel), each widened 2x to i32
-    — the irreducible remainder, since its 15-bit counter cannot
-    lane-pack into a byte.
+def swar_window_fold(votes, consider, draw_bits, *, k: int,
+                     cfg: AvalancheConfig):
+    """The shared SWAR window-update loop: k draws of evict / count /
+    shift / per-lane quorum compare on pre-packed i32 word tiles.
 
-    Every op is element-wise i32 on identically-shaped [bn, bt4] tiles:
-    no reshapes, no sub-32-bit vectors, no strided access — exactly the
+    ``draw_bits(j) -> (in_yes_raw, in_cons)`` supplies draw j's vote and
+    consider bits as lane-LSB i32 words (``& 0x01010101``-shaped values,
+    broadcastable against `votes`).  The ingest kernel reads them off its
+    pre-packed outcome planes; the whole-round megakernel
+    (`ops/megakernel.py`) gathers them from the VMEM-resident preference
+    plane — the seam both share so their window semantics can never
+    drift.  Returns ``(votes, consider, out_yes, out_concl)`` with the
+    per-draw outcomes bit-packed per lane (bit j of lane byte = draw j).
+
+    Every op is element-wise i32 on identically-shaped tiles: no
+    reshapes, no sub-32-bit vectors, no strided access — exactly the
     shapes Mosaic vectorizes.  Right shifts on i32 sign-extend; every
     ``>>`` below is followed by a mask that discards the extended bits.
     """
     lsb, msb = 0x01010101, _i32c(0x80808080)
-    votes = votes_ref[:].astype(jnp.int32)
-    consider = consider_ref[:].astype(jnp.int32)
-    yes_w = yes_ref[:].astype(jnp.int32)
-    pack_w = cons_ref[:].astype(jnp.int32)
-
     window_lanes = ((1 << cfg.window) - 1) * lsb
     full_window = cfg.window == 8
     top_bit = cfg.window - 1
@@ -175,8 +172,7 @@ def _vote_kernel_swar(votes_ref, consider_ref, yes_ref, cons_ref, conf_refs,
     out_concl = jnp.zeros(votes.shape, jnp.int32)
 
     for j in range(k):
-        in_yes_raw = (yes_w >> j) & lsb
-        in_cons = (pack_w >> j) & lsb
+        in_yes_raw, in_cons = draw_bits(j)
         in_yes = in_yes_raw & in_cons
 
         evict_yes = ((votes & consider) >> top_bit) & lsb
@@ -198,52 +194,100 @@ def _vote_kernel_swar(votes_ref, consider_ref, yes_ref, cons_ref, conf_refs,
         out_yes |= (yes_m >> (7 - j)) & lane_bit_j
         out_concl |= (concl_m >> (7 - j)) & lane_bit_j
 
-    votes_o[:] = votes.astype(jnp.uint32)
-    consider_o[:] = consider.astype(jnp.uint32)
+    return votes, consider, out_yes, out_concl
 
-    # Closed-form confidence fold per byte lane (the
-    # `voterecord._confidence_closed_form` algebra, one lane at a time so
-    # every array stays [bn, bt4] i32).
-    changed_packed = jnp.zeros(votes.shape, jnp.int32)
+
+def swar_confidence_lane(conf, concl, yes, *, cfg: AvalancheConfig):
+    """One byte lane of the closed-form confidence fold (the
+    `voterecord._confidence_closed_form` algebra on i32 arrays): `conf`
+    is the lane's u16 plane widened to i32, `concl`/`yes` the lane's
+    bit-packed per-draw outcomes (low 8 bits, draw j at bit j, yes
+    already masked conclusive).  Returns ``(new_conf, lane_changed)``
+    un-masked — callers apply their own update mask.  Shared verbatim
+    by the SWAR ingest kernel and the whole-round megakernel."""
+    a0 = conf & 1
+    c0 = conf >> 1
+    has_concl = concl != 0
+
+    flips = (concl & (yes ^ (a0 * 0xFF))) != 0
+
+    f = concl | (concl >> 1)
+    f |= f >> 2
+    f |= f >> 4
+    high = f ^ (f >> 1)
+    a_fin = jnp.where(has_concl, (yes & high) != 0, a0 != 0)
+
+    disagree = concl & (yes ^ (a_fin.astype(jnp.int32) * 0xFF))
+    d = disagree | (disagree >> 1)
+    d |= d >> 2
+    d |= d >> 4
+    run = _popcount8_i32(concl & (~d & 0xFF))
+    pc = _popcount8_i32(concl)
+
+    counter = jnp.where(flips, run - 1,
+                        jnp.minimum(c0 + pc, 0x7FFF))
+    new_conf = (counter << 1) | a_fin.astype(jnp.int32)
+
+    score = cfg.finalization_score
+    crossed = (c0 < score) & ((c0 + pc) >= score)
+    if score == 0x7FFF:
+        crossed = crossed | ((c0 == 0x7FFF) & (pc > 0))
+    return new_conf, flips | crossed
+
+
+def swar_confidence_fold(out_yes, out_concl, conf_refs, mask_ref, conf_os,
+                         changed_o, *, cfg: AvalancheConfig) -> None:
+    """Apply the closed-form fold to all 4 confidence lanes and write the
+    masked outputs: the shared tail of the SWAR ingest kernel and the
+    megakernel (both produce identical (out_yes, out_concl) packings
+    from `swar_window_fold`)."""
+    changed_packed = jnp.zeros(out_yes.shape, jnp.int32)
     for lane in range(4):
         conf = conf_refs[lane][:].astype(jnp.int32)
         concl = (out_concl >> (8 * lane)) & 0xFF
         yes = ((out_yes >> (8 * lane)) & 0xFF) & concl
-        a0 = conf & 1
-        c0 = conf >> 1
-        has_concl = concl != 0
-
-        flips = (concl & (yes ^ (a0 * 0xFF))) != 0
-
-        f = concl | (concl >> 1)
-        f |= f >> 2
-        f |= f >> 4
-        high = f ^ (f >> 1)
-        a_fin = jnp.where(has_concl, (yes & high) != 0, a0 != 0)
-
-        disagree = concl & (yes ^ (a_fin.astype(jnp.int32) * 0xFF))
-        d = disagree | (disagree >> 1)
-        d |= d >> 2
-        d |= d >> 4
-        run = _popcount8_i32(concl & (~d & 0xFF))
-        pc = _popcount8_i32(concl)
-
-        counter = jnp.where(flips, run - 1,
-                            jnp.minimum(c0 + pc, 0x7FFF))
-        new_conf = (counter << 1) | a_fin.astype(jnp.int32)
-
-        score = cfg.finalization_score
-        crossed = (c0 < score) & ((c0 + pc) >= score)
-        if score == 0x7FFF:
-            crossed = crossed | ((c0 == 0x7FFF) & (pc > 0))
-        lane_changed = flips | crossed
-
+        new_conf, lane_changed = swar_confidence_lane(conf, concl, yes,
+                                                      cfg=cfg)
         lane_mask = ((mask_ref[:].astype(jnp.int32) >> (8 * lane)) & 1) != 0
         conf_os[lane][:] = jnp.where(lane_mask, new_conf,
                                      conf).astype(jnp.uint16)
         changed_packed |= ((lane_changed & lane_mask)
                            .astype(jnp.int32) << (8 * lane))
     changed_o[:] = changed_packed.astype(jnp.uint32)
+
+
+def _vote_kernel_swar(votes_ref, consider_ref, yes_ref, cons_ref, conf_refs,
+                      mask_ref, votes_o, consider_o, conf_os, changed_o,
+                      *, k: int, cfg: AvalancheConfig) -> None:
+    """The SWAR-input kernel body: every plane arrives PRE-PACKED as u32
+    words (4 tx columns per word, `ops/swar.py` layout), so the i32
+    arithmetic IS the storage width — none of the u8->i32 widening that
+    cost the r03 kernel 4x register/VMEM traffic on the window planes.
+    Confidence rides as 4 per-lane u16 planes (one per ``t % 4``
+    residue, split outside the kernel), each widened 2x to i32 — the
+    irreducible remainder, since its 15-bit counter cannot lane-pack
+    into a byte.
+
+    The body is `swar_window_fold` reading draw bits off the pre-packed
+    outcome planes, plus the shared `swar_confidence_fold` tail — the
+    megakernel runs the same two seams with a gathered draw source.
+    """
+    lsb = 0x01010101
+    votes = votes_ref[:].astype(jnp.int32)
+    consider = consider_ref[:].astype(jnp.int32)
+    yes_w = yes_ref[:].astype(jnp.int32)
+    pack_w = cons_ref[:].astype(jnp.int32)
+
+    def draw_bits(j):
+        return (yes_w >> j) & lsb, (pack_w >> j) & lsb
+
+    votes, consider, out_yes, out_concl = swar_window_fold(
+        votes, consider, draw_bits, k=k, cfg=cfg)
+
+    votes_o[:] = votes.astype(jnp.uint32)
+    consider_o[:] = consider.astype(jnp.uint32)
+    swar_confidence_fold(out_yes, out_concl, conf_refs, mask_ref, conf_os,
+                         changed_o, cfg=cfg)
 
 
 def register_packed_votes_pallas_swar(

@@ -555,6 +555,19 @@ def _shard_mapped(mesh, fn, track_finality: bool = True,
                      out_specs=(specs, tel_specs), check_vma=False)
 
 
+def _reject_round_engine(cfg: AvalancheConfig) -> None:
+    """The sharded drivers run the phased per-phase round: the
+    megakernel's in-kernel gather needs the WHOLE node axis resident,
+    which is exactly the axis these drivers shard away.  Reject rather
+    than silently fall back (the PR-13 inert-knob rule)."""
+    if cfg.round_engine != "phased":
+        raise ValueError(
+            "round_engine 'megakernel' is wired for the single-device "
+            "dense avalanche round only; the sharded drivers keep the "
+            "phased path (the fused gather needs the full node axis "
+            "resident per device) — the knob would be inert here")
+
+
 def make_sharded_round_step(mesh, cfg: AvalancheConfig = DEFAULT_CONFIG,
                             donate: bool = False):
     """Build a jitted one-round step over the mesh; call it with a (global)
@@ -563,6 +576,7 @@ def make_sharded_round_step(mesh, cfg: AvalancheConfig = DEFAULT_CONFIG,
     `donate=True` donates the input state to each call (in-place plane
     updates) — callers must chain ``state = step(state)[0]`` and never
     reuse a consumed state."""
+    _reject_round_engine(cfg)
     n_tx = mesh.shape[TXS_AXIS]
     cache = {}
 
@@ -595,6 +609,7 @@ def scan_program(mesh, state: AvalancheSimState,
     `bench.flagship_program` seam, applied to the mesh drivers).  Only
     tree structure and shapes are read from `state`, so abstract
     (`jax.eval_shape`) states lower on any host."""
+    _reject_round_engine(cfg)
     n_global = state.records.votes.shape[0]
     n_tx = mesh.shape[TXS_AXIS]
 
@@ -630,6 +645,7 @@ def settle_program(mesh, state: AvalancheSimState,
     """The jitted run-until-settled program `run_sharded` executes
     (while_loop + psum'd settled flag) — the audit seam twin of
     `scan_program`."""
+    _reject_round_engine(cfg)
     n_global = state.records.votes.shape[0]
     n_tx = mesh.shape[TXS_AXIS]
 

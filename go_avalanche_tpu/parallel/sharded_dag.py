@@ -324,6 +324,7 @@ def make_sharded_dag_round_step(mesh, cfg: AvalancheConfig = DEFAULT_CONFIG,
     """Build a jitted one-round DAG step over the mesh; call it with a
     (global) `DagSimState` placed by `shard_dag_state`.  `donate=True`
     donates the input state per call (chain, never reuse)."""
+    sharded._reject_round_engine(cfg)
     cache = {}
 
     n_tx = mesh.shape[TXS_AXIS]
@@ -368,6 +369,7 @@ def settle_program(mesh, state: DagSimState,
     — exposed unexecuted so `analysis/hlo_audit.py` lowers THE driver
     program (the `bench.flagship_program` seam).  Only tree structure
     and shapes are read from `state`; abstract states lower fine."""
+    sharded._reject_round_engine(cfg)
     n_global = state.base.records.votes.shape[0]
     n_tx = mesh.shape[TXS_AXIS]
 

@@ -222,6 +222,8 @@ def fleet_scan_program(mesh: Mesh, cfg, n_rounds: int):
     byte-identical to the archived `fleet_small` chain).
     """
     from go_avalanche_tpu.models import avalanche as av
+    from go_avalanche_tpu.parallel.sharded import _reject_round_engine
+    _reject_round_engine(cfg)
 
     def run_one(s):
         def body(st, _):

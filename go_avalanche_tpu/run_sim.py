@@ -153,6 +153,7 @@ def build_config(args: argparse.Namespace) -> AvalancheConfig:
         arrival_cluster_weights=getattr(
             args, "arrival_cluster_weights_parsed", None),
         ingest_engine=getattr(args, "ingest_engine", "u8"),
+        round_engine=getattr(args, "round_engine", "phased"),
         inflight_engine=getattr(args, "inflight_engine", "walk"),
         metrics_every=(getattr(args, "metrics_every", 0)
                        if getattr(args, "metrics", None) else 0),
@@ -957,6 +958,20 @@ def main(argv=None) -> Dict:
                              "columns lane-packed per uint32 word with the "
                              "closed-form confidence fold (ops/swar.py). "
                              "Bit-exact either way")
+    parser.add_argument("--round-engine",
+                        choices=["phased", "megakernel"],
+                        default="phased",
+                        help="whole-round execution engine for the dense "
+                             "avalanche round (cfg.round_engine): "
+                             "'phased' = the per-phase chain "
+                             "(reference), 'megakernel' = ONE Pallas "
+                             "program fusing the exchange gather, the "
+                             "SWAR window ingest, and the closed-form "
+                             "confidence fold (ops/megakernel.py).  "
+                             "Bit-exact either way; --model avalanche "
+                             "synchronous rounds only — async/in-flight "
+                             "knobs, adaptive adversary policies, and "
+                             "the other models reject it as inert")
     parser.add_argument("--inflight-engine",
                         choices=["walk", "walk_earlyout", "coalesced"],
                         default="walk",
@@ -1130,6 +1145,64 @@ def main(argv=None) -> Dict:
             f"policy context (models snowball/avalanche/dag/backlog/"
             f"streaming_dag/node_stream); the family models "
             f"(slush/snowflake) predate it — got {args.model}")
+
+    # Round-engine validation: the megakernel fuses the dense avalanche
+    # SYNCHRONOUS round only (ops/megakernel.py).  Mirror the config's
+    # _validate_round_engine rejections at the parser (the PR 5 rule)
+    # so the flags are named instead of the config fields.
+    if getattr(args, "round_engine", "phased") != "phased":
+        if args.model != "avalanche":
+            parser.error(
+                f"--round-engine megakernel is wired for --model "
+                f"avalanche (the dense synchronous round); {args.model} "
+                f"keeps the phased path — the knob would be inert")
+        if args.latency_mode != "none" or args.partition:
+            parser.error(
+                "--round-engine megakernel covers the synchronous "
+                "round only; --latency-mode/--partition deliver votes "
+                "ACROSS rounds through the in-flight ring, outside the "
+                "one fused program — run the async lanes on the "
+                "phased engine")
+        if args.inflight_engine != "walk":
+            parser.error(
+                "--inflight-engine selects the async ring's delivery "
+                "engine; --round-engine megakernel never builds the "
+                "ring — the knob would be silently inert")
+        if args.skip_absent_votes:
+            parser.error(
+                "--skip-absent-votes selects the MAJORITY-threshold "
+                "ingest; the megakernel fuses the SEQUENTIAL window "
+                "ingest — run the majority A/B on the phased engine")
+        if args.vote_mode != VoteMode.SEQUENTIAL.value:
+            parser.error(
+                f"--round-engine megakernel fuses the SEQUENTIAL "
+                f"window ingest; --vote-mode {args.vote_mode} keeps "
+                f"the phased path")
+        if args.adversary_policy != "off":
+            parser.error(
+                "--adversary-policy reads per-round context planes the "
+                "fused program does not thread; run the adaptive-"
+                "adversary lanes on the phased engine")
+        if (args.byzantine > 0
+                and args.adversary == AdversaryStrategy.EQUIVOCATE.value):
+            parser.error(
+                "--adversary equivocate draws per-draw coin streams "
+                "the fused program cannot replay in-kernel; run it on "
+                "the phased engine")
+        if args.mesh:
+            parser.error(
+                "--round-engine megakernel is the single-device dense "
+                "lane; the --mesh drivers keep the phased path "
+                "(parallel/sharded.py rejects the knob)")
+        if args.fleet is not None or args.fleet_shape is not None:
+            parser.error(
+                "--round-engine megakernel is the single-sim dense "
+                "lane; the fleet drivers keep the phased path")
+        if args.txs % 32:
+            parser.error(
+                f"--round-engine megakernel needs --txs divisible by "
+                f"32 (whole bit-packed preference words), got "
+                f"{args.txs}")
 
     # Fleet-mode validation: everything parser-level (the PR 5 rule).
     args.phase_grid_parsed = None

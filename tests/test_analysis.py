@@ -132,6 +132,42 @@ def test_lint_debug_print_library_scope_only():
     assert lint.lint_source(src, "examples/scratch.py") == []
 
 
+def test_lint_round_engine_seam():
+    # A hand-wired exchange→ingest pair with no round_engine touch.
+    bad = ("from go_avalanche_tpu.ops import exchange\n"
+           "from go_avalanche_tpu.ops import voterecord as vr\n"
+           "def my_round(state, cfg, peers):\n"
+           "    y, c = exchange.gather_vote_packs(state, peers)\n"
+           "    return vr.register_packed_votes_engine(state, y, c,\n"
+           "                                           cfg.k, cfg)\n")
+    vs = lint.lint_source(bad, "go_avalanche_tpu/models/foo.py")
+    assert [v.rule for v in vs] == ["round-engine-seam"]
+    assert "megakernel" in vs[0].message
+    # ...anchored at the later of the two seam halves (the ingest call).
+    assert vs[0].line == 5
+    # The same pair WITH the dispatch seam is clean.
+    ok = bad.replace(
+        "    y, c = exchange",
+        "    if cfg.round_engine != 'phased':\n"
+        "        raise ValueError('inert here')\n"
+        "    y, c = exchange")
+    assert lint.lint_source(ok, "go_avalanche_tpu/models/foo.py") == []
+    # A `_reject_round_engine`-style guard call also counts as a seam.
+    guarded = "def _reject_round_engine(cfg):\n    pass\n" + bad.replace(
+        "    y, c = exchange",
+        "    _reject_round_engine(cfg)\n    y, c = exchange")
+    assert lint.lint_source(
+        guarded, "go_avalanche_tpu/parallel/foo.py") == []
+    # ops/ is out of scope — the engines themselves live there.
+    assert lint.lint_source(bad, "go_avalanche_tpu/ops/foo.py") == []
+    # Either half alone is fine: only the PAIR bypasses the dispatch.
+    half = ("from go_avalanche_tpu.ops import voterecord as vr\n"
+            "def ingest(recs, y, c, cfg):\n"
+            "    return vr.register_packed_votes_engine(recs, y, c,\n"
+            "                                           cfg.k, cfg)\n")
+    assert lint.lint_source(half, "go_avalanche_tpu/models/foo.py") == []
+
+
 def test_repo_is_lint_clean():
     """The PR-12 acceptance bar: the committed tree has zero violations
     under every rule (the lint sweep fixed the duplicate spellings)."""

@@ -1,0 +1,191 @@
+"""Whole-round megakernel parity vs the phased pipeline (PR 16).
+
+Every test drives BOTH engines through `models/avalanche.round_step`
+itself — the megakernel's inputs are the phased round's own
+intermediates, so parity through the real dispatch seam is the claim
+that matters.  Runs in Pallas interpreter mode on the CPU test backend
+(the same bit-for-bit protocol as tests/test_pallas.py); the Mosaic
+hardware lowering is the ROADMAP hardware-window follow-up.
+
+Fast core = tier-1; the randomized config-matrix grid and the long
+trajectory ride the `slow` lane.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_avalanche_tpu.config import (
+    DEFAULT_CONFIG,
+    AdversaryStrategy,
+    AvalancheConfig,
+)
+from go_avalanche_tpu.models import avalanche as av
+
+
+def _run(cfg, seed=0, rounds=4, n=64, t=512):
+    key = jax.random.PRNGKey(seed)
+    pref = av.contested_init_pref(seed, n, t)
+    state = av.init(key, n, t, cfg, init_pref=pref)
+    tel = None
+    for _ in range(rounds):
+        state, tel = av.round_step(state, cfg)
+    return state, tel
+
+
+def _assert_engines_match(base_cfg, seed=0, rounds=4, n=64, t=512):
+    mega_cfg = dataclasses.replace(base_cfg, round_engine="megakernel")
+    ps, pt = _run(base_cfg, seed=seed, rounds=rounds, n=n, t=t)
+    ms, mt = _run(mega_cfg, seed=seed, rounds=rounds, n=n, t=t)
+    for field in ("votes", "consider", "confidence"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ps.records, field)),
+            np.asarray(getattr(ms.records, field)), err_msg=field)
+    # Telemetry too: votes_applied, finalized counts etc. come from the
+    # same planes — a drifted count means a drifted plane upstream.
+    for field in pt._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(pt, field)), np.asarray(getattr(mt, field)),
+            err_msg=f"telemetry.{field}")
+
+
+# ------------------------------------------------------------- fast core
+
+
+def test_megakernel_matches_phased_base():
+    _assert_engines_match(DEFAULT_CONFIG)
+
+
+def test_megakernel_matches_phased_byzantine_flip():
+    _assert_engines_match(
+        dataclasses.replace(DEFAULT_CONFIG, byzantine_fraction=0.2))
+
+
+def test_megakernel_matches_phased_oppose_majority():
+    _assert_engines_match(dataclasses.replace(
+        DEFAULT_CONFIG, byzantine_fraction=0.25,
+        adversary_strategy=AdversaryStrategy.OPPOSE_MAJORITY))
+
+
+def test_megakernel_matches_phased_small_k_quorum():
+    _assert_engines_match(
+        dataclasses.replace(DEFAULT_CONFIG, k=3, quorum=2))
+
+
+def test_megakernel_boundary_tiling():
+    """t = 1184: t/4 = 296 = 8 * 37, so the largest whole-bit-word
+    column block is 8 — the narrow-boundary tiling the block picker
+    exists for."""
+    _assert_engines_match(DEFAULT_CONFIG, seed=3, rounds=3, n=96, t=1184)
+
+
+def test_config_rejects_megakernel_with_async_ring():
+    with pytest.raises(ValueError, match="synchronous round only"):
+        AvalancheConfig(round_engine="megakernel", latency_mode="fixed",
+                        latency_rounds=2)
+
+
+def test_config_rejects_megakernel_with_inflight_engine():
+    with pytest.raises(ValueError, match="inflight_engine"):
+        AvalancheConfig(round_engine="megakernel",
+                        inflight_engine="coalesced")
+
+
+def test_config_rejects_megakernel_with_adversary_policy():
+    with pytest.raises(ValueError, match="adversary_policy"):
+        AvalancheConfig(round_engine="megakernel",
+                        adversary_policy="split_vote",
+                        byzantine_fraction=0.2)
+
+
+def test_config_rejects_unknown_engine():
+    with pytest.raises(ValueError, match="phased.*megakernel"):
+        AvalancheConfig(round_engine="warp")
+
+
+def test_fused_round_rejects_bad_shapes():
+    from go_avalanche_tpu.ops import megakernel
+    from go_avalanche_tpu.ops import voterecord as vr
+    from go_avalanche_tpu.ops.bitops import pack_bool_plane
+
+    n, t = 8, 40  # t % 32 != 0
+    recs = vr.init_state(jnp.zeros((n, t), jnp.bool_))
+    prefs = pack_bool_plane(jnp.zeros((n, t), jnp.bool_))
+    peers = jnp.zeros((n, 8), jnp.int32)
+    flags = jnp.ones((n, 8), jnp.bool_)
+    with pytest.raises(ValueError, match="divide by 32"):
+        megakernel.fused_round(recs, prefs, peers, flags,
+                               jnp.zeros((n, 8), jnp.bool_),
+                               jnp.zeros((t,), jnp.bool_),
+                               jnp.ones((n, t), jnp.bool_))
+    cfg9 = dataclasses.replace(DEFAULT_CONFIG, k=9)
+    recs32 = vr.init_state(jnp.zeros((n, 32), jnp.bool_))
+    with pytest.raises(ValueError, match=r"k must be in \(0, 8\]"):
+        megakernel.fused_round(recs32,
+                               pack_bool_plane(jnp.zeros((n, 32),
+                                                         jnp.bool_)),
+                               jnp.zeros((n, 9), jnp.int32),
+                               jnp.ones((n, 9), jnp.bool_),
+                               jnp.zeros((n, 9), jnp.bool_),
+                               jnp.zeros((32,), jnp.bool_),
+                               jnp.ones((n, 32), jnp.bool_), cfg9)
+
+
+def test_other_models_reject_megakernel_as_inert():
+    """dag / snowball / backlog / sharded keep the phased path; a
+    silently ignored engine knob would mislabel every A/B lane."""
+    from go_avalanche_tpu.models import dag as dag_model
+    from go_avalanche_tpu.models import snowball
+    from go_avalanche_tpu.parallel import sharded
+
+    mega = dataclasses.replace(DEFAULT_CONFIG, round_engine="megakernel")
+    key = jax.random.PRNGKey(0)
+
+    conflict_set = jnp.arange(16, dtype=jnp.int32) // 4
+    dstate = dag_model.init(key, 16, conflict_set, mega)
+    with pytest.raises(ValueError, match="dense avalanche round only"):
+        dag_model.round_step(dstate, mega)
+
+    sstate = snowball.init(key, 16, mega)
+    with pytest.raises(ValueError, match="dense avalanche round only"):
+        snowball.round_step(sstate, mega)
+
+    with pytest.raises(ValueError, match="sharded drivers keep the "
+                                         "phased path"):
+        sharded._reject_round_engine(mega)
+
+
+# ------------------------------------------------------------- slow grid
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(2))
+@pytest.mark.parametrize("knobs", [
+    dict(),
+    dict(byzantine_fraction=0.2),
+    dict(byzantine_fraction=0.25,
+         adversary_strategy=AdversaryStrategy.OPPOSE_MAJORITY),
+    dict(k=3, quorum=2),
+    dict(k=5, quorum=4, window=6),
+    dict(fused_exchange=False),
+    dict(ingest_engine="swar32"),
+    dict(stake_mode="zipf"),
+    dict(drop_probability=0.3),
+], ids=["base", "flip", "oppose", "k3q2", "k5q4w6", "legacy-exchange",
+        "swar32", "stake-zipf", "drop30"])
+def test_megakernel_property_matrix(seed, knobs):
+    """Randomized parity across the supported config matrix: every
+    engine-relevant knob crossed with two seeds, records AND telemetry
+    bit-equal after several rounds."""
+    _assert_engines_match(dataclasses.replace(DEFAULT_CONFIG, **knobs),
+                          seed=seed * 7 + 1)
+
+
+@pytest.mark.slow
+def test_megakernel_trajectory_20_rounds():
+    """Bit drift compounds: 20 chained rounds through the dispatch seam
+    stay identical, so the engines are interchangeable mid-run."""
+    _assert_engines_match(DEFAULT_CONFIG, seed=7, rounds=20)
